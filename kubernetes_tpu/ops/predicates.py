@@ -1,0 +1,228 @@
+"""Vectorized fit predicates: the pod x node filter as one fused kernel.
+
+Replaces the reference's findNodesThatFit hot loop
+(plugin/pkg/scheduler/core/generic_scheduler.go:163-232: 16-way
+workqueue.Parallelize over nodes, each worker running the predicate chain
+object-by-object) with dense [P, N] masks computed in one XLA program.
+
+Predicate parity map (reference: plugin/pkg/scheduler/algorithm/predicates/predicates.go):
+  PodFitsResources        :556  -> resources_fit (incl. zero-request early-exit
+                                   :576 and the overlay->scratch fallback :590-604)
+  PodFitsHost             :698  -> host_fit
+  PodFitsHostPorts        :859  -> ports_fit (bitmap gather over 65536 ports)
+  PodMatchNodeSelector    :686  -> selector_fit (OR-of-AND terms as int8 matmuls)
+  PodToleratesNodeTaints  :1241 -> taints_fit (intolerated x taint matmul)
+  CheckNodeCondition      :1306 -> node_ok (precomputed host-side verdict)
+  CheckNodeMemoryPressure :1274 -> mem_pressure_fit (best-effort pods only)
+  CheckNodeDiskPressure   :1296 -> disk_pressure_fit
+  GeneralPredicates       :900  -> resources & host & ports & selector
+
+All functions are shape-polymorphic jittable JAX; inputs are the arrays
+produced by kubernetes_tpu.state.snapshot (node side) and PodBatch (pod side),
+passed as two dicts (pytrees). Integer semantics are preserved exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.state.snapshot import (
+    NUM_BASE_RESOURCES,
+    R_GPU,
+    R_MEM,
+    R_CPU,
+    R_OVERLAY,
+    R_SCRATCH,
+)
+
+Arrays = Dict[str, jnp.ndarray]
+
+
+def node_arrays(snap) -> Arrays:
+    """Assemble the node-side pytree from a ClusterSnapshot."""
+    return {
+        "alloc": jnp.asarray(snap.alloc),
+        "requested": jnp.asarray(snap.requested),
+        "nonzero": jnp.asarray(snap.nonzero),
+        "pod_count": jnp.asarray(snap.pod_count),
+        "allowed_pods": jnp.asarray(snap.allowed_pods),
+        "schedulable": jnp.asarray(snap.schedulable),
+        "mem_pressure": jnp.asarray(snap.mem_pressure),
+        "disk_pressure": jnp.asarray(snap.disk_pressure),
+        "labels": jnp.asarray(snap.labels),
+        "taints_sched": jnp.asarray(snap.taints_sched),
+        "taints_pref": jnp.asarray(snap.taints_pref),
+        "port_bitmap": jnp.asarray(snap.port_bitmap),
+        "valid": jnp.asarray(snap.valid),
+    }
+
+
+def pod_arrays(batch) -> Arrays:
+    """Assemble the pod-side pytree from a PodBatch."""
+    return {
+        "req": jnp.asarray(batch.req),
+        "nonzero": jnp.asarray(batch.nonzero),
+        "zero_req": jnp.asarray(batch.zero_req),
+        "best_effort": jnp.asarray(batch.best_effort),
+        "ports": jnp.asarray(batch.ports),
+        "intolerated": jnp.asarray(batch.intolerated),
+        "intolerated_pref": jnp.asarray(batch.intolerated_pref),
+        "host_required": jnp.asarray(batch.host_required),
+        "has_host": jnp.asarray(batch.has_host),
+        "sel_req_all": jnp.asarray(batch.sel_req_all),
+        "sel_req_any": jnp.asarray(batch.sel_req_any),
+        "sel_forbid": jnp.asarray(batch.sel_forbid),
+        "sel_term_valid": jnp.asarray(batch.sel_term_valid),
+        "sel_any_used": jnp.asarray(batch.sel_any_used),
+        "sel_unsat": jnp.asarray(batch.sel_unsat),
+        "has_selector": jnp.asarray(batch.has_selector),
+    }
+
+
+# ---------------------------------------------------------------------------
+# capacity-dependent predicates (re-evaluated inside the placement scan)
+# ---------------------------------------------------------------------------
+
+
+def resources_fit(pod_req: jnp.ndarray, zero_req: jnp.ndarray,
+                  alloc: jnp.ndarray, requested: jnp.ndarray) -> jnp.ndarray:
+    """PodFitsResources (predicates.go:556-624) minus the pod-count check.
+
+    pod_req [P,R], zero_req [P], alloc [N,R], requested [N,R] -> bool [P,N].
+    Column layout: 0=cpu 1=mem 2=gpu 3=scratch 4=overlay 5..=extended.
+    """
+    total = pod_req[:, None, :] + requested[None, :, :]  # [P,N,R]
+    ok = total <= alloc[None, :, :]
+    # cpu/mem/gpu + extended: plain elementwise
+    plain = jnp.concatenate(
+        [ok[..., :R_SCRATCH], ok[..., NUM_BASE_RESOURCES:]], axis=-1
+    ).all(axis=-1)
+    # storage special-case (predicates.go:590-604): when the node reports no
+    # overlay capacity, overlay requests fall back onto scratch space.
+    alloc_s = alloc[None, :, R_SCRATCH]
+    alloc_o = alloc[None, :, R_OVERLAY]
+    pod_s = pod_req[:, None, R_SCRATCH]
+    pod_o = pod_req[:, None, R_OVERLAY]
+    node_s = requested[None, :, R_SCRATCH]
+    node_o = requested[None, :, R_OVERLAY]
+    no_overlay = alloc_o == 0
+    scratch_ok = jnp.where(
+        no_overlay,
+        pod_s + pod_o + node_s + node_o <= alloc_s,
+        pod_s + node_s <= alloc_s,
+    )
+    overlay_ok = no_overlay | (pod_o + node_o <= alloc_o)
+    fit = plain & scratch_ok & overlay_ok
+    # all-zero request skips resource checks entirely (predicates.go:576-578)
+    return fit | zero_req[:, None]
+
+
+def pod_count_fit(pod_count: jnp.ndarray, allowed_pods: jnp.ndarray) -> jnp.ndarray:
+    """len(pods)+1 <= allowedPodNumber (predicates.go:563-566). [N] -> [N]."""
+    return pod_count + 1 <= allowed_pods
+
+
+def ports_fit(ports: jnp.ndarray, port_bitmap: jnp.ndarray) -> jnp.ndarray:
+    """PodFitsHostPorts (predicates.go:859-878) via packed-bitmap gather.
+
+    ports [P,8] int32 with -1 sentinel; port_bitmap [N,2048] uint32 -> [P,N].
+    """
+    want = ports >= 0
+    safe = jnp.maximum(ports, 0)
+    word = safe // 32  # [P,8]
+    bit = (safe % 32).astype(jnp.uint32)
+    # gather words: [N, P, 8]
+    gathered = jnp.take(port_bitmap, word, axis=1)
+    hit = ((gathered >> bit[None, :, :]) & jnp.uint32(1)).astype(bool)
+    conflict = (hit & want[None, :, :]).any(axis=-1)  # [N,P]
+    return ~conflict.T
+
+
+# ---------------------------------------------------------------------------
+# capacity-independent predicates (computed once per batch, MXU matmuls)
+# ---------------------------------------------------------------------------
+
+
+def selector_fit(pods: Arrays, labels: jnp.ndarray) -> jnp.ndarray:
+    """PodMatchNodeSelector + required node affinity (predicates.go:625-696).
+
+    Terms are OR'd; inside a term requirements are AND'd. Compilation into
+    req_all / req_any / forbid sets happens host-side (snapshot.PodBatch);
+    here it is three int8 matmuls against node labels [N,L] and compares.
+    """
+    req_all = pods["sel_req_all"]  # [P,T,L]
+    req_any = pods["sel_req_any"]  # [P,T,A,L]
+    forbid = pods["sel_forbid"]  # [P,T,L]
+    lab = labels.astype(jnp.int8)
+    all_cnt = jnp.einsum("ptl,nl->ptn", req_all, lab,
+                         preferred_element_type=jnp.int32)
+    need = req_all.astype(jnp.int32).sum(axis=-1)  # [P,T]
+    all_ok = all_cnt == need[:, :, None]
+    forbid_cnt = jnp.einsum("ptl,nl->ptn", forbid, lab,
+                            preferred_element_type=jnp.int32)
+    forbid_ok = forbid_cnt == 0
+    any_cnt = jnp.einsum("ptal,nl->ptan", req_any, lab,
+                         preferred_element_type=jnp.int32)
+    any_ok = ((any_cnt > 0) | ~pods["sel_any_used"][:, :, :, None]).all(axis=2)
+    term_ok = (all_ok & forbid_ok & any_ok
+               & pods["sel_term_valid"][:, :, None]
+               & ~pods["sel_unsat"][:, :, None])
+    return term_ok.any(axis=1) | ~pods["has_selector"][:, None]
+
+
+def taints_fit(intolerated: jnp.ndarray, taints_sched: jnp.ndarray) -> jnp.ndarray:
+    """PodToleratesNodeTaints (predicates.go:1241): fail when the node has any
+    NoSchedule/NoExecute taint the pod does not tolerate. int8 matmul."""
+    cnt = jnp.einsum("pt,nt->pn", intolerated, taints_sched.astype(jnp.int8),
+                     preferred_element_type=jnp.int32)
+    return cnt == 0
+
+
+def host_fit(has_host: jnp.ndarray, host_required: jnp.ndarray, n: int) -> jnp.ndarray:
+    """PodFitsHost (predicates.go:698-712). [P] -> [P,N]."""
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return (~has_host[:, None]) | (host_required[:, None] == idx[None, :])
+
+
+def node_condition_fit(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
+    """CheckNodeCondition + pressure predicates (predicates.go:1274-1337).
+    Node-side verdicts are precomputed host-side; composition here."""
+    ok = nodes["schedulable"] & nodes["valid"]  # [N]
+    mem_ok = (~pods["best_effort"][:, None]) | (~nodes["mem_pressure"][None, :])
+    disk_ok = ~nodes["disk_pressure"][None, :]
+    return ok[None, :] & mem_ok & disk_ok
+
+
+def static_fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
+    """All capacity-INdependent predicates -> [P,N]. Computed once per batch;
+    safe to reuse across the placement scan because nothing here changes as
+    pods commit (labels/taints/host/conditions are node-spec facts)."""
+    n = nodes["alloc"].shape[0]
+    return (
+        selector_fit(pods, nodes["labels"])
+        & taints_fit(pods["intolerated"], nodes["taints_sched"])
+        & host_fit(pods["has_host"], pods["host_required"], n)
+        & node_condition_fit(pods, nodes)
+    )
+
+
+def fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
+    """The full predicate chain against a frozen snapshot -> bool [P,N].
+
+    Equivalent of running podFitsOnNode (generic_scheduler.go:234) for every
+    (pending pod, node) pair with GeneralPredicates + taints + conditions —
+    i.e. the default provider's registered predicates that are modeled so far
+    (volume predicates pending; see SURVEY.md §7 step 7).
+    """
+    return (
+        static_fits(pods, nodes)
+        & resources_fit(pods["req"], pods["zero_req"], nodes["alloc"], nodes["requested"])
+        & pod_count_fit(nodes["pod_count"], nodes["allowed_pods"])[None, :]
+        & ports_fit(pods["ports"], nodes["port_bitmap"])
+    )
+
+
+fits_jit = jax.jit(fits)

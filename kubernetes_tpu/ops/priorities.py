@@ -1,0 +1,179 @@
+"""Vectorized priority functions: the pod x node scorer as one fused kernel.
+
+Replaces PrioritizeNodes (reference:
+plugin/pkg/scheduler/core/generic_scheduler.go:285-414: 16-way parallel map +
+per-priority reduce goroutines) with dense int32 [P, N] score matrices.
+
+Integer semantics are preserved bit-for-bit where the reference uses integer
+math (LeastRequested/MostRequested: int64 floor division -> int32 floor
+division here, valid because snapshot units keep capacity*10 < 2^31), and
+float where the reference uses float64 (BalancedResourceAllocation) — float32
+on TPU; divergence is only possible when (1-|diff|)*10 lands within float32
+epsilon of an integer, which the tests pin down.
+
+Parity map (reference: plugin/pkg/scheduler/algorithm/priorities/):
+  LeastRequestedPriorityMap        least_requested.go:33  -> least_requested
+  BalancedResourceAllocationMap    balanced_resource_allocation.go:105 -> balanced_allocation
+  MostRequestedPriorityMap         most_requested.go:33   -> most_requested
+  TaintTolerationPriorityMap       taint_toleration.go:56 -> taint_toleration (+reduce)
+  EqualPriorityMap                 core/generic_scheduler.go:416 -> equal
+  (NodeAffinity/SelectorSpread/InterPodAffinity/ImageLocality/
+   NodePreferAvoidPods: later milestones — SURVEY.md §7 step 7)
+
+Scores are 0..MAX_PRIORITY(=10) ints per function; the combined score is the
+weight-multiplied sum (generic_scheduler.go:341-349,368-375).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.api.types import MAX_PRIORITY
+
+Arrays = Dict[str, jnp.ndarray]
+
+
+def _unused_score(total: jnp.ndarray, cap: jnp.ndarray) -> jnp.ndarray:
+    """((cap - total) * 10) / cap with int floor division; 0 when cap==0 or
+    total>cap (least_requested.go:47-57 calculateUnusedScore)."""
+    safe_cap = jnp.maximum(cap, 1)
+    score = ((cap - total) * MAX_PRIORITY) // safe_cap
+    return jnp.where((cap == 0) | (total > cap), 0, score)
+
+
+def _used_score(total: jnp.ndarray, cap: jnp.ndarray) -> jnp.ndarray:
+    """(total * 10) / cap; 0 when cap==0 or total>cap
+    (most_requested.go:52-60 calculateUsedScore)."""
+    safe_cap = jnp.maximum(cap, 1)
+    score = (total * MAX_PRIORITY) // safe_cap
+    return jnp.where((cap == 0) | (total > cap), 0, score)
+
+
+def _totals(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """total = pod nonzero request + node nonzero-requested sum
+    (least_requested.go:67-70). [P,2],[N,2] -> ([P,N] cpu, [P,N] mem)."""
+    tot = pod_nonzero[:, None, :] + node_nonzero[None, :, :]
+    return tot[..., 0], tot[..., 1]
+
+
+def least_requested(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
+                    alloc: jnp.ndarray) -> jnp.ndarray:
+    """score = (cpu_score + mem_score) / 2, each (cap-req)*10/cap
+    (least_requested.go:33-90). alloc [N,R] -> [P,N] int32."""
+    tot_cpu, tot_mem = _totals(pod_nonzero, node_nonzero)
+    cpu = _unused_score(tot_cpu, alloc[None, :, 0])
+    mem = _unused_score(tot_mem, alloc[None, :, 1])
+    return (cpu + mem) // 2
+
+
+def most_requested(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
+                   alloc: jnp.ndarray) -> jnp.ndarray:
+    """(most_requested.go:33-90). Used by the ClusterAutoscalerProvider
+    (algorithmprovider/defaults/defaults.go:65)."""
+    tot_cpu, tot_mem = _totals(pod_nonzero, node_nonzero)
+    cpu = _used_score(tot_cpu, alloc[None, :, 0])
+    mem = _used_score(tot_mem, alloc[None, :, 1])
+    return (cpu + mem) // 2
+
+
+def balanced_allocation(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
+                        alloc: jnp.ndarray) -> jnp.ndarray:
+    """10 - |cpuFraction - memFraction|*10, truncated; 0 when either
+    fraction >= 1; fraction(cap==0) := 1
+    (balanced_resource_allocation.go:51-92,105)."""
+    tot_cpu, tot_mem = _totals(pod_nonzero, node_nonzero)
+    cap_cpu = alloc[None, :, 0]
+    cap_mem = alloc[None, :, 1]
+    f32 = jnp.float32
+    frac_c = jnp.where(cap_cpu == 0, f32(1.0),
+                       tot_cpu.astype(f32) / jnp.maximum(cap_cpu, 1).astype(f32))
+    frac_m = jnp.where(cap_mem == 0, f32(1.0),
+                       tot_mem.astype(f32) / jnp.maximum(cap_mem, 1).astype(f32))
+    diff = jnp.abs(frac_c - frac_m)
+    score = ((f32(1.0) - diff) * MAX_PRIORITY).astype(jnp.int32)  # trunc toward 0
+    return jnp.where((frac_c >= 1.0) | (frac_m >= 1.0), 0, score)
+
+
+def taint_toleration(intolerated_pref: jnp.ndarray, taints_pref: jnp.ndarray,
+                     fits: jnp.ndarray = None) -> jnp.ndarray:
+    """CountIntolerableTaintsPreferNoSchedule + normalizing reduce
+    (taint_toleration.go:30-76): map = count of PreferNoSchedule taints the
+    pod does NOT tolerate; reduce = 10 * (1 - count/maxCount), and 10 when
+    maxCount==0. Integer result via float64-equivalent math: the reference
+    computes float64(10)*(1-c/max) then int() truncation — replicated with
+    exact integer arithmetic: floor(10*(max-c)/max) only when 10*(max-c) is
+    divisible... the reference truncates the float; we use integer floor which
+    matches truncation for non-negative values up to float32 rounding."""
+    cnt = jnp.einsum("pt,nt->pn", intolerated_pref,
+                     taints_pref.astype(jnp.int8),
+                     preferred_element_type=jnp.int32)
+    # the normalizing max runs over the pod's FILTERED node set only —
+    # PrioritizeNodes receives filteredNodes (generic_scheduler.go:121,285)
+    masked = cnt if fits is None else jnp.where(fits, cnt, 0)
+    max_cnt = masked.max(axis=1, keepdims=True)
+    safe = jnp.maximum(max_cnt, 1)
+    score = (MAX_PRIORITY * (max_cnt - cnt)) // safe
+    return jnp.where(max_cnt == 0, MAX_PRIORITY, score)
+
+
+def equal(p: int, n: int) -> jnp.ndarray:
+    """EqualPriorityMap (generic_scheduler.go:416-424): score 1 everywhere."""
+    return jnp.ones((p, n), dtype=jnp.int32)
+
+
+# registry: name -> (fn(pods, nodes, fits) -> [P,N] int32); `fits` is the
+# pod's filtered-node mask, consumed only by reduce-normalized priorities
+def _lr(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return least_requested(pods["nonzero"], nodes["nonzero"], nodes["alloc"])
+
+
+def _mr(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return most_requested(pods["nonzero"], nodes["nonzero"], nodes["alloc"])
+
+
+def _ba(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return balanced_allocation(pods["nonzero"], nodes["nonzero"], nodes["alloc"])
+
+
+def _tt(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return taint_toleration(pods["intolerated_pref"], nodes["taints_pref"], fits)
+
+
+def _eq(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return equal(pods["nonzero"].shape[0], nodes["alloc"].shape[0])
+
+
+PRIORITY_REGISTRY = {
+    "LeastRequestedPriority": _lr,
+    "MostRequestedPriority": _mr,
+    "BalancedResourceAllocation": _ba,
+    "TaintTolerationPriority": _tt,
+    "EqualPriority": _eq,
+}
+
+
+def score(pods: Arrays, nodes: Arrays,
+          priorities: Tuple[Tuple[str, int], ...],
+          fits: jnp.ndarray = None) -> jnp.ndarray:
+    """Weighted sum over enabled priorities -> int32 [P,N]
+    (generic_scheduler.go:368-375 'result[i].Score += score * weight')."""
+    p = pods["nonzero"].shape[0]
+    n = nodes["alloc"].shape[0]
+    total = jnp.zeros((p, n), dtype=jnp.int32)
+    for name, weight in priorities:
+        total = total + PRIORITY_REGISTRY[name](pods, nodes, fits) * weight
+    return total
+
+
+DEFAULT_PRIORITIES: Tuple[Tuple[str, int], ...] = (
+    # defaultPriorities (algorithmprovider/defaults/defaults.go:191) minus the
+    # not-yet-modeled ones (SelectorSpread, InterPodAffinity,
+    # NodePreferAvoidPods, NodeAffinity — later milestones)
+    ("LeastRequestedPriority", 1),
+    ("BalancedResourceAllocation", 1),
+    ("TaintTolerationPriority", 1),
+)

@@ -1,0 +1,199 @@
+"""Scheduler cache: authoritative in-memory cluster state incl. assumed pods.
+
+TPU-native analog of schedulerCache (reference:
+plugin/pkg/scheduler/schedulercache/cache.go:44-386). Semantics preserved:
+
+- AssumePod (cache.go:109): optimistically add a just-scheduled pod to its
+  chosen node *before* the bind API call returns, so the next scheduling
+  decision sees it. Unblocks pipelining.
+- FinishBinding (cache.go:130): start the TTL clock; if the informer never
+  confirms the bind (apiserver write lost), cleanup_assumed (cache.go:355)
+  expires the assumption and the pod's resources are released — the
+  self-healing path.
+- ForgetPod (cache.go:154): bind failed synchronously; undo immediately.
+- AddPod/UpdatePod/RemovePod (cache.go:214/248/275): informer-confirmed
+  transitions; a confirmed Add of an assumed pod just clears the deadline.
+- Add/Update/RemoveNode (cache.go:304/316/328).
+- UpdateNodeNameToInfoMap (cache.go:79): generation-diffed snapshot — here it
+  feeds the tensor snapshot's delta refresh instead of cloning Go structs.
+
+Thread-safety: a single lock, like the reference's mutex (cache.go:50). The
+engine runs scheduling on one thread (matching the reference's single
+scheduleOne goroutine, scheduler.go:253) with informer updates arriving from
+the watch thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.state.node_info import NodeInfo
+
+
+class _PodState:
+    __slots__ = ("pod", "assumed", "deadline", "binding_finished")
+
+    def __init__(self, pod: Pod):
+        self.pod = pod
+        self.assumed = False
+        self.deadline: Optional[float] = None
+        self.binding_finished = False
+
+
+class SchedulerCache:
+    def __init__(self, ttl_seconds: float = 30.0, now: Callable[[], float] = time.monotonic):
+        self._ttl = ttl_seconds
+        self._now = now
+        self._lock = threading.Lock()
+        self._pod_states: Dict[str, _PodState] = {}
+        self._nodes: Dict[str, NodeInfo] = {}
+
+    # ------------------------------------------------------------------ pods
+
+    def assume_pod(self, pod: Pod) -> None:
+        key = pod.key()
+        with self._lock:
+            if key in self._pod_states:
+                raise KeyError(f"pod {key} is already in the cache")
+            self._add_pod_locked(pod)
+            st = _PodState(pod)
+            st.assumed = True
+            self._pod_states[key] = st
+
+    def finish_binding(self, pod: Pod) -> None:
+        key = pod.key()
+        with self._lock:
+            st = self._pod_states.get(key)
+            if st is None or not st.assumed:
+                return
+            st.binding_finished = True
+            st.deadline = self._now() + self._ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        key = pod.key()
+        with self._lock:
+            st = self._pod_states.get(key)
+            if st is None:
+                return
+            if st.pod.node_name != pod.node_name and st.pod.node_name != "":
+                # the reference errors on node mismatch (cache.go:161); we
+                # tolerate and remove by the cached location
+                pass
+            if st.assumed:
+                self._remove_pod_locked(st.pod)
+                del self._pod_states[key]
+
+    def add_pod(self, pod: Pod) -> None:
+        """Informer-confirmed pod add (cache.go:214)."""
+        key = pod.key()
+        with self._lock:
+            st = self._pod_states.get(key)
+            if st is not None and st.assumed:
+                if st.pod.node_name != pod.node_name:
+                    # scheduler decision overridden (e.g. another scheduler);
+                    # move the pod (cache.go:224-232 updatePod path)
+                    self._remove_pod_locked(st.pod)
+                    self._add_pod_locked(pod)
+                st.pod = pod
+                st.assumed = False
+                st.deadline = None
+            elif st is None:
+                self._add_pod_locked(pod)
+                self._pod_states[key] = _PodState(pod)
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            st = self._pod_states.get(old.key())
+            if st is None:
+                self._add_pod_locked(new)
+                self._pod_states[new.key()] = _PodState(new)
+                return
+            self._remove_pod_locked(st.pod)
+            self._add_pod_locked(new)
+            st.pod = new
+
+    def remove_pod(self, pod: Pod) -> None:
+        key = pod.key()
+        with self._lock:
+            st = self._pod_states.pop(key, None)
+            if st is not None:
+                self._remove_pod_locked(st.pod)
+
+    def is_assumed(self, pod_key: str) -> bool:
+        with self._lock:
+            st = self._pod_states.get(pod_key)
+            return bool(st and st.assumed)
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return len(self._pod_states)
+
+    # ----------------------------------------------------------------- nodes
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            info = self._nodes.get(node.name)
+            if info is None:
+                info = NodeInfo()
+                self._nodes[node.name] = info
+            info.set_node(node)
+
+    def update_node(self, node: Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(name, None)
+            # the reference keeps the entry if pods remain (cache.go:334-339);
+            # we drop it — orphaned pods re-add a nodeless NodeInfo below
+            if info is not None and info.pods:
+                stub = NodeInfo()
+                for p in info.pods:
+                    stub.add_pod(p)
+                self._nodes[name] = stub
+
+    # -------------------------------------------------------------- snapshot
+
+    def node_infos(self) -> Dict[str, NodeInfo]:
+        """Live references (caller must treat as read-only, or hold no pointer
+        across mutations). The tensor snapshot reads generations from these —
+        the moral equivalent of UpdateNodeNameToInfoMap (cache.go:79)."""
+        with self._lock:
+            return dict(self._nodes)
+
+    def snapshot_infos(self) -> Dict[str, NodeInfo]:
+        with self._lock:
+            return {k: v.clone_shallow() for k, v in self._nodes.items()}
+
+    # -------------------------------------------------------------- expiry
+
+    def cleanup_assumed(self) -> List[str]:
+        """Expire assumed pods whose bind was never confirmed within TTL
+        (cache.go:355 cleanupAssumedPods). Returns expired pod keys."""
+        expired = []
+        now = self._now()
+        with self._lock:
+            for key, st in list(self._pod_states.items()):
+                if st.assumed and st.binding_finished and st.deadline is not None \
+                        and now >= st.deadline:
+                    self._remove_pod_locked(st.pod)
+                    del self._pod_states[key]
+                    expired.append(key)
+        return expired
+
+    # -------------------------------------------------------------- internal
+
+    def _add_pod_locked(self, pod: Pod) -> None:
+        info = self._nodes.get(pod.node_name)
+        if info is None:
+            info = NodeInfo()
+            self._nodes[pod.node_name] = info
+        info.add_pod(pod)
+
+    def _remove_pod_locked(self, pod: Pod) -> None:
+        info = self._nodes.get(pod.node_name)
+        if info is not None:
+            info.remove_pod(pod)

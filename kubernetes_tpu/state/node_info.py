@@ -1,0 +1,99 @@
+"""Per-node aggregate state.
+
+TPU-native analog of schedulercache.NodeInfo (reference:
+plugin/pkg/scheduler/schedulercache/node_info.go:34-75): the authoritative
+host-side aggregate of everything the placement kernels need about one node —
+pods assigned (incl. assumed), requested and nonzero-requested resource sums,
+used host ports, and a monotonically increasing generation counter that drives
+incremental snapshot refresh (node_info.go generation is bumped on every
+mutation; the cache's UpdateNodeNameToInfoMap at cache.go:79 clones only nodes
+whose generation moved — our tensor snapshot does the same per-column delta
+upload, see kubernetes_tpu/state/snapshot.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from kubernetes_tpu.api.types import Node, Pod, Resource
+
+
+class NodeInfo:
+    __slots__ = (
+        "node",
+        "pods",
+        "requested",
+        "nonzero_cpu",
+        "nonzero_mem",
+        "used_ports",
+        "generation",
+    )
+
+    def __init__(self, node: Optional[Node] = None):
+        self.node: Optional[Node] = node
+        self.pods: List[Pod] = []
+        self.requested = Resource()
+        self.nonzero_cpu = 0
+        self.nonzero_mem = 0
+        self.used_ports: Set[int] = set()
+        self.generation = 0
+
+    # -- mutation (mirrors node_info.go addPod:302 / removePod:330) ---------
+
+    def add_pod(self, pod: Pod) -> None:
+        req = pod.resource_request()
+        self.requested.add(req)
+        ncpu, nmem = pod.nonzero_request()
+        self.nonzero_cpu += ncpu
+        self.nonzero_mem += nmem
+        self.used_ports.update(pod.used_ports())
+        self.pods.append(pod)
+        self.generation += 1
+
+    def remove_pod(self, pod: Pod) -> bool:
+        key = pod.key()
+        for i, p in enumerate(self.pods):
+            if p.key() == key:
+                del self.pods[i]
+                req = p.resource_request()
+                self.requested.sub(req)
+                ncpu, nmem = p.nonzero_request()
+                self.nonzero_cpu -= ncpu
+                self.nonzero_mem -= nmem
+                # rebuild ports (another pod may still hold the same port —
+                # the reference keeps a map and re-adds; rebuilding is exact)
+                self.used_ports = set()
+                for q in self.pods:
+                    self.used_ports.update(q.used_ports())
+                self.generation += 1
+                return True
+        return False
+
+    def set_node(self, node: Node) -> None:
+        self.node = node
+        self.generation += 1
+
+    def allocatable(self) -> Resource:
+        return self.node.allocatable if self.node else Resource()
+
+    def allowed_pod_number(self) -> int:
+        return self.node.allowed_pod_number if self.node else 0
+
+    def clone_shallow(self) -> "NodeInfo":
+        out = NodeInfo(self.node)
+        out.pods = list(self.pods)
+        out.requested = self.requested.clone()
+        out.nonzero_cpu = self.nonzero_cpu
+        out.nonzero_mem = self.nonzero_mem
+        out.used_ports = set(self.used_ports)
+        out.generation = self.generation
+        return out
+
+
+def node_info_map(nodes: List[Node], pods: List[Pod]) -> Dict[str, NodeInfo]:
+    """Build a fresh name->NodeInfo map from raw objects (bound pods only)."""
+    out: Dict[str, NodeInfo] = {n.name: NodeInfo(n) for n in nodes}
+    for p in pods:
+        if p.node_name and p.node_name in out:
+            out[p.node_name].add_pod(p)
+    return out

@@ -1,0 +1,10 @@
+"""Test env: force the CPU backend with 8 virtual devices BEFORE jax import,
+so sharding/mesh tests run anywhere (multi-chip TPU hardware is not available
+in CI; the driver separately dry-runs __graft_entry__.dryrun_multichip)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,110 @@
+"""Golden tests: TPU priority kernels vs the pure-Python oracle (integer
+score semantics per least_requested.go / balanced_resource_allocation.go /
+most_requested.go / taint_toleration.go)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.ops import oracle
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.ops.predicates import node_arrays, pod_arrays
+from kubernetes_tpu.state.node_info import node_info_map
+from kubernetes_tpu.state.snapshot import ClusterSnapshot, PodBatch
+from tests.helpers import Gi, Mi, random_nodes, random_pod
+
+
+def build(pods, nodes, bound=()):
+    infos = node_info_map(nodes, list(bound))
+    snap = ClusterSnapshot()
+    snap.refresh(infos)
+    batch = PodBatch(pods, snap)
+    return pod_arrays(batch), node_arrays(snap), snap, infos
+
+
+PRIORITY_SETS = [
+    (("LeastRequestedPriority", 1),),
+    (("MostRequestedPriority", 1),),
+    (("BalancedResourceAllocation", 1),),
+    (("LeastRequestedPriority", 1), ("BalancedResourceAllocation", 1),
+     ("TaintTolerationPriority", 1)),
+]
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+@pytest.mark.parametrize("pset", PRIORITY_SETS)
+def test_scores_match_oracle(seed, pset):
+    rng = random.Random(seed)
+    nodes = random_nodes(rng, 16)
+    names = [n.name for n in nodes]
+    pending = [random_pod(rng, i, names) for i in range(25)]
+    bound = []
+    for i in range(20):
+        p = random_pod(rng, 500 + i, names)
+        p.node_name = rng.choice(names)
+        bound.append(p)
+    parrs, narrs, snap, infos = build(pending, nodes, bound)
+    got = np.asarray(prio.score(parrs, narrs, pset))
+    n_real = len(snap.node_names)
+    for pi, pod in enumerate(pending):
+        ordered = [infos[nm] for nm in snap.node_names]
+        want = oracle.prioritize(pod, ordered, pset)
+        np.testing.assert_array_equal(
+            got[pi, :n_real], want,
+            err_msg=f"pod {pod.name} priorities {pset}")
+
+
+def test_least_requested_exact_values():
+    # cap 4000m/32Gi; existing nonzero request 1000m/8Gi; pod 1000m/8Gi
+    node = make_node("n", cpu=4000, memory=32 * Gi)
+    holder = make_pod("h", cpu=1000, memory=8 * Gi, node_name="n")
+    pod = make_pod("p", cpu=1000, memory=8 * Gi)
+    parrs, narrs, snap, infos = build([pod], [node], [holder])
+    got = int(np.asarray(prio.score(parrs, narrs, (("LeastRequestedPriority", 1),)))[0, 0])
+    # cpu: (4000-2000)*10/4000 = 5 ; mem: (32-16)*10/32 = 5 ; avg = 5
+    assert got == 5
+    assert got == oracle.least_requested_score(pod, infos["n"])
+
+
+def test_least_requested_default_requests():
+    # unset requests count as 100m / 200Mi for scoring only
+    node = make_node("n", cpu=1000, memory=2000 * Mi)
+    pod = make_pod("p")  # no explicit requests
+    parrs, narrs, snap, infos = build([pod], [node])
+    got = int(np.asarray(prio.score(parrs, narrs, (("LeastRequestedPriority", 1),)))[0, 0])
+    # cpu: (1000-100)*10/1000 = 9 ; mem: (2000-200)*10/2000 = 9
+    assert got == 9
+
+
+def test_balanced_allocation_perfect_balance():
+    node = make_node("n", cpu=4000, memory=32 * Gi)
+    pod = make_pod("p", cpu=2000, memory=16 * Gi)  # both fractions = 0.5
+    parrs, narrs, snap, infos = build([pod], [node])
+    got = int(np.asarray(prio.score(parrs, narrs, (("BalancedResourceAllocation", 1),)))[0, 0])
+    assert got == 10
+    assert got == oracle.balanced_allocation_score(pod, infos["n"])
+
+
+def test_balanced_allocation_overcommit_scores_zero():
+    node = make_node("n", cpu=1000, memory=1 * Gi)
+    pod = make_pod("p", cpu=2000, memory=128 * Mi)
+    parrs, narrs, snap, infos = build([pod], [node])
+    got = int(np.asarray(prio.score(parrs, narrs, (("BalancedResourceAllocation", 1),)))[0, 0])
+    assert got == 0
+
+
+def test_taint_toleration_normalized():
+    from kubernetes_tpu.api.types import Taint, TaintEffect
+    n0 = make_node("n0")
+    n1 = make_node("n1", taints=[Taint("noisy", "", TaintEffect.PREFER_NO_SCHEDULE)])
+    n2 = make_node("n2", taints=[
+        Taint("noisy", "", TaintEffect.PREFER_NO_SCHEDULE),
+        Taint("louder", "", TaintEffect.PREFER_NO_SCHEDULE)])
+    pod = make_pod("p")
+    parrs, narrs, snap, infos = build([pod], [n0, n1, n2])
+    got = np.asarray(prio.score(parrs, narrs, (("TaintTolerationPriority", 1),)))[0]
+    ordered = [infos[nm] for nm in snap.node_names]
+    want = oracle.taint_toleration_scores(pod, ordered)
+    assert list(got[: len(want)]) == want  # n0:10 n1:5 n2:0
