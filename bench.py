@@ -1,0 +1,74 @@
+"""Headline benchmark: batch-place the pending queue on a hollow cluster.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
+
+Scenario (north star, BASELINE.md): 30,000 pending pods onto a 5,000-node
+hollow cluster, end-to-end through the control plane — apiserver-lite create,
+watch-driven queue fill, tensor snapshot, fused TPU batch placement with
+sequential assume semantics, per-pod bind writes, watch confirmation.
+
+vs_baseline is the ratio against the reference's 100 pods/s warn-level
+scheduler throughput (test/integration/scheduler_perf/scheduler_test.go:35 —
+the hard floor is 30 pods/s; real 1.7-era deployments sat between the two).
+
+Env knobs: BENCH_NODES, BENCH_PODS, BENCH_PROFILE (density|binpack|affinity|
+hetero), BENCH_WARMUP=0 to skip the compile-warming run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def build(n_nodes: int, n_pods: int, profile: str):
+    from kubernetes_tpu.engine.scheduler import Scheduler
+    from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes, load_cluster
+    from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+    api = ApiServerLite(max_log=max(200_000, 3 * (n_nodes + n_pods)))
+    nodes = hollow_nodes(n_nodes, heterogeneous=(profile == "hetero"),
+                         gpu_fraction=0.3 if profile == "hetero" else 0.0,
+                         taint_fraction=0.1 if profile == "hetero" else 0.0)
+    pods = PROFILES[profile](n_pods)
+    load_cluster(api, nodes, pods)
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    return api, sched
+
+
+def run_once(n_nodes: int, n_pods: int, profile: str):
+    api, sched = build(n_nodes, n_pods, profile)
+    t0 = time.monotonic()
+    totals = sched.run_until_drained()
+    elapsed = time.monotonic() - t0
+    return totals, elapsed, sched
+
+
+def main():
+    n_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    n_pods = int(os.environ.get("BENCH_PODS", 30000))
+    profile = os.environ.get("BENCH_PROFILE", "density")
+    warmup = os.environ.get("BENCH_WARMUP", "1") != "0"
+
+    if warmup:  # compile-warm the kernels at identical shapes, then measure
+        run_once(n_nodes, n_pods, profile)
+    totals, elapsed, sched = run_once(n_nodes, n_pods, profile)
+
+    bound = totals["bound"]
+    pods_per_s = bound / elapsed if elapsed > 0 else 0.0
+    print(json.dumps({
+        "metric": f"pods scheduled/sec ({profile}, {n_nodes} nodes, {n_pods} pods, create->bound)",
+        "value": round(pods_per_s, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_s / 100.0, 2),
+        "elapsed_s": round(elapsed, 3),
+        "bound": bound,
+        "unschedulable": totals["unschedulable"],
+        "p99_e2e_ms": round(sched.metrics.e2e_latency.percentile(99) * 1e3, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,302 @@
+"""Kubernetes JSON wire-format codecs.
+
+Decodes real k8s v1 JSON objects (Pod, Node, the scheduler-extender wire
+structs) into the framework's object model, so the extender sidecar speaks
+the reference's exact HTTP contract (plugin/pkg/scheduler/core/extender.go:226
+`send` posts JSON-encoded ExtenderArgs; structs at
+plugin/pkg/scheduler/api/types.go:158-204 & their v1 mirror api/v1/types.go).
+
+Includes a resource.Quantity parser
+(staging/src/k8s.io/apimachinery/pkg/api/resource/quantity.go semantics:
+plain/decimal numbers, "m" milli suffix, decimal K/M/G/T/P/E and binary
+Ki/Mi/Gi/Ti/Pi/Ei suffixes, scientific notation). CPU decodes to millicores
+(MilliValue), everything else to integer units rounded up (Value)."""
+
+from __future__ import annotations
+
+import math
+from fractions import Fraction
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    Node,
+    NodeAffinity,
+    NodeCondition,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    Resource,
+    SelectorOperator,
+    SelectorRequirement,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TolerationOperator,
+)
+
+_SUFFIX = {
+    "k": 10 ** 3, "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
+    "P": 10 ** 15, "E": 10 ** 18,
+    "Ki": 2 ** 10, "Mi": 2 ** 20, "Gi": 2 ** 30, "Ti": 2 ** 40,
+    "Pi": 2 ** 50, "Ei": 2 ** 60,
+}
+
+
+def parse_quantity(s) -> Fraction:
+    """-> exact Fraction of base units."""
+    if isinstance(s, (int, float)):
+        return Fraction(s).limit_denominator(10 ** 9)
+    s = s.strip()
+    if not s:
+        return Fraction(0)
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei", "k", "M", "G", "T", "P", "E"):
+        if s.endswith(suf):
+            return Fraction(s[: -len(suf)]) * _SUFFIX[suf]
+    if s.endswith("m"):
+        return Fraction(s[:-1]) / 1000
+    return Fraction(s)
+
+
+def quantity_milli(s) -> int:
+    """MilliValue: ceil to millis (quantity.go ScaledValue(resource.Milli))."""
+    return int(math.ceil(parse_quantity(s) * 1000))
+
+
+def quantity_value(s) -> int:
+    """Value: ceil to whole units."""
+    return int(math.ceil(parse_quantity(s)))
+
+
+def decode_resource_list(rl: Optional[Dict[str, Any]]) -> Dict[str, int]:
+    """k8s ResourceList -> canonical int units (cpu: millicores; rest: value)."""
+    out: Dict[str, int] = {}
+    for name, q in (rl or {}).items():
+        if name == "cpu":
+            out["cpu"] = quantity_milli(q)
+        elif name == "memory":
+            out["memory"] = quantity_value(q)
+        else:
+            out[name] = quantity_value(q)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# selectors / affinity
+# ---------------------------------------------------------------------------
+
+
+def _decode_requirements(reqs: Optional[List[Dict]]) -> List[SelectorRequirement]:
+    out = []
+    for r in reqs or []:
+        out.append(SelectorRequirement(
+            key=r.get("key", ""),
+            operator=SelectorOperator(r.get("operator", "In")),
+            values=list(r.get("values") or []),
+        ))
+    return out
+
+
+def _decode_node_affinity(na: Optional[Dict]) -> Optional[NodeAffinity]:
+    if na is None:
+        return None
+    required = None
+    req = na.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if req is not None:
+        required = [NodeSelectorTerm(_decode_requirements(t.get("matchExpressions")))
+                    for t in req.get("nodeSelectorTerms") or []]
+    preferred = []
+    for p in na.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        pref = p.get("preference") or {}
+        preferred.append((int(p.get("weight", 1)),
+                          NodeSelectorTerm(_decode_requirements(
+                              pref.get("matchExpressions")))))
+    return NodeAffinity(required_terms=required, preferred_terms=preferred)
+
+
+def _decode_label_selector(ls: Optional[Dict]) -> Optional[LabelSelector]:
+    if ls is None:
+        return None
+    return LabelSelector(
+        match_labels=dict(ls.get("matchLabels") or {}),
+        match_expressions=_decode_requirements(ls.get("matchExpressions")),
+    )
+
+
+def _decode_pod_affinity_terms(terms: Optional[List[Dict]]) -> List[PodAffinityTerm]:
+    out = []
+    for t in terms or []:
+        out.append(PodAffinityTerm(
+            label_selector=_decode_label_selector(t.get("labelSelector")),
+            namespaces=list(t.get("namespaces") or []),
+            topology_key=t.get("topologyKey", ""),
+        ))
+    return out
+
+
+def _decode_pod_affinity(pa: Optional[Dict]) -> Optional[PodAffinity]:
+    if pa is None:
+        return None
+    preferred = []
+    for w in pa.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        term = w.get("podAffinityTerm") or {}
+        preferred.append((int(w.get("weight", 1)),
+                          _decode_pod_affinity_terms([term])[0]))
+    return PodAffinity(
+        required_terms=_decode_pod_affinity_terms(
+            pa.get("requiredDuringSchedulingIgnoredDuringExecution")),
+        preferred_terms=preferred,
+    )
+
+
+def decode_affinity(aff: Optional[Dict]) -> Optional[Affinity]:
+    if not aff:
+        return None
+    return Affinity(
+        node_affinity=_decode_node_affinity(aff.get("nodeAffinity")),
+        pod_affinity=_decode_pod_affinity(aff.get("podAffinity")),
+        pod_anti_affinity=_decode_pod_affinity(aff.get("podAntiAffinity")),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pod / Node
+# ---------------------------------------------------------------------------
+
+
+def decode_pod(obj: Dict[str, Any]) -> Pod:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    containers = []
+    for c in spec.get("containers") or []:
+        res = c.get("resources") or {}
+        containers.append(Container(
+            name=c.get("name", ""),
+            image=c.get("image", ""),
+            requests=decode_resource_list(res.get("requests")),
+            limits=decode_resource_list(res.get("limits")),
+            ports=[ContainerPort(host_port=int(p.get("hostPort", 0)),
+                                 container_port=int(p.get("containerPort", 0)),
+                                 protocol=p.get("protocol", "TCP"))
+                   for p in c.get("ports") or []],
+        ))
+    tolerations = []
+    for t in spec.get("tolerations") or []:
+        eff = t.get("effect") or None
+        tolerations.append(Toleration(
+            key=t.get("key", ""),
+            operator=TolerationOperator(t.get("operator", "Equal")),
+            value=t.get("value", ""),
+            effect=TaintEffect(eff) if eff else None,
+        ))
+    owner_kind, owner_name = "", ""
+    for ref in meta.get("ownerReferences") or []:
+        if ref.get("controller"):
+            owner_kind, owner_name = ref.get("kind", ""), ref.get("name", "")
+            break
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        containers=containers,
+        node_name=spec.get("nodeName", ""),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        affinity=decode_affinity(spec.get("affinity")),
+        tolerations=tolerations,
+        scheduler_name=spec.get("schedulerName", "default-scheduler"),
+        priority=int(spec.get("priority") or 0),
+        owner_kind=owner_kind,
+        owner_name=owner_name,
+    )
+
+
+def decode_node(obj: Dict[str, Any]) -> Node:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    alloc_rl = decode_resource_list(status.get("allocatable")
+                                    or status.get("capacity"))
+    extended = {k: v for k, v in alloc_rl.items()
+                if k not in ("cpu", "memory", "pods",
+                             "nvidia.com/gpu", "alpha.kubernetes.io/nvidia-gpu",
+                             "storage.kubernetes.io/scratch",
+                             "storage.kubernetes.io/overlay")}
+    alloc = Resource(
+        milli_cpu=alloc_rl.get("cpu", 0),
+        memory=alloc_rl.get("memory", 0),
+        nvidia_gpu=alloc_rl.get("nvidia.com/gpu",
+                                alloc_rl.get("alpha.kubernetes.io/nvidia-gpu", 0)),
+        storage_scratch=alloc_rl.get("storage.kubernetes.io/scratch", 0),
+        storage_overlay=alloc_rl.get("storage.kubernetes.io/overlay", 0),
+        extended=extended,
+    )
+    taints = []
+    for t in spec.get("taints") or []:
+        taints.append(Taint(t.get("key", ""), t.get("value", ""),
+                            TaintEffect(t.get("effect", "NoSchedule"))))
+    conditions = [NodeCondition(c.get("type", ""), c.get("status", "Unknown"))
+                  for c in status.get("conditions") or []]
+    return Node(
+        name=meta.get("name", ""),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        allocatable=alloc,
+        allowed_pod_number=alloc_rl.get("pods", 110),
+        taints=taints,
+        unschedulable=bool(spec.get("unschedulable", False)),
+        conditions=conditions,
+    )
+
+
+def encode_pod(pod: Pod) -> Dict[str, Any]:
+    """Minimal re-encode (enough for extender round-trips and debugging)."""
+    containers = []
+    for c in pod.containers:
+        req = {}
+        for k, v in c.requests.items():
+            req[k] = f"{v}m" if k == "cpu" else str(v)
+        containers.append({
+            "name": c.name, "image": c.image,
+            "resources": {"requests": req},
+            "ports": [{"hostPort": p.host_port, "containerPort": p.container_port,
+                       "protocol": p.protocol} for p in c.ports],
+        })
+    return {
+        "metadata": {"name": pod.name, "namespace": pod.namespace,
+                     "uid": pod.uid, "labels": pod.labels},
+        "spec": {"containers": containers, "nodeName": pod.node_name,
+                 "nodeSelector": pod.node_selector,
+                 "schedulerName": pod.scheduler_name},
+    }
+
+
+def encode_node(node: Node) -> Dict[str, Any]:
+    alloc = {"cpu": f"{node.allocatable.milli_cpu}m",
+             "memory": str(node.allocatable.memory),
+             "pods": str(node.allowed_pod_number)}
+    if node.allocatable.nvidia_gpu:
+        alloc["nvidia.com/gpu"] = str(node.allocatable.nvidia_gpu)
+    for k, v in node.allocatable.extended.items():
+        alloc[k] = str(v)
+    return {
+        "metadata": {"name": node.name, "labels": node.labels},
+        "spec": {
+            "unschedulable": node.unschedulable,
+            "taints": [{"key": t.key, "value": t.value,
+                        "effect": (t.effect.value if isinstance(t.effect, TaintEffect)
+                                   else t.effect)} for t in node.taints],
+        },
+        "status": {
+            "allocatable": alloc,
+            "conditions": [{"type": c.type,
+                            "status": (c.status.value if hasattr(c.status, "value")
+                                       else c.status)}
+                           for c in node.conditions],
+        },
+    }

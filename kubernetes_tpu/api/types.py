@@ -321,9 +321,12 @@ class Pod:
         return cpu, mem
 
     def used_ports(self) -> List[int]:
-        """Host ports requested — schedutil.GetUsedPorts
-        (reference: plugin/pkg/scheduler/util/utils.go)."""
-        return [p.host_port for c in self.containers for p in c.ports if p.host_port != 0]
+        """Host ports requested, deduplicated — schedutil.GetUsedPorts returns
+        a map (reference: plugin/pkg/scheduler/util/utils.go), so duplicates
+        collapse; dedup also keeps per-word port bits distinct for the
+        scatter-add commit in engine/batch.py."""
+        return list(dict.fromkeys(
+            p.host_port for c in self.containers for p in c.ports if p.host_port != 0))
 
     def is_best_effort(self) -> bool:
         """True when no container has any request or limit — v1qos.GetPodQOS

@@ -1,0 +1,163 @@
+"""Batch placement: the whole pending queue scheduled in one device program.
+
+The reference schedules strictly one pod at a time (scheduler.go:253
+scheduleOne; SURVEY.md §2.3 — the single-goroutine serialization point), with
+each decision visible to the next via SchedulerCache.AssumePod. This module
+reproduces those *exact* sequential semantics on device: a lax.scan over the
+pending pods where the carry is the mutable node state (requested resources,
+nonzero sums, pod counts, port bitmaps) and each step re-evaluates the
+capacity-dependent predicates/priorities against the carry before committing
+the chosen node — i.e. assume/decrement happens on device, solving the
+batch-staleness problem (SURVEY.md §7 hard part (c)) without host round-trips.
+
+Work split per SURVEY.md §7 step 2:
+  - capacity-INdependent masks (selector/taints/host/conditions) and score
+    components (taint-toleration counts) are batched MXU matmuls computed ONCE
+    for the whole chunk *outside* the scan (ops/predicates.static_fits);
+  - the per-pod scan step is cheap VPU work: O(N*R) compares + one argmax.
+
+selectHost parity (generic_scheduler.go:88-160):
+  - 0 fitting nodes  -> selected = -1 (FitError host-side), counter unchanged
+  - 1 fitting node   -> early return (schedule() skips PrioritizeNodes), RR
+                        counter NOT incremented (generic_scheduler.go:110-117)
+  - >1 fitting nodes -> max-score tie set, index = counter % ties (counter++),
+                        tie order = ascending node index (the reference's
+                        unstable-sort order is implementation-defined).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubernetes_tpu.ops import predicates as preds
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.api.types import MAX_PRIORITY
+
+Arrays = Dict[str, jnp.ndarray]
+
+
+class NodeState(NamedTuple):
+    """The mutable (carry) slice of node state. Static facts (alloc, labels,
+    taints, allowed_pods, conditions) stay outside the carry."""
+
+    requested: jnp.ndarray  # int32 [N,R]
+    nonzero: jnp.ndarray  # int32 [N,2]
+    pod_count: jnp.ndarray  # int32 [N]
+    port_bitmap: jnp.ndarray  # uint32 [N,W]
+
+
+def node_state(nodes: Arrays) -> NodeState:
+    return NodeState(nodes["requested"], nodes["nonzero"], nodes["pod_count"],
+                     nodes["port_bitmap"])
+
+
+def _step_scores(pod_nonzero: jnp.ndarray, state: NodeState, alloc: jnp.ndarray,
+                 tt_cnt: jnp.ndarray, fits: jnp.ndarray,
+                 priorities: Tuple[Tuple[str, int], ...]) -> jnp.ndarray:
+    """Per-pod priority sum against the evolving carry. [N] int32."""
+    pz = pod_nonzero[None, :]  # [1,2]
+    total = jnp.zeros(alloc.shape[0], dtype=jnp.int32)
+    for name, weight in priorities:
+        if name == "LeastRequestedPriority":
+            s = prio.least_requested(pz, state.nonzero, alloc)[0]
+        elif name == "MostRequestedPriority":
+            s = prio.most_requested(pz, state.nonzero, alloc)[0]
+        elif name == "BalancedResourceAllocation":
+            s = prio.balanced_allocation(pz, state.nonzero, alloc)[0]
+        elif name == "TaintTolerationPriority":
+            # normalizing reduce over the pod's CURRENT filtered set
+            masked = jnp.where(fits, tt_cnt, 0)
+            mx = masked.max()
+            s = jnp.where(mx == 0, MAX_PRIORITY,
+                          (MAX_PRIORITY * (mx - tt_cnt)) // jnp.maximum(mx, 1))
+        elif name == "EqualPriority":
+            s = jnp.ones_like(total)
+        else:
+            raise KeyError(name)
+        total = total + s * weight
+    return total
+
+
+def _commit(state: NodeState, sel: jnp.ndarray, ok: jnp.ndarray,
+            pod_req: jnp.ndarray, pod_nonzero: jnp.ndarray,
+            pod_ports: jnp.ndarray) -> NodeState:
+    """Decrement capacity at the selected node (the on-device AssumePod)."""
+    safe = jnp.where(ok, sel, 0)
+    gain = ok.astype(jnp.int32)
+    requested = state.requested.at[safe].add(pod_req * gain)
+    nonzero = state.nonzero.at[safe].add(pod_nonzero * gain)
+    pod_count = state.pod_count.at[safe].add(gain)
+    # OR the pod's host-port bits into the node's bitmap. Ports are deduped
+    # host-side (Pod.used_ports), so bits landing in the same word are
+    # distinct and a scatter-ADD is an exact OR (the pod only commits to a
+    # node where none of its bits were set).
+    want = pod_ports >= 0
+    wsafe = jnp.maximum(pod_ports, 0)
+    words = wsafe // 32
+    bits = jnp.where(want & ok, jnp.uint32(1) << (wsafe % 32).astype(jnp.uint32),
+                     jnp.uint32(0))
+    row = state.port_bitmap[safe].at[words].add(bits)
+    port_bitmap = state.port_bitmap.at[safe].set(
+        jnp.where(ok, row, state.port_bitmap[safe]))
+    return NodeState(requested, nonzero, pod_count, port_bitmap)
+
+
+@functools.partial(jax.jit, static_argnames=("priorities",))
+def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
+                rr_counter: jnp.ndarray,
+                priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, NodeState, jnp.ndarray]:
+    """Place every pod in the batch sequentially on device.
+
+    Returns (selected [P] int32 node index or -1,
+             fit_count [P] int32 (diagnostics / FitError),
+             final NodeState,
+             final rr_counter).
+    """
+    static_fit = preds.static_fits(pods, nodes)  # [P,N] — MXU batch
+    tt_cnt = jnp.einsum("pt,nt->pn", pods["intolerated_pref"],
+                        nodes["taints_pref"].astype(jnp.int8),
+                        preferred_element_type=jnp.int32)
+    alloc = nodes["alloc"]
+    allowed = nodes["allowed_pods"]
+    n = alloc.shape[0]
+    idx_n = jnp.arange(n, dtype=jnp.int32)
+
+    def step(carry, xs):
+        state, counter = carry
+        p_static, p_tt, p_req, p_zero, p_nonzero, p_ports = xs
+        dyn = (
+            preds.resources_fit(p_req[None], p_zero[None], alloc, state.requested)[0]
+            & preds.pod_count_fit(state.pod_count, allowed)
+            & preds.ports_fit(p_ports[None], state.port_bitmap)[0]
+        )
+        fits = p_static & dyn
+        fit_count = fits.sum().astype(jnp.int32)
+        scores = _step_scores(p_nonzero, state, alloc, p_tt, fits, priorities)
+        masked = jnp.where(fits, scores, jnp.int32(-1))
+        best = masked.max()
+        ties = masked == best  # only fitting nodes can equal best when best>=0
+        num_ties = ties.sum().astype(jnp.uint32)
+        k = jnp.where(num_ties > 0, counter % jnp.maximum(num_ties, 1), 0)
+        # k-th fitting max-score node in ascending index order
+        rank = jnp.cumsum(ties.astype(jnp.uint32)) - 1
+        cand = jnp.where(ties & (rank == k), idx_n, n)
+        rr_sel = cand.min().astype(jnp.int32)
+        one_sel = jnp.argmax(fits).astype(jnp.int32)  # the single fitting node
+        sel = jnp.where(fit_count == 0, jnp.int32(-1),
+                        jnp.where(fit_count == 1, one_sel, rr_sel))
+        ok = fit_count > 0
+        counter = counter + jnp.where(fit_count > 1, jnp.uint32(1), jnp.uint32(0))
+        new_state = _commit(state, sel, ok, p_req, p_nonzero, p_ports)
+        return (new_state, counter), (sel, fit_count)
+
+    xs = (static_fit, tt_cnt, pods["req"], pods["zero_req"], pods["nonzero"],
+          pods["ports"])
+    (state, rr_counter), (selected, fit_counts) = lax.scan(
+        step, (state, rr_counter), xs)
+    return selected, fit_counts, state, rr_counter

@@ -1,0 +1,129 @@
+"""Pending-pod queue with exponential backoff requeue.
+
+Mirrors the reference's FIFO pod queue (factory.go:140 podQueue =
+cache.NewFIFO) + the error-path backoff requeue (factory.go:897
+MakeDefaultErrorFunc with util.PodBackoff: initial 1s, max 60s, doubling per
+pod — plugin/pkg/scheduler/util/backoff_utils.go).
+
+Batch-native twist: pop_batch drains up to max_n ready pods at once (the
+snapshot-the-queue idea from SURVEY.md §2.3) instead of one blocking Pop.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import Pod
+
+INITIAL_BACKOFF = 1.0
+MAX_BACKOFF = 60.0
+
+
+class PodBackoff:
+    """Per-pod doubling backoff (backoff_utils.go:SchedulerBackoff)."""
+
+    def __init__(self, initial: float = INITIAL_BACKOFF, max_s: float = MAX_BACKOFF,
+                 now: Callable[[], float] = time.monotonic):
+        self._initial = initial
+        self._max = max_s
+        self._now = now
+        self._durations: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+
+    def next_delay(self, key: str) -> float:
+        """Current delay for the pod, then double for next time."""
+        d = self._durations.get(key, self._initial)
+        self._durations[key] = min(d * 2, self._max)
+        self._last[key] = self._now()
+        return d
+
+    def gc(self, max_age: float = 2 * MAX_BACKOFF) -> None:
+        cutoff = self._now() - max_age
+        for k in [k for k, t in self._last.items() if t < cutoff]:
+            self._durations.pop(k, None)
+            self._last.pop(k, None)
+
+
+class SchedulingQueue:
+    def __init__(self, now: Callable[[], float] = time.monotonic):
+        self._now = now
+        self._lock = threading.Condition()
+        self._fifo: List[Pod] = []
+        self._keys: Dict[str, Pod] = {}
+        self._deferred: List = []  # heap of (ready_time, seq, pod)
+        self._seq = 0
+        self.backoff = PodBackoff(now=now)
+
+    def add(self, pod: Pod) -> None:
+        with self._lock:
+            key = pod.key()
+            if key in self._keys:
+                return
+            self._keys[key] = pod
+            self._fifo.append(pod)
+            self._lock.notify_all()
+
+    def add_backoff(self, pod: Pod) -> float:
+        """Requeue after the pod's current backoff delay; returns the delay."""
+        with self._lock:
+            key = pod.key()
+            if key in self._keys:
+                return 0.0
+            delay = self.backoff.next_delay(key)
+            self._keys[key] = pod
+            self._seq += 1
+            heapq.heappush(self._deferred, (self._now() + delay, self._seq, pod))
+            self._lock.notify_all()
+            return delay
+
+    def remove(self, pod_key: str) -> None:
+        """Drop a pod (deleted / scheduled by someone else)."""
+        with self._lock:
+            if self._keys.pop(pod_key, None) is not None:
+                self._fifo = [p for p in self._fifo if p.key() != pod_key]
+                self._deferred = [(t, s, p) for (t, s, p) in self._deferred
+                                  if p.key() != pod_key]
+                heapq.heapify(self._deferred)
+
+    def pop_batch(self, max_n: int = 0, wait: Optional[float] = None) -> List[Pod]:
+        """Drain up to max_n (0 = all) ready pods; optionally block up to
+        `wait` seconds for the first one."""
+        deadline = None if wait is None else self._now() + wait
+        with self._lock:
+            while True:
+                self._promote_ready()
+                if self._fifo:
+                    n = len(self._fifo) if max_n == 0 else min(max_n, len(self._fifo))
+                    out = self._fifo[:n]
+                    self._fifo = self._fifo[n:]
+                    for p in out:
+                        self._keys.pop(p.key(), None)
+                    return out
+                if deadline is None:
+                    return []
+                remaining = deadline - self._now()
+                if remaining <= 0:
+                    return []
+                timeout = remaining
+                if self._deferred:
+                    timeout = min(timeout, max(self._deferred[0][0] - self._now(), 0.01))
+                self._lock.wait(timeout)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+    def ready_count(self) -> int:
+        with self._lock:
+            self._promote_ready()
+            return len(self._fifo)
+
+    def _promote_ready(self) -> None:
+        now = self._now()
+        while self._deferred and self._deferred[0][0] <= now:
+            _, _, pod = heapq.heappop(self._deferred)
+            if pod.key() in self._keys:
+                self._fifo.append(pod)

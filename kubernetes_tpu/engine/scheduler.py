@@ -1,0 +1,222 @@
+"""The scheduler daemon: informer sync -> queue -> batch engine -> bind.
+
+Structural mirror of the reference's scheduler loop
+(plugin/pkg/scheduler/scheduler.go:149 Run / :253 scheduleOne and the
+factory's informer wiring, factory.go:120-601), TPU-batched: instead of a
+single-goroutine one-pod loop, each round drains the ready queue and places
+the whole batch in one device program (engine/batch.py), then binds each
+placement through the apiserver. Error paths preserved:
+
+- no fitting node -> FailedScheduling event + backoff requeue
+  (scheduler.go:174-181; factory.go:897 MakeDefaultErrorFunc)
+- bind Conflict/NotFound -> ForgetPod + backoff requeue (scheduler.go:234-249)
+- bind success -> FinishBinding starts the assumed-pod TTL; the watch-stream
+  confirmation (MODIFIED pod with node_name) calls cache.AddPod
+  (cache.go:130,214), closing the optimistic-concurrency loop.
+
+Watch handling mirrors client-go reflector semantics: initial List+Watch from
+the returned resourceVersion; TooOldResourceVersion -> full relist rebuild.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Binding, Event, Node, Pod
+from kubernetes_tpu.engine.queue import SchedulingQueue
+from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.server.apiserver_lite import (
+    ApiServerLite,
+    Conflict,
+    NotFound,
+    TooOldResourceVersion,
+)
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.utils.metrics import SchedulerMetrics
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+
+class Scheduler:
+    def __init__(self, api: ApiServerLite,
+                 scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+                 priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
+                 assumed_ttl: float = 30.0,
+                 record_events: bool = True,
+                 now=time.monotonic):
+        self.api = api
+        self.scheduler_name = scheduler_name
+        self._now = now
+        self.cache = SchedulerCache(ttl_seconds=assumed_ttl, now=now)
+        self.engine = SchedulingEngine(self.cache, priorities=priorities)
+        self.queue = SchedulingQueue(now=now)
+        self.metrics = SchedulerMetrics()
+        self.record_events = record_events
+        self.events: List[Event] = []
+        self._rv = 0
+        self._pods: Dict[str, Pod] = {}  # last-seen apiserver pod state
+        self._started = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        """Initial List (reflector handshake): nodes + pods into cache/queue."""
+        nodes, _ = self.api.list("Node")
+        for n in nodes:
+            self.cache.add_node(n)
+        pods, rv = self.api.list("Pod")
+        for p in pods:
+            self._pods[p.key()] = p
+            if p.node_name:
+                self.cache.add_pod(p)
+            elif self._responsible_for(p):
+                self.queue.add(dataclasses.replace(p))
+        self._rv = rv
+        self._started = True
+
+    def sync(self, wait: float = 0.0) -> int:
+        """Drain watch events into cache + queue (the informer event handlers
+        of factory.go:188-260). Returns number of events processed."""
+        if not self._started:
+            self.start()
+            return 0
+        try:
+            events = self.api.watch_since(("Pod", "Node"), self._rv, timeout=wait)
+        except TooOldResourceVersion:
+            self._relist()
+            return 0
+        for ev in events:
+            self._rv = ev.rv
+            if ev.kind == "Node":
+                self._on_node_event(ev.type, ev.obj)
+            else:
+                self._on_pod_event(ev.type, ev.obj)
+        return len(events)
+
+    # ------------------------------------------------------------ scheduling
+
+    def schedule_round(self, max_batch: int = 0, wait: float = 0.0) -> Dict[str, int]:
+        """One batch round: pop ready pods, place on device, bind. Mirrors
+        scheduleOne (scheduler.go:253) over a whole batch."""
+        self.sync()
+        pods = self.queue.pop_batch(max_n=max_batch, wait=wait)
+        stats = {"popped": len(pods), "bound": 0, "unschedulable": 0,
+                 "bind_errors": 0}
+        if not pods:
+            self.cache.cleanup_assumed()
+            self.queue.backoff.gc()
+            return stats
+        t0 = time.monotonic()
+        results = self.engine.schedule(pods, assume=True)
+        t_alg = time.monotonic() - t0
+        per_pod_alg = t_alg / max(len(pods), 1)
+        for r in results:
+            if r.node_name is None:
+                stats["unschedulable"] += 1
+                self.metrics.failed.inc()
+                self._event(r.pod, "Warning", "FailedScheduling",
+                            f"0/{len(self.engine.snapshot.node_names)} nodes "
+                            f"available (fit_count={r.fit_count})")
+                self.queue.add_backoff(r.pod)
+                continue
+            tb0 = time.monotonic()
+            try:
+                self.api.bind(Binding(r.pod.name, r.pod.namespace, r.pod.uid,
+                                      r.node_name))
+            except (Conflict, NotFound) as e:
+                # undo the optimistic assume (scheduler.go:234-245)
+                stats["bind_errors"] += 1
+                self.cache.forget_pod(r.pod)
+                self._event(r.pod, "Warning", "FailedBinding", str(e))
+                retry = dataclasses.replace(r.pod, node_name="")
+                self.queue.add_backoff(retry)
+                continue
+            t_bind = time.monotonic() - tb0
+            self.cache.finish_binding(r.pod)
+            stats["bound"] += 1
+            self.metrics.scheduled.inc()
+            self.metrics.algorithm_latency.observe(per_pod_alg)
+            self.metrics.binding_latency.observe(t_bind)
+            self.metrics.e2e_latency.observe(per_pod_alg + t_bind)
+            self._event(r.pod, "Normal", "Scheduled",
+                        f"Successfully assigned {r.pod.key()} to {r.node_name}")
+        self.cache.cleanup_assumed()
+        self.queue.backoff.gc()
+        return stats
+
+    def run_until_drained(self, max_rounds: int = 10_000,
+                          max_batch: int = 0) -> Dict[str, int]:
+        """Bench helper: rounds until queue is empty and no watch events."""
+        total = {"popped": 0, "bound": 0, "unschedulable": 0, "bind_errors": 0}
+        for _ in range(max_rounds):
+            stats = self.schedule_round(max_batch=max_batch)
+            for k in total:
+                total[k] += stats[k]
+            if stats["popped"] == 0 and self.sync() == 0 \
+                    and self.queue.ready_count() == 0:
+                break
+        return total
+
+    # ------------------------------------------------------------- handlers
+
+    def _responsible_for(self, pod: Pod) -> bool:
+        return (pod.scheduler_name or DEFAULT_SCHEDULER_NAME) == self.scheduler_name
+
+    def _on_node_event(self, etype: str, node: Node) -> None:
+        if etype == "DELETED":
+            self.cache.remove_node(node.name)
+        else:
+            self.cache.update_node(node)
+
+    def _on_pod_event(self, etype: str, pod: Pod) -> None:
+        key = pod.key()
+        prev = self._pods.get(key)
+        if etype == "DELETED":
+            self._pods.pop(key, None)
+            self.queue.remove(key)
+            if prev is not None and prev.node_name:
+                self.cache.remove_pod(prev)
+            return
+        self._pods[key] = pod
+        if etype == "ADDED":
+            if pod.node_name:
+                self.cache.add_pod(pod)
+            elif self._responsible_for(pod):
+                self.queue.add(dataclasses.replace(pod))
+            return
+        # MODIFIED
+        was_bound = prev is not None and bool(prev.node_name)
+        if not was_bound and pod.node_name:
+            self.queue.remove(key)
+            self.cache.add_pod(pod)  # confirms our assume, or records a
+            # foreign scheduler's bind (cache.go:214)
+        elif was_bound and pod.node_name:
+            self.cache.update_pod(prev, pod)
+        elif was_bound and not pod.node_name:
+            self.cache.remove_pod(prev)
+            if self._responsible_for(pod):
+                self.queue.add(dataclasses.replace(pod))
+        else:
+            self.queue.remove(key)
+            if self._responsible_for(pod):
+                self.queue.add(dataclasses.replace(pod))
+
+    def _relist(self) -> None:
+        """Watch fell behind the event log — rebuild everything from a fresh
+        List, like a reflector restart. Assumed pods still pending
+        confirmation are preserved by re-adding only confirmed state."""
+        self.cache = SchedulerCache(ttl_seconds=self.cache._ttl, now=self._now)
+        self.engine = SchedulingEngine(self.cache,
+                                       priorities=self.engine.priorities)
+        self.queue = SchedulingQueue(now=self._now)
+        self._pods = {}
+        self._started = False
+        self.start()
+
+    def _event(self, pod: Pod, etype: str, reason: str, message: str) -> None:
+        if not self.record_events:
+            return
+        self.events.append(Event(pod.key(), reason, message, etype))

@@ -1,0 +1,142 @@
+"""Host-side scheduling engine: snapshot -> device batch -> assume.
+
+The TPU-native replacement for genericScheduler.Schedule
+(reference: plugin/pkg/scheduler/core/generic_scheduler.go:88-142) operating
+on the whole pending queue at once:
+
+  1. delta-refresh the tensor snapshot from the SchedulerCache (the analog of
+     cache.UpdateNodeNameToInfoMap at generic_scheduler.go:101);
+  2. run engine/batch.place_batch on device — sequential semantics preserved
+     (see batch.py docstring);
+  3. map node indices back to names and AssumePod each placement into the
+     cache (scheduler.go:188 assume; binding is the caller's async job,
+     scheduler.go:224-250).
+
+Pods whose features the kernels over-approximate (PodBatch.needs_host_check)
+take the exact object-level oracle path against the updated cache — the
+"exact host-side verification" safety net of SURVEY.md §7(e).
+
+Device arrays are cached keyed on snapshot.version so an unchanged cluster
+uploads nothing between batches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.engine.batch import NodeState, place_batch
+from kubernetes_tpu.ops import oracle
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.ops.predicates import node_arrays, pod_arrays
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.snapshot import ClusterSnapshot, PodBatch
+
+
+class PlacementResult:
+    __slots__ = ("pod", "node_name", "fit_count")
+
+    def __init__(self, pod: Pod, node_name: Optional[str], fit_count: int):
+        self.pod = pod
+        self.node_name = node_name
+        self.fit_count = fit_count
+
+    def __repr__(self):
+        return f"Placement({self.pod.key()} -> {self.node_name})"
+
+
+class SchedulingEngine:
+    def __init__(self, cache: SchedulerCache,
+                 priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
+                 mem_shift: int = 10):
+        self.cache = cache
+        self.priorities = priorities
+        self.snapshot = ClusterSnapshot(mem_shift=mem_shift)
+        self.rr = oracle.RoundRobin()  # shared counter, device + oracle paths
+        self._device_nodes = None
+        self._device_version = -1
+
+    # ------------------------------------------------------------------ api
+
+    def schedule(self, pods: Sequence[Pod], assume: bool = True
+                 ) -> List[PlacementResult]:
+        """Schedule a batch. Returns one PlacementResult per pod, in input
+        order. When assume=True, successful placements are assumed into the
+        cache with pod.node_name set (the caller binds asynchronously)."""
+        if not pods:
+            return []
+        infos = self.cache.node_infos()
+        self.snapshot.refresh(infos)
+        # PodBatch first: selector compilation may grow the label vocab and
+        # rebuild the label matrix; upload happens after, dirty-arrays only
+        batch = PodBatch(pods, self.snapshot)
+        nodes = self._nodes_on_device()
+        fast_idx = [i for i in range(len(pods)) if not batch.needs_host_check[i]]
+        slow_idx = [i for i in range(len(pods)) if batch.needs_host_check[i]]
+        results: List[Optional[PlacementResult]] = [None] * len(pods)
+
+        if fast_idx:
+            if len(fast_idx) == len(pods):
+                fast_batch = batch
+            else:
+                fast_batch = PodBatch([pods[i] for i in fast_idx], self.snapshot)
+            parr = pod_arrays(fast_batch)
+            state = NodeState(nodes["requested"], nodes["nonzero"],
+                              nodes["pod_count"], nodes["port_bitmap"])
+            selected, fit_counts, _, rr_end = place_batch(
+                parr, nodes, state, jnp.uint32(self.rr.counter),
+                self.priorities)
+            selected = np.asarray(selected)
+            fit_counts = np.asarray(fit_counts)
+            self.rr.counter = int(rr_end)
+            for j, i in enumerate(fast_idx):
+                sel = int(selected[j])
+                name = self.snapshot.node_names[sel] if sel >= 0 else None
+                results[i] = PlacementResult(pods[i], name, int(fit_counts[j]))
+                if name is not None and assume:
+                    self._assume(pods[i], name)
+
+        # exact host path for over-approximated pods, AFTER device placements
+        # so they see committed capacity (FIFO order within themselves)
+        if slow_idx:
+            infos = self.cache.node_infos()
+            names = self.snapshot.node_names
+            for i in slow_idx:
+                name = oracle.schedule_one(pods[i], names, infos, self.rr,
+                                           self.priorities)
+                results[i] = PlacementResult(pods[i], name, 1 if name else 0)
+                if name is not None and assume:
+                    self._assume(pods[i], name)
+                    infos = self.cache.node_infos()
+
+        return results  # type: ignore[return-value]
+
+    # ------------------------------------------------------------- internals
+
+    def _assume(self, pod: Pod, node_name: str) -> None:
+        pod.node_name = node_name
+        self.cache.assume_pod(pod)
+
+    _NODE_ARRAY_KEYS = ("alloc", "requested", "nonzero", "pod_count",
+                        "allowed_pods", "schedulable", "mem_pressure",
+                        "disk_pressure", "labels", "taints_sched",
+                        "taints_pref", "port_bitmap", "valid")
+
+    def _nodes_on_device(self):
+        """Incremental host->HBM sync: re-upload an array only when its shape
+        changed or the snapshot marked it dirty. Steady-state rounds move only
+        requested/nonzero/pod_count (~KBs), not the 40MB+ full snapshot."""
+        snap = self.snapshot
+        if self._device_nodes is None:
+            self._device_nodes = {}
+        for k in self._NODE_ARRAY_KEYS:
+            host = getattr(snap, k)
+            cur = self._device_nodes.get(k)
+            if cur is None or cur.shape != host.shape or k in snap.dirty:
+                self._device_nodes[k] = jnp.asarray(host)
+        snap.dirty.clear()
+        self._device_version = snap.version
+        return self._device_nodes

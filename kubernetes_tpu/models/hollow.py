@@ -1,0 +1,129 @@
+"""Hollow-cluster generator: the kubemark-equivalent load rig.
+
+The reference benchmarks against (a) scheduler_perf's fake nodes/pods
+(test/integration/scheduler_perf/scheduler_test.go:42-68: 4 CPU / 32Gi /
+110-pod nodes, trivial pods) and (b) kubemark hollow nodes
+(cmd/kubemark/hollow-node.go — real kubelet logic, faked externalities).
+This module generates equivalent synthetic clusters and the workload profiles
+of BASELINE.json's five configs, loaded through the apiserver-lite.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import (
+    Node,
+    Pod,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TolerationOperator,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+Mi = 1024 * 1024
+Gi = 1024 * Mi
+
+ZONES = ["zone-a", "zone-b", "zone-c"]
+
+
+def hollow_nodes(n: int, seed: int = 0, heterogeneous: bool = False,
+                 gpu_fraction: float = 0.0, taint_fraction: float = 0.0
+                 ) -> List[Node]:
+    """scheduler_perf node shape by default (scheduler_test.go:49-68)."""
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        if heterogeneous:
+            cpu = rng.choice([2000, 4000, 8000, 16000, 32000])
+            mem = rng.choice([8, 16, 32, 64, 128]) * Gi
+        else:
+            cpu, mem = 4000, 32 * Gi
+        gpu = 8 if rng.random() < gpu_fraction else 0
+        taints = []
+        if gpu and taint_fraction:
+            taints.append(Taint("nvidia.com/gpu", "present", TaintEffect.NO_SCHEDULE))
+        elif rng.random() < taint_fraction:
+            taints.append(Taint("dedicated", "infra", TaintEffect.NO_SCHEDULE))
+        labels = {
+            "kubernetes.io/hostname": f"hollow-node-{i}",
+            "failure-domain.beta.kubernetes.io/zone": ZONES[i % len(ZONES)],
+        }
+        if gpu:
+            labels["accelerator"] = "nvidia"
+        nodes.append(make_node(f"hollow-node-{i}", cpu=cpu, memory=mem, pods=110,
+                               gpu=gpu, labels=labels, taints=taints))
+    return nodes
+
+
+def density_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
+    """Config 1: uniform small pods (the 'nginx' density workload —
+    scheduler_perf creates pods with no requests; we give them the classic
+    100m/500Mi shape so bin-packing is exercised)."""
+    return [make_pod(f"density-{i}", namespace=namespace, cpu=100, memory=500 * Mi)
+            for i in range(n)]
+
+
+def binpack_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
+    """Config 2: mixed-size pods for PodFitsResources + BalancedResourceAllocation."""
+    rng = random.Random(seed)
+    shapes = [(100, 128 * Mi), (250, 512 * Mi), (500, 1 * Gi), (1000, 2 * Gi),
+              (2000, 4 * Gi)]
+    out = []
+    for i in range(n):
+        cpu, mem = rng.choice(shapes)
+        out.append(make_pod(f"binpack-{i}", namespace=namespace, cpu=cpu, memory=mem))
+    return out
+
+
+def affinity_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
+    """Config 3: selector/affinity-heavy (zone spreads via node selectors;
+    inter-pod affinity lands when that kernel arrives)."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        pod = make_pod(f"affinity-{i}", namespace=namespace, cpu=100, memory=256 * Mi,
+                       labels={"app": f"svc-{i % 20}"})
+        if rng.random() < 0.5:
+            pod.node_selector = {
+                "failure-domain.beta.kubernetes.io/zone": rng.choice(ZONES)}
+        out.append(pod)
+    return out
+
+
+def hetero_gpu_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
+    """Config 5: GPU/extended-resource requests + tolerations on 10k
+    heterogeneous nodes."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        if rng.random() < 0.3:
+            pod = make_pod(f"hetero-{i}", namespace=namespace, cpu=1000,
+                           memory=4 * Gi, gpu=rng.choice([1, 2, 4, 8]))
+            pod.tolerations = [Toleration("nvidia.com/gpu",
+                                          TolerationOperator.EXISTS, "", None)]
+        else:
+            pod = make_pod(f"hetero-{i}", namespace=namespace,
+                           cpu=rng.choice([100, 500, 2000]),
+                           memory=rng.choice([256 * Mi, 1 * Gi, 8 * Gi]))
+        out.append(pod)
+    return out
+
+
+PROFILES = {
+    "density": density_pods,
+    "binpack": binpack_pods,
+    "affinity": affinity_pods,
+    "hetero": hetero_gpu_pods,
+}
+
+
+def load_cluster(api: ApiServerLite, nodes: List[Node], pods: List[Pod]) -> None:
+    for node in nodes:
+        api.create("Node", node)
+    for pod in pods:
+        api.create("Pod", pod)

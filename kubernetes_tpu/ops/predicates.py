@@ -66,6 +66,7 @@ def pod_arrays(batch) -> Arrays:
         "req": jnp.asarray(batch.req),
         "nonzero": jnp.asarray(batch.nonzero),
         "zero_req": jnp.asarray(batch.zero_req),
+        "impossible": jnp.asarray(batch.impossible),
         "best_effort": jnp.asarray(batch.best_effort),
         "ports": jnp.asarray(batch.ports),
         "intolerated": jnp.asarray(batch.intolerated),
@@ -206,6 +207,7 @@ def static_fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
         & taints_fit(pods["intolerated"], nodes["taints_sched"])
         & host_fit(pods["has_host"], pods["host_required"], n)
         & node_condition_fit(pods, nodes)
+        & ~pods["impossible"][:, None]  # ext resource no node advertises
     )
 
 

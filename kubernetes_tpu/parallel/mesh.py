@@ -1,0 +1,57 @@
+"""Device-mesh sharding for the scheduler kernels.
+
+The scale axis of the reference is cluster size x pending-queue depth
+(SURVEY.md §5.7); here that becomes tensor sharding over a 1-D "nodes" mesh:
+every node-indexed array (labels, taints, alloc, requested, port bitmaps...)
+is sharded along axis 0 across devices, pod-side arrays are replicated, and
+XLA inserts the collectives (max/argmin reductions over the node axis ride
+the ICI ring) — the pjit recipe: pick a mesh, annotate shardings, let the
+compiler do the communication. This replaces the reference's
+workqueue.Parallelize(16, nodes) fan-out (generic_scheduler.go:204,352) with
+true SPMD over chips.
+
+The sequential placement scan works unchanged under these shardings: the
+per-step dyn-fit/score math is elementwise over N (local to each shard), the
+argmax/min reductions become cross-device collectives, and the capacity
+commit is a scatter into the owning shard.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Arrays = Dict[str, jax.Array]
+
+NODE_AXIS = "nodes"
+
+# node-side arrays sharded along the node axis; everything else replicated
+_NODE_SHARDED_KEYS = frozenset({
+    "alloc", "requested", "nonzero", "pod_count", "allowed_pods",
+    "schedulable", "mem_pressure", "disk_pressure", "labels", "taints_sched",
+    "taints_pref", "port_bitmap", "valid",
+})
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (NODE_AXIS,))
+
+
+def shard_nodes(nodes: Arrays, mesh: Mesh) -> Arrays:
+    """Place node-side arrays sharded along axis 0 of the mesh."""
+    out = {}
+    for k, v in nodes.items():
+        spec = P(NODE_AXIS) if k in _NODE_SHARDED_KEYS else P()
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+def replicate(pods: Arrays, mesh: Mesh) -> Arrays:
+    sh = NamedSharding(mesh, P())
+    return {k: jax.device_put(v, sh) for k, v in pods.items()}

@@ -1,0 +1,176 @@
+"""apiserver-lite: in-process object store with resourceVersion CAS + watch.
+
+The benchmark-grade stand-in for kube-apiserver+etcd, mirroring what the
+reference's integration tier does with its in-process master
+(test/integration/scheduler_perf/util.go:47 mustSetupScheduler). Semantics
+kept from the real storage stack:
+
+- monotonically increasing resourceVersion assigned on every write
+  (staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go).
+- optimistic concurrency: update with expect_rv mismatching -> Conflict,
+  like GuaranteedUpdate's CAS loop (etcd3/store.go:257).
+- watch: every write appends to an event log; watchers consume from a cursor.
+  A bounded log means a too-slow watcher gets TooOldResourceVersion and must
+  relist — the etcd compaction / watch-cache-eviction behavior
+  (storage/cacher.go; apimachinery watch semantics).
+- the pods/<name>/binding subresource sets spec.nodeName atomically and
+  refuses double-binding (pkg/registry/core/pod/storage/storage.go:128
+  BindingREST -> pod strategy's "pod X is already assigned to node Y").
+
+Thread-safe; watchers may block with a timeout (condition variable).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Binding, Node, Pod
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class TooOldResourceVersion(Exception):
+    """Watcher fell behind the bounded event log; relist and re-watch."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    obj: Any
+    rv: int
+
+
+_KEY = Tuple[str, str, str]  # kind, namespace, name
+
+
+def _meta(obj: Any) -> Tuple[str, str]:
+    ns = getattr(obj, "namespace", "")
+    return ns, obj.name
+
+
+class ApiServerLite:
+    def __init__(self, max_log: int = 200_000):
+        self._lock = threading.Condition()
+        self._objects: Dict[_KEY, Any] = {}
+        self._rv = 0
+        self._log: List[WatchEvent] = []
+        self._log_start_rv = 0  # rv of the first retained event
+        self._max_log = max_log
+
+    # ------------------------------------------------------------------ CRUD
+
+    def create(self, kind: str, obj: Any) -> int:
+        with self._lock:
+            key = (kind, *_meta(obj))
+            if key in self._objects:
+                raise Conflict(f"{key} already exists")
+            self._rv += 1
+            obj.resource_version = self._rv
+            self._objects[key] = obj
+            self._append(WatchEvent("ADDED", kind, obj, self._rv))
+            return self._rv
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            try:
+                return self._objects[(kind, namespace, name)]
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+
+    def list(self, kind: str) -> Tuple[List[Any], int]:
+        """Returns (objects, resourceVersion-at-list-time) — the reflector's
+        List+Watch handshake (client-go/tools/cache/reflector.go)."""
+        with self._lock:
+            objs = [o for (k, _, _), o in self._objects.items() if k == kind]
+            return objs, self._rv
+
+    def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> int:
+        with self._lock:
+            key = (kind, *_meta(obj))
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(str(key))
+            if expect_rv is not None and cur.resource_version != expect_rv:
+                raise Conflict(
+                    f"{key}: rv {expect_rv} != current {cur.resource_version}")
+            self._rv += 1
+            obj.resource_version = self._rv
+            self._objects[key] = obj
+            self._append(WatchEvent("MODIFIED", kind, obj, self._rv))
+            return self._rv
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                raise NotFound(str(key))
+            self._rv += 1
+            self._append(WatchEvent("DELETED", kind, obj, self._rv))
+
+    # ------------------------------------------------------------- binding
+
+    def bind(self, binding: Binding) -> int:
+        """The /binding subresource (BindingREST, storage.go:128)."""
+        with self._lock:
+            key = ("Pod", binding.pod_namespace, binding.pod_name)
+            pod: Optional[Pod] = self._objects.get(key)
+            if pod is None:
+                raise NotFound(f"pod {binding.pod_namespace}/{binding.pod_name}")
+            if pod.node_name:
+                raise Conflict(
+                    f"pod {pod.key()} is already assigned to node {pod.node_name}")
+            new = dataclasses.replace(pod, node_name=binding.node_name)
+            self._rv += 1
+            new.resource_version = self._rv
+            self._objects[key] = new
+            self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
+            return self._rv
+
+    # --------------------------------------------------------------- watch
+
+    def watch_since(self, kinds: Tuple[str, ...], from_rv: int,
+                    timeout: Optional[float] = None) -> List[WatchEvent]:
+        """All events with rv > from_rv for the given kinds; blocks up to
+        `timeout` when none are available (0/None = non-blocking)."""
+        with self._lock:
+            if from_rv < self._log_start_rv - 1 and from_rv < self._rv:
+                # events the watcher needs may have been compacted away
+                if self._log and self._log[0].rv > from_rv + 1:
+                    raise TooOldResourceVersion(
+                        f"requested rv {from_rv}, log starts at {self._log[0].rv}")
+            evs = self._collect(kinds, from_rv)
+            if not evs and timeout:
+                self._lock.wait(timeout)
+                evs = self._collect(kinds, from_rv)
+            return evs
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # ------------------------------------------------------------ internals
+
+    def _collect(self, kinds: Tuple[str, ...], from_rv: int) -> List[WatchEvent]:
+        # events are appended in rv order — binary-search the start
+        import bisect
+        lo = bisect.bisect_right(self._log, from_rv, key=lambda e: e.rv)
+        return [e for e in self._log[lo:] if e.kind in kinds]
+
+    def _append(self, ev: WatchEvent) -> None:
+        self._log.append(ev)
+        if len(self._log) > self._max_log:
+            drop = len(self._log) - self._max_log
+            self._log = self._log[drop:]
+            self._log_start_rv = self._log[0].rv
+        self._lock.notify_all()

@@ -1,0 +1,312 @@
+"""Scheduler-extender HTTP sidecar: the integration seam into a real
+kube-scheduler.
+
+Implements the reference's extender wire contract verbatim so an unmodified
+kube-scheduler with `--policy-config-file` pointing at an ExtenderConfig
+(api/types.go:129) offloads findNodesThatFit / PrioritizeNodes here
+(generic_scheduler.go:211-228,381-399 -> core/extender.go:100 Filter,
+:157 Prioritize, :199 Bind, :226 send):
+
+  POST {prefix}/filter      ExtenderArgs -> ExtenderFilterResult
+  POST {prefix}/prioritize  ExtenderArgs -> HostPriorityList
+  POST {prefix}/bind        ExtenderBindingArgs -> ExtenderBindingResult
+  GET  /healthz, /metrics
+
+JSON keys: the reference posts the *internal* structs (no json tags ->
+capitalized keys: "Pod", "Nodes", "NodeNames"); Go's json.Unmarshal is
+case-insensitive, so we accept either case and respond capitalized.
+
+nodeCacheCapable mode (extender.go:113-124): only candidate node NAMES cross
+the wire; the sidecar keeps full node/pod state in its own cache, synced via
+the bulk endpoints POST /cache/nodes and /cache/pods (the "snapshot POSTs"
+variant of SURVEY.md §7 step 3) and updated optimistically by bind calls.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from kubernetes_tpu.api import serde
+from kubernetes_tpu.api.types import Node, Pod
+
+
+class ExtenderBackend(Protocol):
+    def filter(self, pod: Pod, nodes: Optional[List[Node]],
+               node_names: Optional[List[str]]
+               ) -> Tuple[List[str], Dict[str, str]]: ...
+
+    def prioritize(self, pod: Pod, nodes: Optional[List[Node]],
+                   node_names: Optional[List[str]]
+                   ) -> List[Tuple[str, int]]: ...
+
+    def bind(self, pod_name: str, pod_namespace: str, pod_uid: str,
+             node: str) -> str: ...
+
+    def sync_nodes(self, nodes: List[Node]) -> None: ...
+
+    def sync_pods(self, pods: List[Pod]) -> None: ...
+
+    def metrics_text(self) -> str: ...
+
+
+class ExtenderHTTPServer:
+    def __init__(self, backend: ExtenderBackend, host: str = "127.0.0.1",
+                 port: int = 0, prefix: str = ""):
+        self.backend = backend
+        self.prefix = prefix.rstrip("/")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _read_json(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b"{}"
+                return json.loads(raw or b"{}")
+
+            def _write_json(self, obj, code: int = 200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/metrics":
+                    body = outer.backend.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._write_json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                path = self.path
+                if outer.prefix and path.startswith(outer.prefix):
+                    path = path[len(outer.prefix):]
+                try:
+                    payload = self._read_json()
+                    if path == "/filter":
+                        self._write_json(outer.handle_filter(payload))
+                    elif path == "/prioritize":
+                        self._write_json(outer.handle_prioritize(payload))
+                    elif path == "/bind":
+                        self._write_json(outer.handle_bind(payload))
+                    elif path == "/cache/nodes":
+                        outer.backend.sync_nodes(
+                            [serde.decode_node(n) for n in payload.get("items", [])])
+                        self._write_json({"synced": len(payload.get("items", []))})
+                    elif path == "/cache/pods":
+                        outer.backend.sync_pods(
+                            [serde.decode_pod(p) for p in payload.get("items", [])])
+                        self._write_json({"synced": len(payload.get("items", []))})
+                    else:
+                        self._write_json({"error": f"unknown path {self.path}"}, 404)
+                except Exception as e:  # wire errors surface in-band, like the
+                    # reference's ExtenderFilterResult.Error (types.go:177)
+                    self._write_json({"Error": f"{type(e).__name__}: {e}"}, 500)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------------- handlers
+
+    @staticmethod
+    def _get(payload: Dict, *names):
+        for n in names:
+            if n in payload:
+                return payload[n]
+        return None
+
+    def _parse_args(self, payload: Dict) -> Tuple[Pod, Optional[List[Node]],
+                                                  Optional[List[str]]]:
+        pod_obj = self._get(payload, "Pod", "pod") or {}
+        pod = serde.decode_pod(pod_obj)
+        nodes_obj = self._get(payload, "Nodes", "nodes")
+        nodes = None
+        if nodes_obj:
+            nodes = [serde.decode_node(n)
+                     for n in (nodes_obj.get("Items")
+                               or nodes_obj.get("items") or [])]
+        names = self._get(payload, "NodeNames", "nodenames", "nodeNames")
+        return pod, nodes, names
+
+    def handle_filter(self, payload: Dict) -> Dict:
+        pod, nodes, names = self._parse_args(payload)
+        passed, failed = self.backend.filter(pod, nodes, names)
+        if nodes is not None:
+            by_name = {n.name: n for n in nodes}
+            return {
+                "Nodes": {"Items": [serde.encode_node(by_name[nm])
+                                    for nm in passed if nm in by_name]},
+                "FailedNodes": failed,
+                "Error": "",
+            }
+        return {"NodeNames": passed, "FailedNodes": failed, "Error": ""}
+
+    def handle_prioritize(self, payload: Dict) -> List[Dict]:
+        pod, nodes, names = self._parse_args(payload)
+        scores = self.backend.prioritize(pod, nodes, names)
+        return [{"Host": h, "Score": int(s)} for h, s in scores]
+
+    def handle_bind(self, payload: Dict) -> Dict:
+        err = self.backend.bind(
+            self._get(payload, "PodName", "podName") or "",
+            self._get(payload, "PodNamespace", "podNamespace") or "",
+            str(self._get(payload, "PodUID", "podUID") or ""),
+            self._get(payload, "Node", "node") or "")
+        return {"Error": err}
+
+    # ------------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class TPUExtenderBackend:
+    """The TPU-offload backend: sidecar-owned SchedulerCache + fused kernels.
+
+    Filter/prioritize evaluate the pod against the sidecar's cached cluster
+    state (or against the Nodes shipped in the args when not cache-capable),
+    restricted to the candidate set the scheduler sent — exactly the
+    contract of extender.go:100-198. Bind assumes into the local cache and
+    delegates the apiserver write to `binder` (None = extender not configured
+    with BindVerb)."""
+
+    def __init__(self, binder=None):
+        # jax-dependent imports are local so the wire layer stays importable
+        # without a TPU runtime
+        from kubernetes_tpu.state.cache import SchedulerCache
+        from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+        from kubernetes_tpu.utils.metrics import SchedulerMetrics
+
+        self.cache = SchedulerCache()
+        self.engine = SchedulingEngine(self.cache)
+        self.metrics = SchedulerMetrics()
+        self.binder = binder
+        self._known_pods: Dict[str, Pod] = {}
+
+    # -- cache sync ---------------------------------------------------------
+
+    def sync_nodes(self, nodes: List[Node]) -> None:
+        seen = set()
+        for n in nodes:
+            self.cache.update_node(n)
+            seen.add(n.name)
+        for name in list(self.cache.node_infos().keys()):
+            if name not in seen:
+                self.cache.remove_node(name)
+
+    def sync_pods(self, pods: List[Pod]) -> None:
+        seen = set()
+        for p in pods:
+            if not p.node_name:
+                continue
+            seen.add(p.key())
+            prev = self._known_pods.get(p.key())
+            if prev is None:
+                self.cache.add_pod(p)
+            else:
+                self.cache.update_pod(prev, p)
+            self._known_pods[p.key()] = p
+        # full-state semantics, like sync_nodes: pods absent from the
+        # snapshot were deleted — release their capacity
+        for key in list(self._known_pods):
+            if key not in seen:
+                self.cache.remove_pod(self._known_pods.pop(key))
+
+    # -- extender verbs -----------------------------------------------------
+
+    def _eval(self, pod: Pod, nodes: Optional[List[Node]]):
+        import numpy as np
+        from kubernetes_tpu.ops.predicates import fits_jit, node_arrays, pod_arrays
+        from kubernetes_tpu.ops import priorities as prio
+        from kubernetes_tpu.state.snapshot import ClusterSnapshot, PodBatch
+
+        if nodes is not None:
+            # non-cache-capable: full node state ships in every request, so
+            # evaluate against a FRESH snapshot — reusing the persistent one
+            # would diff generation counters of unrelated NodeInfo objects
+            # and silently serve stale rows
+            from kubernetes_tpu.state.node_info import node_info_map
+            infos = node_info_map(nodes, [p for p in self._known_pods.values()])
+            snap = ClusterSnapshot()
+            snap.refresh(infos)
+        else:
+            snap = self.engine.snapshot
+            snap.refresh(self.cache.node_infos())
+        batch = PodBatch([pod], snap)
+        narr = node_arrays(snap)
+        parr = pod_arrays(batch)
+        m = np.asarray(fits_jit(parr, narr))[0]
+        s = np.asarray(prio.score(parr, narr, self.engine.priorities))[0]
+        return snap, m, s
+
+    def filter(self, pod, nodes, node_names):
+        snap, m, _ = self._eval(pod, nodes)
+        candidates = node_names if node_names is not None else \
+            [n.name for n in nodes] if nodes is not None else snap.node_names
+        passed, failed = [], {}
+        for nm in candidates:
+            i = snap.node_index.get(nm, -1)
+            if i >= 0 and m[i]:
+                passed.append(nm)
+            else:
+                failed[nm] = "node(s) didn't satisfy TPU predicate kernel"
+        return passed, failed
+
+    def prioritize(self, pod, nodes, node_names):
+        snap, _, s = self._eval(pod, nodes)
+        candidates = node_names if node_names is not None else \
+            [n.name for n in nodes] if nodes is not None else snap.node_names
+        return [(nm, int(s[snap.node_index[nm]]))
+                for nm in candidates if nm in snap.node_index]
+
+    def bind(self, pod_name, pod_namespace, pod_uid, node):
+        import dataclasses
+        key = f"{pod_namespace}/{pod_name}"
+        pod = self._known_pods.get(key)
+        if pod is None:
+            pod = Pod(name=pod_name, namespace=pod_namespace, uid=pod_uid)
+        pod = dataclasses.replace(pod, node_name=node)
+        try:
+            self.cache.assume_pod(pod)
+            self.cache.finish_binding(pod)
+        except KeyError:
+            pass  # already known
+        if self.binder is not None:
+            try:
+                self.binder(pod_name, pod_namespace, pod_uid, node)
+            except Exception as e:
+                self.cache.forget_pod(pod)
+                return str(e)
+        return ""
+
+    def metrics_text(self) -> str:
+        return self.metrics.render()

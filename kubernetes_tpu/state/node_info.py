@@ -27,6 +27,8 @@ class NodeInfo:
         "nonzero_mem",
         "used_ports",
         "generation",
+        "spec_generation",
+        "ports_generation",
     )
 
     def __init__(self, node: Optional[Node] = None):
@@ -36,7 +38,13 @@ class NodeInfo:
         self.nonzero_cpu = 0
         self.nonzero_mem = 0
         self.used_ports: Set[int] = set()
+        # generation: any mutation; spec_generation: node object (labels,
+        # taints, allocatable, conditions) changed; ports_generation: the
+        # used-ports set changed. The snapshot diffs each independently so a
+        # plain pod add/remove only rewrites the small dynamic arrays.
         self.generation = 0
+        self.spec_generation = 0
+        self.ports_generation = 0
 
     # -- mutation (mirrors node_info.go addPod:302 / removePod:330) ---------
 
@@ -46,7 +54,10 @@ class NodeInfo:
         ncpu, nmem = pod.nonzero_request()
         self.nonzero_cpu += ncpu
         self.nonzero_mem += nmem
-        self.used_ports.update(pod.used_ports())
+        ports = pod.used_ports()
+        if ports:
+            self.used_ports.update(ports)
+            self.ports_generation += 1
         self.pods.append(pod)
         self.generation += 1
 
@@ -60,11 +71,13 @@ class NodeInfo:
                 ncpu, nmem = p.nonzero_request()
                 self.nonzero_cpu -= ncpu
                 self.nonzero_mem -= nmem
-                # rebuild ports (another pod may still hold the same port —
-                # the reference keeps a map and re-adds; rebuilding is exact)
-                self.used_ports = set()
-                for q in self.pods:
-                    self.used_ports.update(q.used_ports())
+                if p.used_ports():
+                    # rebuild ports (another pod may still hold the same port —
+                    # the reference keeps a map and re-adds; rebuilding is exact)
+                    self.used_ports = set()
+                    for q in self.pods:
+                        self.used_ports.update(q.used_ports())
+                    self.ports_generation += 1
                 self.generation += 1
                 return True
         return False
@@ -72,6 +85,7 @@ class NodeInfo:
     def set_node(self, node: Node) -> None:
         self.node = node
         self.generation += 1
+        self.spec_generation += 1
 
     def allocatable(self) -> Resource:
         return self.node.allocatable if self.node else Resource()
@@ -87,6 +101,8 @@ class NodeInfo:
         out.nonzero_mem = self.nonzero_mem
         out.used_ports = set(self.used_ports)
         out.generation = self.generation
+        out.spec_generation = self.spec_generation
+        out.ports_generation = self.ports_generation
         return out
 
 

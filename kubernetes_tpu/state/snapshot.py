@@ -115,7 +115,23 @@ class ClusterSnapshot:
       taints_pref  int8  [N, T]   PreferNoSchedule taints (priority only)
       port_bitmap  uint32 [N, 2048]
       valid        bool  [N]      real node (False for padding rows)
+
+    The label-pair vocabulary is DEMAND-driven: only pairs some pod selector
+    references get columns (interned via ensure_* during PodBatch compile).
+    Node-unique labels like kubernetes.io/hostname therefore cost nothing
+    unless selected on — without this, L scales with cluster size and the
+    selector tensors dominate host->HBM transfer. Exactness is preserved:
+    a pair no selector mentions can never affect a match verdict.
+
+    `dirty` names the arrays whose host copy changed since the consumer
+    (engine) last uploaded — pod add/remove touches only requested/nonzero/
+    pod_count (+port_bitmap when the pod has host ports), so steady-state
+    rounds re-upload ~KBs, not the full snapshot.
     """
+
+    DYNAMIC = ("requested", "nonzero", "pod_count")
+    STATIC = ("alloc", "allowed_pods", "schedulable", "mem_pressure",
+              "disk_pressure", "labels", "taints_sched", "taints_pref", "valid")
 
     def __init__(self, mem_shift: int = 10, node_pad: int = 8):
         self.mem_shift = mem_shift
@@ -125,9 +141,14 @@ class ClusterSnapshot:
         self.ext_vocab = Vocab()  # extended resource names
         self.node_names: List[str] = []
         self.node_index: Dict[str, int] = {}
-        self._generations: Dict[str, int] = {}
+        self._generations: Dict[str, Tuple[int, int, int]] = {}
         self._shape_sig: Optional[Tuple[int, int, int, int]] = None
         self.version = 0  # bumped on any array change (device cache key)
+        self.dirty: set = set()
+        self._label_index: Dict[str, set] = {}  # key -> values across nodes
+        self._row_labels: List[Dict[str, str]] = []  # per-row node label maps
+        self._labels_width = _pad(0)
+        self._vocab_dirty = False
         # arrays created on first refresh
         self.alloc: np.ndarray
         self.requested: np.ndarray
@@ -155,7 +176,13 @@ class ClusterSnapshot:
 
     def resource_row(self, *, milli_cpu: int, memory: int, gpu: int, scratch: int,
                      overlay: int, extended: Dict[str, int], up: bool,
-                     width: int) -> np.ndarray:
+                     width: int, unknown: Optional[List[str]] = None) -> np.ndarray:
+        """Encode one resource vector. The ext vocab is CLOSED here — refresh()
+        interns every name visible in node allocatable/requested before the
+        arrays are shaped. A name still unknown (only possible for a pending
+        pod requesting a resource no node advertises) is appended to `unknown`
+        so the caller can mark the pod impossible-to-place instead of
+        overflowing the padded width."""
         row = np.zeros(width, dtype=np.int32)
         row[R_CPU] = milli_cpu
         row[R_MEM] = self.quant_mem(memory, up)
@@ -163,38 +190,98 @@ class ClusterSnapshot:
         row[R_SCRATCH] = self.quant_mem(scratch, up)
         row[R_OVERLAY] = self.quant_mem(overlay, up)
         for name, q in extended.items():
-            row[NUM_BASE_RESOURCES + self.ext_vocab.add(name, "")] = q
+            idx = self.ext_vocab.get(name, "")
+            if idx < 0:
+                if unknown is None:
+                    raise KeyError(
+                        f"extended resource {name!r} missing from vocab — "
+                        "refresh() must intern node-side names first")
+                unknown.append(name)
+                continue
+            row[NUM_BASE_RESOURCES + idx] = q
         return row
+
+    def ensure_label_pair(self, key: str, value: str) -> int:
+        """Intern a selector-referenced pair; marks the label matrix stale
+        when the vocab grows."""
+        before = len(self.label_vocab)
+        idx = self.label_vocab.add(key, value)
+        if len(self.label_vocab) != before:
+            self._vocab_dirty = True
+        return idx
+
+    def node_values_for_key(self, key: str):
+        """Values present for `key` across current nodes (for Exists/Gt/Lt/
+        DoesNotExist expansion)."""
+        return self._label_index.get(key, ())
+
+    def finalize_labels(self) -> int:
+        """Rebuild the [N, L] label matrix if the vocab grew (called by
+        PodBatch after selector compilation). Returns the padded width L."""
+        want = _pad(len(self.label_vocab))
+        if self._vocab_dirty or want != self._labels_width:
+            self._labels_width = want
+            n = self.alloc.shape[0] if self._shape_sig else 0
+            self.labels = np.zeros((n, want), dtype=np.int8)
+            for i, lbls in enumerate(self._row_labels):
+                self._write_label_row(i, lbls)
+            self._vocab_dirty = False
+            self.dirty.add("labels")
+            self.version += 1
+            if self._shape_sig is not None:
+                # keep the shape signature in sync so the next refresh()
+                # doesn't mistake the widened label axis for a rebuild
+                n, _, t, r = self._shape_sig
+                self._shape_sig = (n, want, t, r)
+        return self._labels_width
 
     def refresh(self, infos: Dict[str, NodeInfo]) -> bool:
         """Sync arrays with the cache. Returns True on full rebuild (shape or
         membership change), False for in-place delta."""
-        # Intern everything first so vocab sizes are final before shaping.
+        # taint / extended-resource vocabs are node-driven (small by nature)
         for info in infos.values():
             node = info.node
             if node is None:
                 continue
-            for k, v in node.labels.items():
-                self.label_vocab.add(k, v)
             for t in node.taints:
-                self.taint_vocab.add(t.key, t.value + "\x00" + str(t.effect.value if isinstance(t.effect, TaintEffect) else t.effect))
+                eff = t.effect.value if isinstance(t.effect, TaintEffect) else t.effect
+                self.taint_vocab.add(t.key, t.value + "\x00" + str(eff))
             for name in node.allocatable.extended:
+                self.ext_vocab.add(name, "")
+        for info in infos.values():
+            # bound/assumed pods may request ext resources their node doesn't
+            # advertise; intern those too so _write_dynamic_row can't overflow
+            for name in info.requested.extended:
                 self.ext_vocab.add(name, "")
 
         names = sorted(infos.keys())
         n_pad = _pad(len(names), self.node_pad)
-        sig = (n_pad, _pad(len(self.label_vocab)), _pad(len(self.taint_vocab)),
+        sig = (n_pad, self._labels_width, _pad(len(self.taint_vocab)),
                self.num_resources)
         rebuild = sig != self._shape_sig or names != self.node_names
         if rebuild:
             self._allocate(names, sig)
+            self._label_index = {}
+            self._row_labels = [{} for _ in range(n_pad)]
             changed = names
         else:
             changed = [nm for nm in names
-                       if infos[nm].generation != self._generations.get(nm, -1)]
+                       if infos[nm].generation != self._generations.get(nm, (-1,))[0]]
+        label_index_stale = rebuild
         for nm in changed:
-            self._write_row(self.node_index[nm], infos[nm])
-            self._generations[nm] = infos[nm].generation
+            i = self.node_index[nm]
+            info = infos[nm]
+            prev = self._generations.get(nm, (-1, -1, -1))
+            self._write_dynamic_row(i, info)
+            if rebuild or info.spec_generation != prev[1]:
+                self._write_static_row(i, info)
+                label_index_stale = True
+            if rebuild or info.ports_generation != prev[2]:
+                self._write_ports_row(i, info)
+            self._generations[nm] = (info.generation, info.spec_generation,
+                                     info.ports_generation)
+        if label_index_stale:
+            self._rebuild_label_index(infos, names)
         if changed or rebuild:
             self.version += 1
         return rebuild
@@ -221,19 +308,11 @@ class ClusterSnapshot:
         self.port_bitmap = np.zeros((n, PORT_WORDS), dtype=np.uint32)
         self.valid = np.zeros(n, dtype=bool)
         self.valid[: len(names)] = True
+        self.dirty = {"requested", "nonzero", "pod_count", "port_bitmap",
+                      *self.STATIC}
 
-    def _write_row(self, i: int, info: NodeInfo) -> None:
-        node = info.node
+    def _write_dynamic_row(self, i: int, info: NodeInfo) -> None:
         r = self.num_resources
-        if node is None:
-            self.schedulable[i] = False
-            self.valid[i] = False
-            return
-        self.alloc[i] = self.resource_row(
-            milli_cpu=node.allocatable.milli_cpu, memory=node.allocatable.memory,
-            gpu=node.allocatable.nvidia_gpu, scratch=node.allocatable.storage_scratch,
-            overlay=node.allocatable.storage_overlay,
-            extended=node.allocatable.extended, up=False, width=r)
         self.requested[i] = self.resource_row(
             milli_cpu=info.requested.milli_cpu, memory=info.requested.memory,
             gpu=info.requested.nvidia_gpu, scratch=info.requested.storage_scratch,
@@ -242,16 +321,28 @@ class ClusterSnapshot:
         self.nonzero[i, 0] = info.nonzero_cpu
         self.nonzero[i, 1] = self.quant_mem(info.nonzero_mem, up=True)
         self.pod_count[i] = len(info.pods)
+        self.dirty.update(self.DYNAMIC)
+
+    def _write_static_row(self, i: int, info: NodeInfo) -> None:
+        node = info.node
+        r = self.num_resources
+        if node is None:
+            self.schedulable[i] = False
+            self.valid[i] = False
+            self.dirty.update(("schedulable", "valid"))
+            return
+        self.alloc[i] = self.resource_row(
+            milli_cpu=node.allocatable.milli_cpu, memory=node.allocatable.memory,
+            gpu=node.allocatable.nvidia_gpu, scratch=node.allocatable.storage_scratch,
+            overlay=node.allocatable.storage_overlay,
+            extended=node.allocatable.extended, up=False, width=r)
         self.allowed_pods[i] = node.allowed_pod_number
         self.schedulable[i] = node.is_ready()
         self.mem_pressure[i] = node.condition("MemoryPressure") == ConditionStatus.TRUE
         self.disk_pressure[i] = node.condition("DiskPressure") == ConditionStatus.TRUE
         self.valid[i] = True
-
-        lbl = np.zeros(self.labels.shape[1], dtype=np.int8)
-        for k, v in node.labels.items():
-            lbl[self.label_vocab.get(k, v)] = 1
-        self.labels[i] = lbl
+        self._row_labels[i] = node.labels
+        self._write_label_row(i, node.labels)
 
         ts = np.zeros(self.taints_sched.shape[1], dtype=np.int8)
         tp = np.zeros_like(ts)
@@ -264,12 +355,34 @@ class ClusterSnapshot:
                 tp[idx] = 1
         self.taints_sched[i] = ts
         self.taints_pref[i] = tp
+        self.dirty.update(self.STATIC)
 
+    def _write_label_row(self, i: int, labels: Dict[str, str]) -> None:
+        lbl = np.zeros(self.labels.shape[1], dtype=np.int8)
+        for k, v in labels.items():
+            idx = self.label_vocab.get(k, v)
+            if idx >= 0:
+                lbl[idx] = 1
+        self.labels[i] = lbl
+
+    def _write_ports_row(self, i: int, info: NodeInfo) -> None:
         bm = np.zeros(PORT_WORDS, dtype=np.uint32)
         for port in info.used_ports:
             if 0 < port < PORT_SPACE:
                 bm[port // 32] |= np.uint32(1 << (port % 32))
         self.port_bitmap[i] = bm
+        self.dirty.add("port_bitmap")
+
+    def _rebuild_label_index(self, infos: Dict[str, NodeInfo],
+                             names: List[str]) -> None:
+        idx: Dict[str, set] = {}
+        for nm in names:
+            node = infos[nm].node
+            if node is None:
+                continue
+            for k, v in node.labels.items():
+                idx.setdefault(k, set()).add(v)
+        self._label_index = idx
 
 
 # ---------------------------------------------------------------------------
@@ -303,12 +416,14 @@ class PodBatch:
         P = len(self.pods)
         if snap._shape_sig is None:
             raise RuntimeError("ClusterSnapshot.refresh() must run before PodBatch")
-        L = snap.labels.shape[1]
         T = snap.taints_sched.shape[1]
         Rr = snap.num_resources
         self.req = np.zeros((P, Rr), dtype=np.int32)
         self.nonzero = np.zeros((P, 2), dtype=np.int32)
         self.zero_req = np.zeros(P, dtype=bool)
+        # pod requests an extended resource NO node advertises -> can never
+        # fit anywhere (alloc 0 < request on every node)
+        self.impossible = np.zeros(P, dtype=bool)
         self.best_effort = np.zeros(P, dtype=bool)
         self.ports = np.full((P, MAX_PORTS_PER_POD), -1, dtype=np.int32)
         self.intolerated = np.ones((P, T), dtype=np.int8)  # sched-taints NOT tolerated
@@ -317,7 +432,9 @@ class PodBatch:
         self.has_host = np.zeros(P, dtype=bool)
         self.needs_host_check = np.zeros(P, dtype=bool)
 
-        # selector structures — sized by actual usage, min 1 term
+        # selector structures — sized by actual usage, min 1 term. Compiling
+        # interns referenced label pairs into the snapshot's demand-driven
+        # vocab, so the label matrix is finalized only afterwards.
         n_terms = 1
         n_any = 1
         compiled = []
@@ -329,6 +446,7 @@ class PodBatch:
                 n_any = max(n_any, len(t[1]))
         n_terms = min(n_terms, max_terms)
         n_any = min(n_any, max_any)
+        L = snap.finalize_labels()
         self.sel_req_all = np.zeros((P, n_terms, L), dtype=np.int8)
         self.sel_req_any = np.zeros((P, n_terms, n_any, L), dtype=np.int8)
         self.sel_forbid = np.zeros((P, n_terms, L), dtype=np.int8)
@@ -370,8 +488,9 @@ class PodBatch:
             for r in term.match_expressions:
                 op = SelectorOperator(r.operator)
                 if op == SelectorOperator.IN:
-                    idxs = [snap.label_vocab.get(r.key, v) for v in r.values]
-                    idxs = [i for i in idxs if i >= 0]
+                    # intern every referenced pair; a pair no node carries is
+                    # an all-zero column, so matching fails naturally
+                    idxs = [snap.ensure_label_pair(r.key, v) for v in r.values]
                     if not idxs:
                         unsat = True
                     elif len(idxs) == 1:
@@ -379,18 +498,19 @@ class PodBatch:
                     else:
                         any_groups.append(idxs)
                 elif op == SelectorOperator.EXISTS:
-                    idxs = snap.label_vocab.by_key.get(r.key, [])
-                    if not idxs:
-                        unsat = True
+                    vals = snap.node_values_for_key(r.key)
+                    if not vals:
+                        unsat = True  # no node has the key at snapshot time
                     else:
-                        any_groups.append(list(idxs))
+                        any_groups.append(
+                            [snap.ensure_label_pair(r.key, v) for v in vals])
                 elif op == SelectorOperator.DOES_NOT_EXIST:
-                    forbid.extend(snap.label_vocab.by_key.get(r.key, []))
+                    forbid.extend(snap.ensure_label_pair(r.key, v)
+                                  for v in snap.node_values_for_key(r.key))
                 elif op == SelectorOperator.NOT_IN:
-                    for v in r.values:
-                        i = snap.label_vocab.get(r.key, v)
-                        if i >= 0:
-                            forbid.append(i)
+                    vals = set(snap.node_values_for_key(r.key))
+                    forbid.extend(snap.ensure_label_pair(r.key, v)
+                                  for v in r.values if v in vals)
                 elif op in (SelectorOperator.GT, SelectorOperator.LT):
                     try:
                         rhs = int(r.values[0]) if r.values else None
@@ -400,14 +520,13 @@ class PodBatch:
                         unsat = True
                     else:
                         idxs = []
-                        for i in snap.label_vocab.by_key.get(r.key, []):
-                            _, val = snap.label_vocab.items()[i]
+                        for val in snap.node_values_for_key(r.key):
                             try:
                                 lhs = int(val)
                             except ValueError:
                                 continue
                             if (lhs > rhs) if op == SelectorOperator.GT else (lhs < rhs):
-                                idxs.append(i)
+                                idxs.append(snap.ensure_label_pair(r.key, val))
                         if not idxs:
                             unsat = True
                         else:
@@ -418,10 +537,14 @@ class PodBatch:
     def _encode_pod(self, p: int, pod: Pod, snap: ClusterSnapshot, terms,
                     n_terms: int, n_any: int) -> None:
         req = pod.resource_request()
+        unknown: List[str] = []
         self.req[p] = snap.resource_row(
             milli_cpu=req.milli_cpu, memory=req.memory, gpu=req.nvidia_gpu,
             scratch=req.storage_scratch, overlay=req.storage_overlay,
-            extended=req.extended, up=True, width=snap.num_resources)
+            extended=req.extended, up=True, width=snap.num_resources,
+            unknown=unknown)
+        if unknown:
+            self.impossible[p] = True
         ncpu, nmem = pod.nonzero_request()
         self.nonzero[p, 0] = ncpu
         self.nonzero[p, 1] = snap.quant_mem(nmem, up=True)

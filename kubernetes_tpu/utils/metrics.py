@@ -1,0 +1,119 @@
+"""Scheduler metrics: histograms with the reference's bucket layout.
+
+Mirrors plugin/pkg/scheduler/metrics/metrics.go:31-55 — three latency
+histograms (e2e scheduling, algorithm, binding) with exponential buckets
+1ms..~16s (ExponentialBuckets(1000, 2, 15) microseconds), exported in
+Prometheus text format via render() (scrape endpoint wired in server/).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    out = []
+    v = start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return out
+
+
+# seconds; matches 1000us * 2^k for k in 0..14 (metrics.go:38,46,54)
+DEFAULT_BUCKETS = exponential_buckets(0.001, 2, 15)
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: List[float] = None):
+        self.name = name
+        self.help = help_text
+        self.buckets = list(buckets or DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._values: List[float] = []  # for exact percentiles in benches
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._values.append(v)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._values:
+                return 0.0
+            vs = sorted(self._values)
+            idx = min(int(p / 100.0 * len(vs)), len(vs) - 1)
+            return vs[idx]
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [f"# HELP {self.name} {self.help}",
+                     f"# TYPE {self.name} histogram"]
+            cum = 0
+            for b, c in zip(self.buckets, self._counts):
+                cum += c
+                lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._count}")
+            return "\n".join(lines)
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n{self.name} {self._v}")
+
+
+class SchedulerMetrics:
+    """The scheduler's metric set (metrics.go:31-66)."""
+
+    def __init__(self):
+        self.e2e_latency = Histogram(
+            "scheduler_e2e_scheduling_latency_seconds",
+            "E2e scheduling latency (scheduling algorithm + binding)")
+        self.algorithm_latency = Histogram(
+            "scheduler_scheduling_algorithm_latency_seconds",
+            "Scheduling algorithm latency")
+        self.binding_latency = Histogram(
+            "scheduler_binding_latency_seconds", "Binding latency")
+        self.scheduled = Counter("scheduler_pods_scheduled_total",
+                                 "Pods successfully bound")
+        self.failed = Counter("scheduler_pods_failed_total",
+                              "Pods that failed scheduling")
+
+    def render(self) -> str:
+        return "\n".join(m.render() for m in (
+            self.e2e_latency, self.algorithm_latency, self.binding_latency,
+            self.scheduled, self.failed))

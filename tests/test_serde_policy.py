@@ -1,0 +1,150 @@
+"""Wire-format tests: resource.Quantity parsing, k8s JSON Pod/Node decoding,
+Policy JSON compat (the format guarded upstream by
+plugin/pkg/scheduler/api/compatibility_test.go)."""
+
+from kubernetes_tpu.api import serde
+from kubernetes_tpu.api.policy import parse_policy, PROVIDERS
+from kubernetes_tpu.api.types import SelectorOperator, TaintEffect
+
+
+def test_quantity_parsing():
+    assert serde.quantity_milli("100m") == 100
+    assert serde.quantity_milli("2") == 2000
+    assert serde.quantity_milli("0.5") == 500
+    assert serde.quantity_milli("2500m") == 2500
+    assert serde.quantity_value("128Mi") == 128 * 1024 * 1024
+    assert serde.quantity_value("1Gi") == 1024 ** 3
+    assert serde.quantity_value("1G") == 10 ** 9
+    assert serde.quantity_value("500k") == 500_000
+    assert serde.quantity_value("1.5Gi") == 3 * 1024 ** 3 // 2
+    assert serde.quantity_value("7") == 7
+    # milli rounding is ceil (quantity.go ScaledValue rounds up)
+    assert serde.quantity_milli("1m") == 1
+    assert serde.quantity_value("100m") == 1
+
+
+def test_decode_pod_full():
+    pod = serde.decode_pod({
+        "metadata": {"name": "web-1", "namespace": "prod", "uid": "u-1",
+                     "labels": {"app": "web"},
+                     "ownerReferences": [{"kind": "ReplicaSet", "name": "web",
+                                          "controller": True}]},
+        "spec": {
+            "schedulerName": "default-scheduler",
+            "nodeSelector": {"disk": "ssd"},
+            "containers": [{
+                "name": "c", "image": "nginx:1.13",
+                "resources": {"requests": {"cpu": "250m", "memory": "64Mi",
+                                           "nvidia.com/gpu": "1"}},
+                "ports": [{"hostPort": 8080, "containerPort": 80}],
+            }],
+            "tolerations": [{"key": "dedicated", "operator": "Equal",
+                             "value": "gpu", "effect": "NoSchedule"}],
+            "affinity": {"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        {"key": "zone", "operator": "In", "values": ["a", "b"]}]}]},
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": 5, "preference": {"matchExpressions": [
+                        {"key": "disk", "operator": "Exists"}]}}]}},
+        },
+    })
+    assert pod.key() == "prod/web-1"
+    req = pod.resource_request()
+    assert req.milli_cpu == 250
+    assert req.memory == 64 * 1024 * 1024
+    assert req.nvidia_gpu == 1
+    assert pod.used_ports() == [8080]
+    assert pod.owner_kind == "ReplicaSet"
+    assert pod.tolerations[0].effect == TaintEffect.NO_SCHEDULE
+    na = pod.affinity.node_affinity
+    assert na.required_terms[0].match_expressions[0].operator == SelectorOperator.IN
+    assert na.preferred_terms[0][0] == 5
+
+
+def test_decode_node_full():
+    node = serde.decode_node({
+        "metadata": {"name": "n1", "labels": {"zone": "a"}},
+        "spec": {"unschedulable": False,
+                 "taints": [{"key": "flaky", "value": "", "effect": "NoExecute"}]},
+        "status": {
+            "allocatable": {"cpu": "4", "memory": "32Gi", "pods": "110",
+                            "nvidia.com/gpu": "2", "example.com/foo": "5"},
+            "conditions": [{"type": "Ready", "status": "True"},
+                           {"type": "MemoryPressure", "status": "False"}],
+        },
+    })
+    assert node.allocatable.milli_cpu == 4000
+    assert node.allocatable.memory == 32 * 1024 ** 3
+    assert node.allocatable.nvidia_gpu == 2
+    assert node.allocatable.extended == {"example.com/foo": 5}
+    assert node.allowed_pod_number == 110
+    assert node.taints[0].effect == TaintEffect.NO_EXECUTE
+    assert node.is_ready()
+
+
+def test_encode_decode_roundtrip():
+    from kubernetes_tpu.api.types import make_node, make_pod
+    pod = make_pod("p", cpu=100, memory=1024 ** 3, ports=[80])
+    pod2 = serde.decode_pod(serde.encode_pod(pod))
+    assert pod2.resource_request().milli_cpu == 100
+    assert pod2.used_ports() == [80]
+    node = make_node("n", cpu=4000, gpu=2)
+    node2 = serde.decode_node(serde.encode_node(node))
+    assert node2.allocatable.milli_cpu == 4000
+    assert node2.allocatable.nvidia_gpu == 2
+    assert node2.is_ready()
+
+
+POLICY_JSON = """{
+  "kind": "Policy", "apiVersion": "v1",
+  "predicates": [
+    {"name": "PodFitsResources"},
+    {"name": "TestLabelsPresence",
+     "argument": {"labelsPresence": {"labels": ["foo"], "presence": true}}},
+    {"name": "TestServiceAffinity",
+     "argument": {"serviceAffinity": {"labels": ["region"]}}}
+  ],
+  "priorities": [
+    {"name": "LeastRequestedPriority", "weight": 1},
+    {"name": "TestServiceAntiAffinity", "weight": 3,
+     "argument": {"serviceAntiAffinity": {"label": "zone"}}}
+  ],
+  "extenders": [
+    {"urlPrefix": "http://127.0.0.1:9998/scheduler",
+     "filterVerb": "filter", "prioritizeVerb": "prioritize",
+     "weight": 5, "nodeCacheCapable": true, "enableHttps": false}
+  ]
+}"""
+
+
+def test_policy_parse_reference_format():
+    # shape mirrors the 1.7 Policy files in compatibility_test.go
+    pol = parse_policy(POLICY_JSON)
+    assert [p.name for p in pol.predicates] == [
+        "PodFitsResources", "TestLabelsPresence", "TestServiceAffinity"]
+    assert pol.predicates[1].labels_presence.labels == ["foo"]
+    assert pol.predicates[2].service_affinity.labels == ["region"]
+    assert pol.priorities[0].weight == 1
+    assert pol.priorities[1].service_antiaffinity_label == "zone"
+    ext = pol.extenders[0]
+    assert ext.url_prefix.endswith("/scheduler")
+    assert ext.filter_verb == "filter"
+    assert ext.weight == 5
+    assert ext.node_cache_capable
+    assert ext.http_timeout_s == 5.0
+
+
+def test_policy_empty_sections_distinguish_nil():
+    # nil predicates -> provider defaults; empty list -> no predicates
+    assert parse_policy("{}").predicates is None
+    assert parse_policy('{"predicates": []}').predicates == []
+
+
+def test_providers():
+    dp = PROVIDERS["DefaultProvider"]["priorities"]
+    ca = PROVIDERS["ClusterAutoscalerProvider"]["priorities"]
+    assert ("LeastRequestedPriority", 1) in dp
+    assert ("MostRequestedPriority", 1) in ca
+    assert ("LeastRequestedPriority", 1) not in ca
+    assert ("NodePreferAvoidPodsPriority", 10000) in dp

@@ -1,0 +1,113 @@
+"""Tensorization-layer tests: demand-driven label vocab, generation-diffed
+delta refresh, and per-array dirty tracking (the device-upload contract that
+keeps steady-state rounds at ~KBs of host->HBM traffic)."""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.state.node_info import node_info_map
+from kubernetes_tpu.state.snapshot import ClusterSnapshot, PodBatch
+from tests.helpers import Gi, random_nodes, random_pod
+
+
+def build(n_nodes=24, seed=3):
+    rng = random.Random(seed)
+    nodes = random_nodes(rng, n_nodes)
+    infos = node_info_map(nodes, [])
+    snap = ClusterSnapshot()
+    snap.refresh(infos)
+    return rng, nodes, infos, snap
+
+
+def test_pod_add_marks_only_dynamic_arrays_dirty():
+    rng, nodes, infos, snap = build()
+    PodBatch([random_pod(rng, i, [n.name for n in nodes]) for i in range(30)], snap)
+    snap.dirty.clear()
+    p = make_pod("x", cpu=100, memory=1 * Gi)
+    p.node_name = nodes[0].name
+    infos[nodes[0].name].add_pod(p)
+    assert not snap.refresh(infos)  # delta, not rebuild
+    assert snap.dirty == {"requested", "nonzero", "pod_count"}
+
+
+def test_pod_with_ports_also_dirties_port_bitmap():
+    rng, nodes, infos, snap = build()
+    snap.dirty.clear()
+    p = make_pod("y", ports=[8080])
+    p.node_name = nodes[1].name
+    infos[nodes[1].name].add_pod(p)
+    snap.refresh(infos)
+    assert snap.dirty == {"requested", "nonzero", "pod_count", "port_bitmap"}
+
+
+def test_node_spec_change_dirties_static_arrays():
+    rng, nodes, infos, snap = build()
+    snap.dirty.clear()
+    infos[nodes[2].name].set_node(nodes[2])
+    snap.refresh(infos)
+    assert "labels" in snap.dirty and "alloc" in snap.dirty
+
+
+def test_label_vocab_is_pod_demand_driven():
+    # node-unique labels (hostname-style) must not widen the label matrix
+    nodes = [make_node(f"n{i}", labels={"hostname": f"n{i}", "zone": "a"})
+             for i in range(100)]
+    infos = node_info_map(nodes, [])
+    snap = ClusterSnapshot()
+    snap.refresh(infos)
+    PodBatch([make_pod("p", node_selector={"zone": "a"})], snap)
+    assert snap.labels.shape[1] <= 8  # only 'zone=a' interned (+padding)
+    # selecting a hostname interns exactly that pair and still matches
+    PodBatch([make_pod("q", node_selector={"hostname": "n42"})], snap)
+    assert len(snap.label_vocab) == 2
+
+
+def test_identical_batches_do_not_rebuild_labels():
+    rng, nodes, infos, snap = build()
+    pods = [random_pod(rng, i, [n.name for n in nodes]) for i in range(30)]
+    PodBatch(pods, snap)
+    v0 = snap.version
+    PodBatch(pods, snap)
+    assert snap.version == v0
+
+
+def test_quantization_is_conservative():
+    snap = ClusterSnapshot()
+    infos = node_info_map([make_node("n", memory=1 * Gi + 512)], [])
+    snap.refresh(infos)
+    i = snap.node_index["n"]
+    # allocatable rounds DOWN (can't overcommit via quantization)
+    assert snap.alloc[i, 1] == (1 * Gi + 512) >> 10
+    p = make_pod("p", memory=1023)  # request rounds UP to 1 KiB
+    b = PodBatch([p], snap)
+    assert b.req[0, 1] == 1
+
+
+def test_removed_then_readded_node_membership_rebuild():
+    rng, nodes, infos, snap = build(n_nodes=9)
+    del infos[nodes[0].name]
+    assert snap.refresh(infos)  # membership change -> rebuild
+    assert nodes[0].name not in snap.node_index
+
+
+def test_unknown_extended_resource_marks_pod_impossible():
+    # a pod requesting an ext resource NO node advertises must become
+    # unschedulable, not crash the batch build (padded-vocab overflow)
+    rng, nodes, infos, snap = build(n_nodes=8)
+    pods = [make_pod(f"x{i}", cpu=100,
+                     extended={f"example.com/weird-{i}": 1}) for i in range(6)]
+    b = PodBatch(pods, snap)
+    assert b.impossible.all()
+    sane = PodBatch([make_pod("ok", cpu=100)], snap)
+    assert not sane.impossible.any()
+
+
+def test_bound_pod_with_unknown_extended_resource_interned_on_refresh():
+    rng, nodes, infos, snap = build(n_nodes=8)
+    p = make_pod("b", cpu=100, extended={"example.com/foreign": 2})
+    p.node_name = nodes[0].name
+    infos[nodes[0].name].add_pod(p)
+    snap.refresh(infos)  # must not raise; vocab grows, arrays widen
+    assert snap.ext_vocab.get("example.com/foreign", "") >= 0
