@@ -193,10 +193,12 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
             value=t.get("value", ""),
             effect=TaintEffect(eff) if eff else None,
         ))
-    owner_kind, owner_name = "", ""
+    owner_kind, owner_name, owner_uid = "", "", ""
     for ref in meta.get("ownerReferences") or []:
         if ref.get("controller"):
-            owner_kind, owner_name = ref.get("kind", ""), ref.get("name", "")
+            owner_kind = ref.get("kind", "")
+            owner_name = ref.get("name", "")
+            owner_uid = ref.get("uid", "")
             break
     return Pod(
         name=meta.get("name", ""),
@@ -213,6 +215,8 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
         priority=int(spec.get("priority") or 0),
         owner_kind=owner_kind,
         owner_name=owner_name,
+        owner_uid=owner_uid,
+        deleted=meta.get("deletionTimestamp") is not None,
     )
 
 

@@ -286,8 +286,10 @@ class Pod:
     scheduler_name: str = "default-scheduler"
     priority: int = 0
     resource_version: int = 0
-    owner_kind: str = ""  # for equivalence classes + selector spreading
-    owner_name: str = ""
+    owner_kind: str = ""  # controllerRef: equivalence classes, spreading,
+    owner_name: str = ""  # NodePreferAvoidPods
+    owner_uid: str = ""
+    deleted: bool = False  # DeletionTimestamp != nil (spreading skips these)
 
     def key(self) -> str:
         return self.namespace + "/" + self.name
@@ -399,6 +401,33 @@ class Node:
 # ---------------------------------------------------------------------------
 # Binding / events
 # ---------------------------------------------------------------------------
+
+
+@dataclass
+class WorkloadObject:
+    """Owner-ish object for SelectorSpreadPriority / ServiceAffinity: a
+    Service, ReplicationController, ReplicaSet or StatefulSet reduced to the
+    fields the scheduler reads — a namespaced label selector
+    (reference: selector_spreading.go:59-85 getSelectors; algorithm listers
+    GetPodServices/GetPodControllers/GetPodReplicaSets/GetPodStatefulSets).
+    Services/RCs use map-equality selectors; RS/SS use LabelSelector."""
+
+    kind: str  # Service | ReplicationController | ReplicaSet | StatefulSet
+    name: str
+    namespace: str = "default"
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[SelectorRequirement] = field(default_factory=list)
+    resource_version: int = 0
+
+    def selects(self, pod: "Pod") -> bool:
+        if pod.namespace != self.namespace:
+            return False
+        if not self.match_labels and not self.match_expressions:
+            return False  # nil/empty selector objects are skipped by listers
+        for k, v in self.match_labels.items():
+            if pod.labels.get(k) != v:
+                return False
+        return all(r.matches_labels(pod.labels) for r in self.match_expressions)
 
 
 @dataclass

@@ -56,12 +56,24 @@ def node_state(nodes: Arrays) -> NodeState:
                      nodes["port_bitmap"])
 
 
+# priorities whose per-node score depends only on node spec + pod (no carry,
+# no filtered-set reduce): computed once for the whole batch outside the scan
+_STATIC_PRIORITIES = ("NodePreferAvoidPodsPriority", "ImageLocalityPriority",
+                      "EqualPriority")
+# carry-dependent (capacity evolves as pods commit)
+_DYNAMIC_PRIORITIES = ("LeastRequestedPriority", "MostRequestedPriority",
+                       "BalancedResourceAllocation")
+# filtered-set-normalized reduces, recomputed per pod against current fits
+_REDUCE_PRIORITIES = ("TaintTolerationPriority", "NodeAffinityPriority")
+
+
 def _step_scores(pod_nonzero: jnp.ndarray, state: NodeState, alloc: jnp.ndarray,
-                 tt_cnt: jnp.ndarray, fits: jnp.ndarray,
+                 tt_cnt: jnp.ndarray, na_cnt: jnp.ndarray,
+                 static_score: jnp.ndarray, fits: jnp.ndarray,
                  priorities: Tuple[Tuple[str, int], ...]) -> jnp.ndarray:
     """Per-pod priority sum against the evolving carry. [N] int32."""
     pz = pod_nonzero[None, :]  # [1,2]
-    total = jnp.zeros(alloc.shape[0], dtype=jnp.int32)
+    total = static_score
     for name, weight in priorities:
         if name == "LeastRequestedPriority":
             s = prio.least_requested(pz, state.nonzero, alloc)[0]
@@ -75,8 +87,12 @@ def _step_scores(pod_nonzero: jnp.ndarray, state: NodeState, alloc: jnp.ndarray,
             mx = masked.max()
             s = jnp.where(mx == 0, MAX_PRIORITY,
                           (MAX_PRIORITY * (mx - tt_cnt)) // jnp.maximum(mx, 1))
-        elif name == "EqualPriority":
-            s = jnp.ones_like(total)
+        elif name == "NodeAffinityPriority":
+            masked = jnp.where(fits, na_cnt, 0)
+            mx = masked.max()
+            s = jnp.where(mx > 0, (MAX_PRIORITY * na_cnt) // jnp.maximum(mx, 1), 0)
+        elif name in _STATIC_PRIORITIES or name in prio.HOST_ONLY_PRIORITIES:
+            continue  # folded into static_score / host-path-only
         else:
             raise KeyError(name)
         total = total + s * weight
@@ -120,17 +136,28 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
              final rr_counter).
     """
     static_fit = preds.static_fits(pods, nodes)  # [P,N] — MXU batch
-    tt_cnt = jnp.einsum("pt,nt->pn", pods["intolerated_pref"],
-                        nodes["taints_pref"].astype(jnp.int8),
-                        preferred_element_type=jnp.int32)
     alloc = nodes["alloc"]
     allowed = nodes["allowed_pods"]
     n = alloc.shape[0]
+    p_count = pods["req"].shape[0]
     idx_n = jnp.arange(n, dtype=jnp.int32)
+    # reduce-priority count matrices (batched MXU work, consumed per-step)
+    tt_cnt = jnp.einsum("pt,nt->pn", pods["intolerated_pref"],
+                        nodes["taints_pref"].astype(jnp.int8),
+                        preferred_element_type=jnp.int32)
+    na_cnt = prio.node_affinity_counts(pods, nodes["labels"]) \
+        if any(nm == "NodeAffinityPriority" for nm, _ in priorities) \
+        else jnp.zeros((p_count, n), dtype=jnp.int32)
+    # carry/reduce-independent priorities: fold into one static score matrix
+    static_score = jnp.zeros((p_count, n), dtype=jnp.int32)
+    for name, weight in priorities:
+        if name in _STATIC_PRIORITIES:
+            static_score = static_score + \
+                prio.PRIORITY_REGISTRY[name](pods, nodes, None) * weight
 
     def step(carry, xs):
         state, counter = carry
-        p_static, p_tt, p_req, p_zero, p_nonzero, p_ports = xs
+        p_static, p_tt, p_na, p_sscore, p_req, p_zero, p_nonzero, p_ports = xs
         dyn = (
             preds.resources_fit(p_req[None], p_zero[None], alloc, state.requested)[0]
             & preds.pod_count_fit(state.pod_count, allowed)
@@ -138,7 +165,8 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
         )
         fits = p_static & dyn
         fit_count = fits.sum().astype(jnp.int32)
-        scores = _step_scores(p_nonzero, state, alloc, p_tt, fits, priorities)
+        scores = _step_scores(p_nonzero, state, alloc, p_tt, p_na, p_sscore,
+                              fits, priorities)
         masked = jnp.where(fits, scores, jnp.int32(-1))
         best = masked.max()
         ties = masked == best  # only fitting nodes can equal best when best>=0
@@ -156,8 +184,8 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
         new_state = _commit(state, sel, ok, p_req, p_nonzero, p_ports)
         return (new_state, counter), (sel, fit_count)
 
-    xs = (static_fit, tt_cnt, pods["req"], pods["zero_req"], pods["nonzero"],
-          pods["ports"])
+    xs = (static_fit, tt_cnt, na_cnt, static_score, pods["req"],
+          pods["zero_req"], pods["nonzero"], pods["ports"])
     (state, rr_counter), (selected, fit_counts) = lax.scan(
         step, (state, rr_counter), xs)
     return selected, fit_counts, state, rr_counter

@@ -51,7 +51,12 @@ class Scheduler:
         self.scheduler_name = scheduler_name
         self._now = now
         self.cache = SchedulerCache(ttl_seconds=assumed_ttl, now=now)
-        self.engine = SchedulingEngine(self.cache, priorities=priorities)
+        # Service/RC/RS/StatefulSet mirror for spreading & service affinity —
+        # the extra informers of factory.go:120-140
+        self._workloads: Dict[str, object] = {}
+        self.engine = SchedulingEngine(
+            self.cache, priorities=priorities,
+            workloads_provider=lambda: list(self._workloads.values()))
         self.queue = SchedulingQueue(now=now)
         self.metrics = SchedulerMetrics()
         self.record_events = record_events
@@ -62,11 +67,18 @@ class Scheduler:
 
     # ------------------------------------------------------------ lifecycle
 
+    WORKLOAD_KINDS = ("Service", "ReplicationController", "ReplicaSet",
+                      "StatefulSet")
+
     def start(self) -> None:
         """Initial List (reflector handshake): nodes + pods into cache/queue."""
         nodes, _ = self.api.list("Node")
         for n in nodes:
             self.cache.add_node(n)
+        for kind in self.WORKLOAD_KINDS:
+            for w in self.api.list(kind)[0]:
+                self._workloads[kind + "/" + getattr(w, "namespace", "")
+                                + "/" + w.name] = w
         pods, rv = self.api.list("Pod")
         for p in pods:
             self._pods[p.key()] = p
@@ -84,7 +96,8 @@ class Scheduler:
             self.start()
             return 0
         try:
-            events = self.api.watch_since(("Pod", "Node"), self._rv, timeout=wait)
+            events = self.api.watch_since(("Pod", "Node") + self.WORKLOAD_KINDS,
+                                          self._rv, timeout=wait)
         except TooOldResourceVersion:
             self._relist()
             return 0
@@ -92,8 +105,15 @@ class Scheduler:
             self._rv = ev.rv
             if ev.kind == "Node":
                 self._on_node_event(ev.type, ev.obj)
-            else:
+            elif ev.kind == "Pod":
                 self._on_pod_event(ev.type, ev.obj)
+            else:
+                key = (ev.kind + "/" + getattr(ev.obj, "namespace", "")
+                       + "/" + ev.obj.name)
+                if ev.type == "DELETED":
+                    self._workloads.pop(key, None)
+                else:
+                    self._workloads[key] = ev.obj
         return len(events)
 
     # ------------------------------------------------------------ scheduling
@@ -209,8 +229,10 @@ class Scheduler:
         List, like a reflector restart. Assumed pods still pending
         confirmation are preserved by re-adding only confirmed state."""
         self.cache = SchedulerCache(ttl_seconds=self.cache._ttl, now=self._now)
-        self.engine = SchedulingEngine(self.cache,
-                                       priorities=self.engine.priorities)
+        self._workloads = {}
+        self.engine = SchedulingEngine(
+            self.cache, priorities=self.engine.priorities,
+            workloads_provider=lambda: list(self._workloads.values()))
         self.queue = SchedulingQueue(now=self._now)
         self._pods = {}
         self._started = False

@@ -51,11 +51,16 @@ class PlacementResult:
 class SchedulingEngine:
     def __init__(self, cache: SchedulerCache,
                  priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
-                 mem_shift: int = 10):
+                 mem_shift: int = 10, workloads_provider=None,
+                 hard_pod_affinity_weight: int = 1):
         self.cache = cache
         self.priorities = priorities
         self.snapshot = ClusterSnapshot(mem_shift=mem_shift)
         self.rr = oracle.RoundRobin()  # shared counter, device + oracle paths
+        # Service/RC/RS/SS objects for spreading & service affinity — the
+        # factory's extra informers (factory.go:120-140)
+        self.workloads_provider = workloads_provider or (lambda: [])
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self._device_nodes = None
         self._device_version = -1
 
@@ -74,6 +79,30 @@ class SchedulingEngine:
         # rebuild the label matrix; upload happens after, dirty-arrays only
         batch = PodBatch(pods, self.snapshot)
         nodes = self._nodes_on_device()
+
+        # Symmetry routing (predicates.go:1146): a pod with NO affinity of
+        # its own can still be blocked by an EXISTING pod's required
+        # anti-affinity (or by an affinity pod earlier in this batch). Pods
+        # matching any such term take the exact host path — the device kernel
+        # doesn't model the symmetry check yet.
+        from kubernetes_tpu.ops.oracle_ext import term_matches_pod
+        anti_terms = []
+        for info in infos.values():
+            for e in info.pods_with_affinity:
+                if e.affinity and e.affinity.pod_anti_affinity:
+                    for term in e.affinity.pod_anti_affinity.required_terms:
+                        anti_terms.append((term, e))
+        for p in pods:
+            if p.affinity and p.affinity.pod_anti_affinity:
+                for term in p.affinity.pod_anti_affinity.required_terms:
+                    anti_terms.append((term, p))
+        if anti_terms:
+            for i in range(len(pods)):
+                if not batch.needs_host_check[i] and any(
+                        term_matches_pod(term, owner, pods[i])
+                        for term, owner in anti_terms):
+                    batch.needs_host_check[i] = True
+
         fast_idx = [i for i in range(len(pods)) if not batch.needs_host_check[i]]
         slow_idx = [i for i in range(len(pods)) if batch.needs_host_check[i]]
         results: List[Optional[PlacementResult]] = [None] * len(pods)
@@ -102,15 +131,21 @@ class SchedulingEngine:
         # exact host path for over-approximated pods, AFTER device placements
         # so they see committed capacity (FIFO order within themselves)
         if slow_idx:
+            from kubernetes_tpu.ops.oracle_ext import SchedulingContext
             infos = self.cache.node_infos()
             names = self.snapshot.node_names
+            ctx = SchedulingContext(
+                infos, self.workloads_provider(),
+                hard_pod_affinity_weight=self.hard_pod_affinity_weight)
             for i in slow_idx:
                 name = oracle.schedule_one(pods[i], names, infos, self.rr,
-                                           self.priorities)
+                                           self.priorities, ctx)
                 results[i] = PlacementResult(pods[i], name, 1 if name else 0)
                 if name is not None and assume:
                     self._assume(pods[i], name)
                     infos = self.cache.node_infos()
+                    ctx.infos = infos
+                    ctx.invalidate()
 
         return results  # type: ignore[return-value]
 
@@ -123,7 +158,8 @@ class SchedulingEngine:
     _NODE_ARRAY_KEYS = ("alloc", "requested", "nonzero", "pod_count",
                         "allowed_pods", "schedulable", "mem_pressure",
                         "disk_pressure", "labels", "taints_sched",
-                        "taints_pref", "port_bitmap", "valid")
+                        "taints_pref", "port_bitmap", "valid", "avoid",
+                        "image_sizes")
 
     def _nodes_on_device(self):
         """Incremental host->HBM sync: re-upload an array only when its shape

@@ -123,21 +123,26 @@ def check_disk_pressure(node: Node) -> bool:
     return node.condition("DiskPressure") != ConditionStatus.TRUE
 
 
-def pod_fits(pod: Pod, info: NodeInfo) -> bool:
-    """Default-provider predicate chain as modeled so far (GeneralPredicates
-    + taints + conditions; defaults.go:118)."""
+def pod_fits(pod: Pod, info: NodeInfo, ctx=None, affinity_meta=None) -> bool:
+    """Default-provider predicate chain (defaults.go:118): GeneralPredicates
+    + taints + conditions + (with a SchedulingContext) MatchInterPodAffinity.
+    Volume predicates pending (SURVEY.md §7 step 7)."""
     node = info.node
     if node is None:
         return False
     res_ok, _ = pod_fits_resources(pod, info)
-    return (res_ok
-            and pod_fits_host(pod, node)
-            and pod_fits_host_ports(pod, info)
-            and pod_matches_node_selector(pod, node)
-            and pod_tolerates_node_taints(pod, node)
-            and check_node_condition(node)
-            and check_memory_pressure(pod, node)
-            and check_disk_pressure(node))
+    ok = (res_ok
+          and pod_fits_host(pod, node)
+          and pod_fits_host_ports(pod, info)
+          and pod_matches_node_selector(pod, node)
+          and pod_tolerates_node_taints(pod, node)
+          and check_node_condition(node)
+          and check_memory_pressure(pod, node)
+          and check_disk_pressure(node))
+    if ok and ctx is not None:
+        from kubernetes_tpu.ops.oracle_ext import inter_pod_affinity_fits
+        ok = inter_pod_affinity_fits(pod, node, ctx, affinity_meta)
+    return ok
 
 
 # ---------------------------------------------------------------------------
@@ -219,8 +224,12 @@ DEFAULT_PRIORITY_WEIGHTS: Tuple[Tuple[str, int], ...] = (
 
 def prioritize(pod: Pod, infos: Sequence[NodeInfo],
                priorities: Tuple[Tuple[str, int], ...] = DEFAULT_PRIORITY_WEIGHTS,
-               ) -> List[int]:
-    """Weighted sum across enabled priorities (generic_scheduler.go:368-375)."""
+               ctx=None) -> List[int]:
+    """Weighted sum across enabled priorities (generic_scheduler.go:368-375).
+    Context-dependent priorities (spreading, inter-pod affinity) require a
+    SchedulingContext and score 0 without one, mirroring their zero
+    contribution when their listers are absent."""
+    from kubernetes_tpu.ops import oracle_ext
     n = len(infos)
     totals = [0] * n
     for name, weight in priorities:
@@ -232,6 +241,18 @@ def prioritize(pod: Pod, infos: Sequence[NodeInfo],
             per = [balanced_allocation_score(pod, i) for i in infos]
         elif name == "TaintTolerationPriority":
             per = taint_toleration_scores(pod, infos)
+        elif name == "NodeAffinityPriority":
+            per = oracle_ext.node_affinity_scores(pod, infos)
+        elif name == "NodePreferAvoidPodsPriority":
+            per = oracle_ext.prefer_avoid_scores(pod, infos)
+        elif name == "ImageLocalityPriority":
+            per = oracle_ext.image_locality_scores(pod, infos)
+        elif name == "SelectorSpreadPriority":
+            per = (oracle_ext.selector_spread_scores(pod, infos, ctx)
+                   if ctx is not None else [0] * n)
+        elif name == "InterPodAffinityPriority":
+            per = (oracle_ext.interpod_affinity_scores(pod, infos, ctx)
+                   if ctx is not None else [0] * n)
         elif name == "EqualPriority":
             per = [1] * n
         else:
@@ -264,16 +285,20 @@ class RoundRobin:
 def schedule_one(pod: Pod, names: List[str], infos: Dict[str, NodeInfo],
                  rr: RoundRobin,
                  priorities: Tuple[Tuple[str, int], ...] = DEFAULT_PRIORITY_WEIGHTS,
-                 ) -> Optional[str]:
+                 ctx=None) -> Optional[str]:
     """genericScheduler.Schedule for one pod (generic_scheduler.go:88-142):
     filter -> prioritize -> selectHost. Returns node name or None."""
-    fit_names = [nm for nm in names if pod_fits(pod, infos[nm])]
+    meta = None
+    if ctx is not None:
+        from kubernetes_tpu.ops.oracle_ext import AffinityMeta
+        meta = AffinityMeta(pod, ctx)  # once per pod, not per node
+    fit_names = [nm for nm in names if pod_fits(pod, infos[nm], ctx, meta)]
     if not fit_names:
         return None
     if len(fit_names) == 1:
         return fit_names[0]
     fit_infos = [infos[nm] for nm in fit_names]
-    scores = prioritize(pod, fit_infos, priorities)
+    scores = prioritize(pod, fit_infos, priorities, ctx)
     best = max(scores)
     ties = [nm for nm, s in zip(fit_names, scores) if s == best]
     return ties[rr.pick(len(ties))]

@@ -57,6 +57,8 @@ def node_arrays(snap) -> Arrays:
         "taints_pref": jnp.asarray(snap.taints_pref),
         "port_bitmap": jnp.asarray(snap.port_bitmap),
         "valid": jnp.asarray(snap.valid),
+        "avoid": jnp.asarray(snap.avoid),
+        "image_sizes": jnp.asarray(snap.image_sizes),
     }
 
 
@@ -80,6 +82,16 @@ def pod_arrays(batch) -> Arrays:
         "sel_any_used": jnp.asarray(batch.sel_any_used),
         "sel_unsat": jnp.asarray(batch.sel_unsat),
         "has_selector": jnp.asarray(batch.has_selector),
+        "pref_req_all": jnp.asarray(batch.pref_req_all),
+        "pref_req_any": jnp.asarray(batch.pref_req_any),
+        "pref_forbid": jnp.asarray(batch.pref_forbid),
+        "pref_any_used": jnp.asarray(batch.pref_any_used),
+        "pref_valid": jnp.asarray(batch.pref_valid),
+        "pref_unsat": jnp.asarray(batch.pref_unsat),
+        "pref_empty": jnp.asarray(batch.pref_empty),
+        "pref_weight": jnp.asarray(batch.pref_weight),
+        "avoid_idx": jnp.asarray(batch.avoid_idx),
+        "img_count": jnp.asarray(batch.img_count),
     }
 
 

@@ -125,6 +125,66 @@ def equal(p: int, n: int) -> jnp.ndarray:
     return jnp.ones((p, n), dtype=jnp.int32)
 
 
+def node_affinity_counts(pods: Arrays, labels: jnp.ndarray) -> jnp.ndarray:
+    """NodeAffinityPriority map phase (node_affinity.go:36-77): per-node sum
+    of weights of matching preferred terms -> int32 [P,N]. Same compiled-
+    selector matmul structure as predicates.selector_fit; empty terms match
+    every node."""
+    lab = labels.astype(jnp.int8)
+    all_cnt = jnp.einsum("ptl,nl->ptn", pods["pref_req_all"], lab,
+                         preferred_element_type=jnp.int32)
+    need = pods["pref_req_all"].astype(jnp.int32).sum(axis=-1)
+    all_ok = all_cnt == need[:, :, None]
+    forbid_cnt = jnp.einsum("ptl,nl->ptn", pods["pref_forbid"], lab,
+                            preferred_element_type=jnp.int32)
+    any_cnt = jnp.einsum("ptal,nl->ptan", pods["pref_req_any"], lab,
+                         preferred_element_type=jnp.int32)
+    any_ok = ((any_cnt > 0) | ~pods["pref_any_used"][:, :, :, None]).all(axis=2)
+    match = (all_ok & (forbid_cnt == 0) & any_ok
+             & ~pods["pref_unsat"][:, :, None]) | pods["pref_empty"][:, :, None]
+    match = match & pods["pref_valid"][:, :, None]
+    return (match.astype(jnp.int32) * pods["pref_weight"][:, :, None]).sum(axis=1)
+
+
+def node_affinity(pods: Arrays, labels: jnp.ndarray,
+                  fits: jnp.ndarray = None) -> jnp.ndarray:
+    """Map + normalizing reduce (node_affinity.go:79-100):
+    int(10 * count / maxCount) over the filtered set; all-zero -> 0."""
+    cnt = node_affinity_counts(pods, labels)
+    masked = cnt if fits is None else jnp.where(fits, cnt, 0)
+    mx = masked.max(axis=1, keepdims=True)
+    return jnp.where(mx > 0, (MAX_PRIORITY * cnt) // jnp.maximum(mx, 1), 0)
+
+
+def prefer_avoid(avoid_idx: jnp.ndarray, node_avoid: jnp.ndarray) -> jnp.ndarray:
+    """NodePreferAvoidPodsPriority (node_prefer_avoid_pods.go:29-60):
+    0 when the node's preferAvoidPods annotation names the pod's RC/RS
+    controller, else MaxPriority. avoid_idx [P] (-1 = not RC/RS-owned),
+    node_avoid int8 [N,U] -> [P,N]."""
+    safe = jnp.maximum(avoid_idx, 0)
+    hit = jnp.take(node_avoid, safe, axis=1).T.astype(bool)  # [P,N]
+    avoided = hit & (avoid_idx >= 0)[:, None]
+    return jnp.where(avoided, 0, MAX_PRIORITY).astype(jnp.int32)
+
+
+# image_locality.go:30-34 thresholds, quantized to KiB like the snapshot
+MIN_IMG_KIB = (23 * 1024 * 1024) >> 10
+MAX_IMG_KIB = (1000 * 1024 * 1024) >> 10
+
+
+def image_locality(img_count: jnp.ndarray, image_sizes: jnp.ndarray
+                   ) -> jnp.ndarray:
+    """ImageLocalityPriorityMap (image_locality.go:32-66): bucket the summed
+    size of the pod's images already present on the node into 0..10.
+    img_count int32 [P,I] (containers per image), image_sizes int32 [N,I] KiB."""
+    total = jnp.einsum("pi,ni->pn", img_count, image_sizes,
+                       preferred_element_type=jnp.int32)
+    mid = (MAX_PRIORITY * (total - MIN_IMG_KIB)) // (MAX_IMG_KIB - MIN_IMG_KIB) + 1
+    return jnp.where(total < MIN_IMG_KIB, 0,
+                     jnp.where(total >= MAX_IMG_KIB, MAX_PRIORITY, mid)
+                     ).astype(jnp.int32)
+
+
 # registry: name -> (fn(pods, nodes, fits) -> [P,N] int32); `fits` is the
 # pod's filtered-node mask, consumed only by reduce-normalized priorities
 def _lr(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
@@ -147,13 +207,35 @@ def _eq(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
     return equal(pods["nonzero"].shape[0], nodes["alloc"].shape[0])
 
 
+def _na(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return node_affinity(pods, nodes["labels"], fits)
+
+
+def _avoid(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return prefer_avoid(pods["avoid_idx"], nodes["avoid"])
+
+
+def _img(pods: Arrays, nodes: Arrays, fits) -> jnp.ndarray:
+    return image_locality(pods["img_count"], nodes["image_sizes"])
+
+
 PRIORITY_REGISTRY = {
     "LeastRequestedPriority": _lr,
     "MostRequestedPriority": _mr,
     "BalancedResourceAllocation": _ba,
     "TaintTolerationPriority": _tt,
+    "NodeAffinityPriority": _na,
+    "NodePreferAvoidPodsPriority": _avoid,
+    "ImageLocalityPriority": _img,
     "EqualPriority": _eq,
 }
+
+# priorities that only the exact host path (ops.oracle) evaluates today —
+# kernel paths contribute 0 for them instead of crashing, so provider-parity
+# priority tuples (policy.provider_priorities) are accepted everywhere
+HOST_ONLY_PRIORITIES = frozenset({
+    "SelectorSpreadPriority", "InterPodAffinityPriority",
+})
 
 
 def score(pods: Arrays, nodes: Arrays,
@@ -165,15 +247,19 @@ def score(pods: Arrays, nodes: Arrays,
     n = nodes["alloc"].shape[0]
     total = jnp.zeros((p, n), dtype=jnp.int32)
     for name, weight in priorities:
+        if name in HOST_ONLY_PRIORITIES:
+            continue
         total = total + PRIORITY_REGISTRY[name](pods, nodes, fits) * weight
     return total
 
 
 DEFAULT_PRIORITIES: Tuple[Tuple[str, int], ...] = (
     # defaultPriorities (algorithmprovider/defaults/defaults.go:191) minus the
-    # not-yet-modeled ones (SelectorSpread, InterPodAffinity,
-    # NodePreferAvoidPods, NodeAffinity — later milestones)
+    # two not yet in kernel form (SelectorSpread, InterPodAffinity — those run
+    # via the exact host path / later kernels)
     ("LeastRequestedPriority", 1),
     ("BalancedResourceAllocation", 1),
+    ("NodePreferAvoidPodsPriority", 10000),
+    ("NodeAffinityPriority", 1),
     ("TaintTolerationPriority", 1),
 )

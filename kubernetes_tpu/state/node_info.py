@@ -22,6 +22,7 @@ class NodeInfo:
     __slots__ = (
         "node",
         "pods",
+        "pods_with_affinity",
         "requested",
         "nonzero_cpu",
         "nonzero_mem",
@@ -34,6 +35,10 @@ class NodeInfo:
     def __init__(self, node: Optional[Node] = None):
         self.node: Optional[Node] = node
         self.pods: List[Pod] = []
+        # pods carrying any pod (anti-)affinity — the reference tracks these
+        # separately (node_info.go PodsWithAffinity) so the symmetry checks
+        # don't scan every pod
+        self.pods_with_affinity: List[Pod] = []
         self.requested = Resource()
         self.nonzero_cpu = 0
         self.nonzero_mem = 0
@@ -59,6 +64,9 @@ class NodeInfo:
             self.used_ports.update(ports)
             self.ports_generation += 1
         self.pods.append(pod)
+        if pod.affinity is not None and (pod.affinity.pod_affinity is not None
+                                         or pod.affinity.pod_anti_affinity is not None):
+            self.pods_with_affinity.append(pod)
         self.generation += 1
 
     def remove_pod(self, pod: Pod) -> bool:
@@ -66,6 +74,8 @@ class NodeInfo:
         for i, p in enumerate(self.pods):
             if p.key() == key:
                 del self.pods[i]
+                self.pods_with_affinity = [
+                    q for q in self.pods_with_affinity if q.key() != key]
                 req = p.resource_request()
                 self.requested.sub(req)
                 ncpu, nmem = p.nonzero_request()
@@ -96,6 +106,7 @@ class NodeInfo:
     def clone_shallow(self) -> "NodeInfo":
         out = NodeInfo(self.node)
         out.pods = list(self.pods)
+        out.pods_with_affinity = list(self.pods_with_affinity)
         out.requested = self.requested.clone()
         out.nonzero_cpu = self.nonzero_cpu
         out.nonzero_mem = self.nonzero_mem

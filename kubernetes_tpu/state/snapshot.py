@@ -69,6 +69,29 @@ def _pad(n: int, to: int = 8) -> int:
     return max(to, ((n + to - 1) // to) * to)
 
 
+AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+
+
+def _parse_avoid_annotation(annotations: Dict[str, str]) -> List[Tuple[str, str]]:
+    """-> [(kind, uid)] from the preferAvoidPods node annotation
+    (reference: pkg/api/v1/helper GetAvoidPodsFromNodeAnnotations;
+    node_prefer_avoid_pods.go:48-58). Malformed JSON -> no avoidance."""
+    raw = annotations.get(AVOID_PODS_ANNOTATION)
+    if not raw:
+        return []
+    import json
+    try:
+        avoids = json.loads(raw)
+    except ValueError:
+        return []
+    out = []
+    for avoid in avoids.get("preferAvoidPods", []):
+        ctrl = (avoid.get("podSignature") or {}).get("podController") or {}
+        if ctrl.get("kind") and ctrl.get("uid"):
+            out.append((ctrl["kind"], ctrl["uid"]))
+    return out
+
+
 class Vocab:
     """Interning table with stable indices and a by-key reverse map for
     expanding Exists/DoesNotExist/Gt/Lt requirements into pair sets."""
@@ -131,7 +154,8 @@ class ClusterSnapshot:
 
     DYNAMIC = ("requested", "nonzero", "pod_count")
     STATIC = ("alloc", "allowed_pods", "schedulable", "mem_pressure",
-              "disk_pressure", "labels", "taints_sched", "taints_pref", "valid")
+              "disk_pressure", "labels", "taints_sched", "taints_pref", "valid",
+              "avoid", "image_sizes")
 
     def __init__(self, mem_shift: int = 10, node_pad: int = 8):
         self.mem_shift = mem_shift
@@ -142,13 +166,21 @@ class ClusterSnapshot:
         self.node_names: List[str] = []
         self.node_index: Dict[str, int] = {}
         self._generations: Dict[str, Tuple[int, int, int]] = {}
-        self._shape_sig: Optional[Tuple[int, int, int, int]] = None
+        self._shape_sig: Optional[Tuple[int, ...]] = None
         self.version = 0  # bumped on any array change (device cache key)
         self.dirty: set = set()
         self._label_index: Dict[str, set] = {}  # key -> values across nodes
         self._row_labels: List[Dict[str, str]] = []  # per-row node label maps
         self._labels_width = _pad(0)
         self._vocab_dirty = False
+        # NodePreferAvoidPods: vocab of avoided (kind, uid) controller sigs
+        self.avoid_vocab = Vocab()
+        # ImageLocality: demand-driven vocab of image names pods reference;
+        # node rows rebuilt on growth like the label matrix
+        self.image_vocab = Vocab()
+        self._row_images: List[list] = []
+        self._images_width = _pad(0, 4)
+        self._image_vocab_dirty = False
         # arrays created on first refresh
         self.alloc: np.ndarray
         self.requested: np.ndarray
@@ -215,6 +247,28 @@ class ClusterSnapshot:
         DoesNotExist expansion)."""
         return self._label_index.get(key, ())
 
+    def ensure_image(self, name: str) -> int:
+        before = len(self.image_vocab)
+        idx = self.image_vocab.add(name, "")
+        if len(self.image_vocab) != before:
+            self._image_vocab_dirty = True
+        return idx
+
+    def finalize_images(self) -> int:
+        """Rebuild [N, I] image-size matrix (KiB, clamped to int32) if the
+        image vocab grew. Mirrors finalize_labels."""
+        want = _pad(len(self.image_vocab), 4)
+        if self._image_vocab_dirty or want != self._images_width:
+            self._images_width = want
+            n = self.alloc.shape[0] if self._shape_sig else 0
+            self.image_sizes = np.zeros((n, want), dtype=np.int32)
+            for i, images in enumerate(self._row_images):
+                self._write_image_row(i, images)
+            self._image_vocab_dirty = False
+            self.dirty.add("image_sizes")
+            self.version += 1
+        return self._images_width
+
     def finalize_labels(self) -> int:
         """Rebuild the [N, L] label matrix if the vocab grew (called by
         PodBatch after selector compilation). Returns the padded width L."""
@@ -231,33 +285,47 @@ class ClusterSnapshot:
             if self._shape_sig is not None:
                 # keep the shape signature in sync so the next refresh()
                 # doesn't mistake the widened label axis for a rebuild
-                n, _, t, r = self._shape_sig
-                self._shape_sig = (n, want, t, r)
+                sig = list(self._shape_sig)
+                sig[1] = want
+                self._shape_sig = tuple(sig)
         return self._labels_width
 
     def refresh(self, infos: Dict[str, NodeInfo]) -> bool:
         """Sync arrays with the cache. Returns True on full rebuild (shape or
         membership change), False for in-place delta."""
-        # taint / extended-resource vocabs are node-driven (small by nature)
-        for info in infos.values():
+        # node-driven vocabs (taints, extended resources, avoid signatures) —
+        # interned before shaping, re-scanned only for changed node specs.
+        # The skip-cache keys on (spec_generation, node object identity): a
+        # node deleted and re-added under the same name restarts its counters,
+        # so generation equality alone would skip interning its new spec.
+        if not hasattr(self, "_interned_spec"):
+            self._interned_spec = {}
+        for nm in list(self._interned_spec):
+            if nm not in infos:
+                del self._interned_spec[nm]
+        for nm, info in infos.items():
             node = info.node
-            if node is None:
-                continue
-            for t in node.taints:
-                eff = t.effect.value if isinstance(t.effect, TaintEffect) else t.effect
-                self.taint_vocab.add(t.key, t.value + "\x00" + str(eff))
-            for name in node.allocatable.extended:
-                self.ext_vocab.add(name, "")
-        for info in infos.values():
-            # bound/assumed pods may request ext resources their node doesn't
-            # advertise; intern those too so _write_dynamic_row can't overflow
-            for name in info.requested.extended:
-                self.ext_vocab.add(name, "")
+            seen = self._interned_spec.get(nm)
+            if node is not None and (seen is None or seen[0] != info.spec_generation
+                                     or seen[1] is not node):
+                self._interned_spec[nm] = (info.spec_generation, node)
+                for t in node.taints:
+                    eff = t.effect.value if isinstance(t.effect, TaintEffect) else t.effect
+                    self.taint_vocab.add(t.key, t.value + "\x00" + str(eff))
+                for name in node.allocatable.extended:
+                    self.ext_vocab.add(name, "")
+                for kind, uid in _parse_avoid_annotation(node.annotations):
+                    self.avoid_vocab.add(kind, uid)
+            if info.requested.extended:
+                # bound/assumed pods may request ext resources their node
+                # doesn't advertise; intern so _write_dynamic_row can't overflow
+                for name in info.requested.extended:
+                    self.ext_vocab.add(name, "")
 
         names = sorted(infos.keys())
         n_pad = _pad(len(names), self.node_pad)
         sig = (n_pad, self._labels_width, _pad(len(self.taint_vocab)),
-               self.num_resources)
+               self.num_resources, _pad(len(self.avoid_vocab), 4))
         rebuild = sig != self._shape_sig or names != self.node_names
         if rebuild:
             self._allocate(names, sig)
@@ -265,21 +333,28 @@ class ClusterSnapshot:
             self._row_labels = [{} for _ in range(n_pad)]
             changed = names
         else:
-            changed = [nm for nm in names
-                       if infos[nm].generation != self._generations.get(nm, (-1,))[0]]
+            # a NodeInfo replaced under the same name (node removed+re-added)
+            # restarts its counters — identity is part of the staleness key
+            changed = []
+            for nm in names:
+                prev = self._generations.get(nm)
+                info = infos[nm]
+                if prev is None or prev[0] != info.generation or prev[3] is not info:
+                    changed.append(nm)
         label_index_stale = rebuild
         for nm in changed:
             i = self.node_index[nm]
             info = infos[nm]
-            prev = self._generations.get(nm, (-1, -1, -1))
+            prev = self._generations.get(nm, (-1, -1, -1, None))
+            fresh = prev[3] is not info
             self._write_dynamic_row(i, info)
-            if rebuild or info.spec_generation != prev[1]:
+            if rebuild or fresh or info.spec_generation != prev[1]:
                 self._write_static_row(i, info)
                 label_index_stale = True
-            if rebuild or info.ports_generation != prev[2]:
+            if rebuild or fresh or info.ports_generation != prev[2]:
                 self._write_ports_row(i, info)
             self._generations[nm] = (info.generation, info.spec_generation,
-                                     info.ports_generation)
+                                     info.ports_generation, info)
         if label_index_stale:
             self._rebuild_label_index(infos, names)
         if changed or rebuild:
@@ -288,8 +363,8 @@ class ClusterSnapshot:
 
     # ------------------------------------------------------------- internals
 
-    def _allocate(self, names: List[str], sig: Tuple[int, int, int, int]) -> None:
-        n, l, t, r = sig
+    def _allocate(self, names: List[str], sig: Tuple[int, ...]) -> None:
+        n, l, t, r = sig[:4]
         self._shape_sig = sig
         self.node_names = names
         self.node_index = {nm: i for i, nm in enumerate(names)}
@@ -308,6 +383,9 @@ class ClusterSnapshot:
         self.port_bitmap = np.zeros((n, PORT_WORDS), dtype=np.uint32)
         self.valid = np.zeros(n, dtype=bool)
         self.valid[: len(names)] = True
+        self.avoid = np.zeros((n, _pad(len(self.avoid_vocab), 4)), dtype=np.int8)
+        self.image_sizes = np.zeros((n, self._images_width), dtype=np.int32)
+        self._row_images = [[] for _ in range(n)]
         self.dirty = {"requested", "nonzero", "pod_count", "port_bitmap",
                       *self.STATIC}
 
@@ -355,7 +433,29 @@ class ClusterSnapshot:
                 tp[idx] = 1
         self.taints_sched[i] = ts
         self.taints_pref[i] = tp
+
+        av = np.zeros(self.avoid.shape[1], dtype=np.int8)
+        for kind, uid in _parse_avoid_annotation(node.annotations):
+            idx = self.avoid_vocab.get(kind, uid)
+            if idx >= 0:
+                av[idx] = 1
+        self.avoid[i] = av
+
+        self._row_images[i] = node.images
+        self._write_image_row(i, node.images)
         self.dirty.update(self.STATIC)
+
+    def _write_image_row(self, i: int, images) -> None:
+        row = np.zeros(self._images_width, dtype=np.int32)
+        for img in images:
+            size_kib = min(img.size_bytes >> 10, 2 ** 31 - 1)
+            for name in img.names:
+                idx = self.image_vocab.get(name, "")
+                if idx >= 0:
+                    row[idx] = size_kib
+        if getattr(self, "image_sizes", None) is not None \
+                and self.image_sizes.shape[1] == self._images_width:
+            self.image_sizes[i] = row
 
     def _write_label_row(self, i: int, labels: Dict[str, str]) -> None:
         lbl = np.zeros(self.labels.shape[1], dtype=np.int8)
@@ -392,6 +492,66 @@ class ClusterSnapshot:
 MAX_PORTS_PER_POD = 8
 
 
+def compile_requirements(match_expressions, snap: ClusterSnapshot):
+    """Compile a list of ANDed SelectorRequirements against the snapshot's
+    demand-driven label vocab -> (req_all, any_groups, forbid, unsat).
+    Semantics per NodeSelectorRequirementsAsSelector + labels.Selector.Matches
+    (predicates.go:625-647): In -> pair membership, Exists/Gt/Lt -> expansion
+    over the values present on nodes, NotIn/DoesNotExist -> forbidden pairs
+    (absent key matches)."""
+    req_all: List[int] = []
+    any_groups: List[List[int]] = []
+    forbid: List[int] = []
+    unsat = not match_expressions
+    for r in match_expressions:
+        op = SelectorOperator(r.operator)
+        if op == SelectorOperator.IN:
+            # intern every referenced pair; a pair no node carries is an
+            # all-zero column, so matching fails naturally
+            idxs = [snap.ensure_label_pair(r.key, v) for v in r.values]
+            if not idxs:
+                unsat = True
+            elif len(idxs) == 1:
+                req_all.append(idxs[0])
+            else:
+                any_groups.append(idxs)
+        elif op == SelectorOperator.EXISTS:
+            vals = snap.node_values_for_key(r.key)
+            if not vals:
+                unsat = True  # no node has the key at snapshot time
+            else:
+                any_groups.append(
+                    [snap.ensure_label_pair(r.key, v) for v in vals])
+        elif op == SelectorOperator.DOES_NOT_EXIST:
+            forbid.extend(snap.ensure_label_pair(r.key, v)
+                          for v in snap.node_values_for_key(r.key))
+        elif op == SelectorOperator.NOT_IN:
+            vals = set(snap.node_values_for_key(r.key))
+            forbid.extend(snap.ensure_label_pair(r.key, v)
+                          for v in r.values if v in vals)
+        elif op in (SelectorOperator.GT, SelectorOperator.LT):
+            try:
+                rhs = int(r.values[0]) if r.values else None
+            except ValueError:
+                rhs = None
+            if rhs is None:
+                unsat = True
+            else:
+                idxs = []
+                for val in snap.node_values_for_key(r.key):
+                    try:
+                        lhs = int(val)
+                    except ValueError:
+                        continue
+                    if (lhs > rhs) if op == SelectorOperator.GT else (lhs < rhs):
+                        idxs.append(snap.ensure_label_pair(r.key, val))
+                if not idxs:
+                    unsat = True
+                else:
+                    any_groups.append(idxs)
+    return (req_all, any_groups, forbid, unsat)
+
+
 class PodBatch:
     """Dense encoding of a list of pending pods against a snapshot's vocabs.
 
@@ -411,7 +571,7 @@ class PodBatch:
     """
 
     def __init__(self, pods: Sequence[Pod], snap: ClusterSnapshot,
-                 max_terms: int = 4, max_any: int = 2):
+                 max_terms: int = 4, max_any: int = 2, max_pref: int = 8):
         self.pods = list(pods)
         P = len(self.pods)
         if snap._shape_sig is None:
@@ -437,16 +597,29 @@ class PodBatch:
         # vocab, so the label matrix is finalized only afterwards.
         n_terms = 1
         n_any = 1
+        n_pref = 1
         compiled = []
+        pref_compiled = []
         for pod in self.pods:
             terms = self._compile_selector(pod, snap)
             compiled.append(terms)
             n_terms = max(n_terms, len(terms))
             for t in terms:
                 n_any = max(n_any, len(t[1]))
+            prefs = self._compile_preferred(pod, snap)
+            pref_compiled.append(prefs)
+            n_pref = max(n_pref, len(prefs))
+            for _, comp in prefs:
+                if comp is not None:
+                    n_any = max(n_any, len(comp[1]))
+            for c in pod.containers:
+                if c.image:
+                    snap.ensure_image(c.image)
         n_terms = min(n_terms, max_terms)
         n_any = min(n_any, max_any)
+        n_pref = min(n_pref, max_pref)
         L = snap.finalize_labels()
+        I = snap.finalize_images()
         self.sel_req_all = np.zeros((P, n_terms, L), dtype=np.int8)
         self.sel_req_any = np.zeros((P, n_terms, n_any, L), dtype=np.int8)
         self.sel_forbid = np.zeros((P, n_terms, L), dtype=np.int8)
@@ -454,9 +627,33 @@ class PodBatch:
         self.sel_any_used = np.zeros((P, n_terms, n_any), dtype=bool)
         self.sel_unsat = np.zeros((P, n_terms), dtype=bool)
         self.has_selector = np.zeros(P, dtype=bool)
+        # preferred node-affinity terms (NodeAffinityPriority,
+        # node_affinity.go:36-77): weight + compiled selector per term; a term
+        # with no expressions matches ALL nodes (pref_empty)
+        self.pref_req_all = np.zeros((P, n_pref, L), dtype=np.int8)
+        self.pref_req_any = np.zeros((P, n_pref, n_any, L), dtype=np.int8)
+        self.pref_forbid = np.zeros((P, n_pref, L), dtype=np.int8)
+        self.pref_any_used = np.zeros((P, n_pref, n_any), dtype=bool)
+        self.pref_valid = np.zeros((P, n_pref), dtype=bool)
+        self.pref_unsat = np.zeros((P, n_pref), dtype=bool)
+        self.pref_empty = np.zeros((P, n_pref), dtype=bool)
+        self.pref_weight = np.zeros((P, n_pref), dtype=np.int32)
+        # NodePreferAvoidPods: index into the avoid vocab, -1 = not RC/RS-owned
+        self.avoid_idx = np.full(P, -1, dtype=np.int32)
+        # ImageLocality: per-image container counts
+        self.img_count = np.zeros((P, I), dtype=np.int32)
 
         for p, pod in enumerate(self.pods):
             self._encode_pod(p, pod, snap, compiled[p], n_terms, n_any)
+            self._encode_pref(p, pod, snap, pref_compiled[p], n_pref, n_any)
+            if pod.owner_kind in ("ReplicationController", "ReplicaSet"):
+                self.avoid_idx[p] = snap.avoid_vocab.get(pod.owner_kind,
+                                                         pod.owner_uid)
+            for c in pod.containers:
+                if c.image:
+                    idx = snap.image_vocab.get(c.image, "")
+                    if idx >= 0:
+                        self.img_count[p, idx] += 1
 
     # -------------------------------------------------------------- helpers
 
@@ -479,59 +676,22 @@ class PodBatch:
                     "\x00unsat", SelectorOperator.IN, [])])]
         elif simple:
             terms = [NodeSelectorTerm(simple)]
+        return [compile_requirements(term.match_expressions, snap)
+                for term in terms]
+
+    def _compile_preferred(self, pod: Pod, snap: ClusterSnapshot):
+        """-> [(weight, compiled-or-None)] for preferred node-affinity terms;
+        None = empty term (matches every node, node_affinity.go:51)."""
+        na = pod.affinity.node_affinity if pod.affinity else None
         out = []
-        for term in terms:
-            req_all: List[int] = []
-            any_groups: List[List[int]] = []
-            forbid: List[int] = []
-            unsat = not term.match_expressions
-            for r in term.match_expressions:
-                op = SelectorOperator(r.operator)
-                if op == SelectorOperator.IN:
-                    # intern every referenced pair; a pair no node carries is
-                    # an all-zero column, so matching fails naturally
-                    idxs = [snap.ensure_label_pair(r.key, v) for v in r.values]
-                    if not idxs:
-                        unsat = True
-                    elif len(idxs) == 1:
-                        req_all.append(idxs[0])
-                    else:
-                        any_groups.append(idxs)
-                elif op == SelectorOperator.EXISTS:
-                    vals = snap.node_values_for_key(r.key)
-                    if not vals:
-                        unsat = True  # no node has the key at snapshot time
-                    else:
-                        any_groups.append(
-                            [snap.ensure_label_pair(r.key, v) for v in vals])
-                elif op == SelectorOperator.DOES_NOT_EXIST:
-                    forbid.extend(snap.ensure_label_pair(r.key, v)
-                                  for v in snap.node_values_for_key(r.key))
-                elif op == SelectorOperator.NOT_IN:
-                    vals = set(snap.node_values_for_key(r.key))
-                    forbid.extend(snap.ensure_label_pair(r.key, v)
-                                  for v in r.values if v in vals)
-                elif op in (SelectorOperator.GT, SelectorOperator.LT):
-                    try:
-                        rhs = int(r.values[0]) if r.values else None
-                    except ValueError:
-                        rhs = None
-                    if rhs is None:
-                        unsat = True
-                    else:
-                        idxs = []
-                        for val in snap.node_values_for_key(r.key):
-                            try:
-                                lhs = int(val)
-                            except ValueError:
-                                continue
-                            if (lhs > rhs) if op == SelectorOperator.GT else (lhs < rhs):
-                                idxs.append(snap.ensure_label_pair(r.key, val))
-                        if not idxs:
-                            unsat = True
-                        else:
-                            any_groups.append(idxs)
-            out.append((req_all, any_groups, forbid, unsat))
+        for weight, term in (na.preferred_terms if na else []):
+            if weight == 0:
+                continue  # node_affinity.go:57
+            if not term.match_expressions:
+                out.append((weight, None))
+            else:
+                out.append((weight,
+                            compile_requirements(term.match_expressions, snap)))
         return out
 
     def _encode_pod(self, p: int, pod: Pod, snap: ClusterSnapshot, terms,
@@ -564,6 +724,12 @@ class PodBatch:
         if pod.node_name:
             self.has_host[p] = True
             self.host_required[p] = snap.node_index.get(pod.node_name, -1)
+
+        if pod.affinity is not None and (pod.affinity.pod_affinity is not None
+                                         or pod.affinity.pod_anti_affinity is not None):
+            # inter-pod affinity is evaluated by the exact host path until the
+            # topology-incidence kernel integrates into the placement scan
+            self.needs_host_check[p] = True
 
         # tolerations -> which vocab taints remain INtolerated
         for t_idx, (tkey, tpack) in enumerate(snap.taint_vocab.items()):
@@ -598,6 +764,33 @@ class PodBatch:
                 self.sel_any_used[p, t, a] = True
                 for i in group:
                     self.sel_req_any[p, t, a, i] = 1
+
+    def _encode_pref(self, p: int, pod: Pod, snap: ClusterSnapshot, prefs,
+                     n_pref: int, n_any: int) -> None:
+        if len(prefs) > n_pref:
+            # too many preferred terms for static shape: host-exact path
+            self.needs_host_check[p] = True
+            prefs = prefs[:0]
+        for t, (weight, comp) in enumerate(prefs):
+            self.pref_valid[p, t] = True
+            self.pref_weight[p, t] = weight
+            if comp is None:
+                self.pref_empty[p, t] = True
+                continue
+            req_all, any_groups, forbid, unsat = comp
+            if len(any_groups) > n_any:
+                self.needs_host_check[p] = True
+                any_groups = []
+            if unsat:
+                self.pref_unsat[p, t] = True
+            for i in req_all:
+                self.pref_req_all[p, t, i] = 1
+            for i in forbid:
+                self.pref_forbid[p, t, i] = 1
+            for a, group in enumerate(any_groups):
+                self.pref_any_used[p, t, a] = True
+                for i in group:
+                    self.pref_req_any[p, t, a, i] = 1
 
     def __len__(self) -> int:
         return len(self.pods)

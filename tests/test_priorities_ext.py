@@ -1,0 +1,285 @@
+"""Golden tests for the second wave of priorities: NodeAffinity(preferred),
+NodePreferAvoidPods, ImageLocality (kernel vs oracle), and the oracle-only
+SelectorSpread / InterPodAffinity implementations against hand-built tables
+in the style of the reference's *_test.go files."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    ContainerImage,
+    NodeAffinity,
+    NodeSelectorTerm,
+    PodAffinity,
+    PodAffinityTerm,
+    LabelSelector,
+    SelectorOperator,
+    SelectorRequirement,
+    WorkloadObject,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.ops import oracle, oracle_ext
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.ops.predicates import node_arrays, pod_arrays
+from kubernetes_tpu.state.node_info import node_info_map
+from kubernetes_tpu.state.snapshot import AVOID_PODS_ANNOTATION, ClusterSnapshot, PodBatch
+from tests.helpers import Gi, Mi
+
+
+def build(pods, nodes, bound=()):
+    infos = node_info_map(nodes, list(bound))
+    snap = ClusterSnapshot()
+    snap.refresh(infos)
+    batch = PodBatch(pods, snap)
+    return pod_arrays(batch), node_arrays(snap), snap, infos
+
+
+def kernel_scores(pods, nodes, pset, bound=()):
+    parrs, narrs, snap, infos = build(pods, nodes, bound)
+    import jax.numpy as jnp
+    fits = jnp.asarray(np.ones((len(pods), narrs["alloc"].shape[0]), dtype=bool))
+    got = np.asarray(prio.score(parrs, narrs, pset, fits))
+    return got[:, : len(snap.node_names)], snap, infos
+
+
+def test_node_affinity_priority_matches_oracle():
+    nodes = [make_node("n0", labels={"disk": "ssd", "zone": "a"}),
+             make_node("n1", labels={"disk": "hdd", "zone": "a"}),
+             make_node("n2", labels={"zone": "b"})]
+    pod = make_pod("p")
+    pod.affinity = Affinity(node_affinity=NodeAffinity(preferred_terms=[
+        (5, NodeSelectorTerm([SelectorRequirement("disk", SelectorOperator.IN, ["ssd"])])),
+        (3, NodeSelectorTerm([SelectorRequirement("zone", SelectorOperator.IN, ["a"])])),
+        (2, NodeSelectorTerm([])),  # empty term matches everything
+    ]))
+    got, snap, infos = kernel_scores([pod], nodes, (("NodeAffinityPriority", 1),))
+    ordered = [infos[nm] for nm in snap.node_names]
+    want = oracle_ext.node_affinity_scores(pod, ordered)
+    # counts: n0=10, n1=5, n2=2 -> scores int(10*c/10) = [10, 5, 2]
+    assert list(got[0]) == want == [10, 5, 2]
+
+
+def test_node_affinity_priority_no_preferences_scores_zero():
+    nodes = [make_node("n0"), make_node("n1")]
+    got, snap, infos = kernel_scores([make_pod("p")], nodes,
+                                     (("NodeAffinityPriority", 1),))
+    assert list(got[0]) == [0, 0]
+
+
+def test_prefer_avoid_pods_matches_oracle():
+    annotation = json.dumps({"preferAvoidPods": [
+        {"podSignature": {"podController": {"kind": "ReplicaSet",
+                                            "uid": "rs-1",
+                                            "apiVersion": "v1"}},
+         "reason": "some reason"}]})
+    n0 = make_node("n0")
+    n0.annotations[AVOID_PODS_ANNOTATION] = annotation
+    n1 = make_node("n1")
+    owned = make_pod("owned")
+    owned.owner_kind, owned.owner_uid = "ReplicaSet", "rs-1"
+    other_rs = make_pod("other")
+    other_rs.owner_kind, other_rs.owner_uid = "ReplicaSet", "rs-2"
+    bare = make_pod("bare")
+    got, snap, infos = kernel_scores(
+        [owned, other_rs, bare], [n0, n1],
+        (("NodePreferAvoidPodsPriority", 1),))
+    ordered = [infos[nm] for nm in snap.node_names]
+    for i, pod in enumerate([owned, other_rs, bare]):
+        assert list(got[i]) == oracle_ext.prefer_avoid_scores(pod, ordered)
+    col = {nm: i for i, nm in enumerate(snap.node_names)}
+    assert got[0, col["n0"]] == 0 and got[0, col["n1"]] == 10
+    assert got[1, col["n0"]] == 10  # different RS uid not avoided
+    assert got[2, col["n0"]] == 10  # non-controller pod never avoided
+
+
+def test_image_locality_matches_oracle():
+    Mi_ = 1024 * 1024
+    n0 = make_node("n0")
+    n0.images = [ContainerImage(["nginx:1.13"], 500 * Mi_),
+                 ContainerImage(["redis:3.2", "redis:latest"], 100 * Mi_)]
+    n1 = make_node("n1")
+    n1.images = [ContainerImage(["nginx:1.13"], 10 * Mi_)]  # < 23MB floor
+    n2 = make_node("n2")
+    pod = make_pod("p")
+    pod.containers[0].image = "nginx:1.13"
+    got, snap, infos = kernel_scores([pod], [n0, n1, n2],
+                                     (("ImageLocalityPriority", 1),))
+    ordered = [infos[nm] for nm in snap.node_names]
+    want = oracle_ext.image_locality_scores(pod, ordered)
+    assert list(got[0]) == want
+    col = {nm: i for i, nm in enumerate(snap.node_names)}
+    # 500MB -> int(10*(500-23)/(1000-23))+1 = 5 ; below floor -> 0 ; absent -> 0
+    assert got[0, col["n0"]] == 5
+    assert got[0, col["n1"]] == 0
+    assert got[0, col["n2"]] == 0
+
+
+def test_selector_spread_oracle_zone_weighting():
+    zoneA = {"failure-domain.beta.kubernetes.io/zone": "a"}
+    zoneB = {"failure-domain.beta.kubernetes.io/zone": "b"}
+    nodes = [make_node("a0", labels=zoneA), make_node("a1", labels=zoneA),
+             make_node("b0", labels=zoneB)]
+    svc = WorkloadObject("Service", "web", "default", match_labels={"app": "web"})
+    bound = []
+    for i, nm in enumerate(["a0", "a0", "a1"]):
+        p = make_pod(f"w{i}", labels={"app": "web"})
+        p.node_name = nm
+        bound.append(p)
+    infos = node_info_map(nodes, bound)
+    ctx = oracle_ext.SchedulingContext(infos, [svc])
+    pod = make_pod("new", labels={"app": "web"})
+    ordered = [infos[nm] for nm in sorted(infos)]
+    scores = oracle_ext.selector_spread_scores(pod, ordered, ctx)
+    by = dict(zip(sorted(infos), scores))
+    # counts: a0=2, a1=1, b0=0; zoneA=3, zoneB=0; maxNode=2, maxZone=3
+    # a0: node (2-2)/2*10=0,  zone 0   -> 0
+    # a1: node (2-1)/2*10=5,  zone 0   -> 5*(1/3) = 1
+    # b0: node 10, zone 10             -> 10
+    assert by == {"a0": 0, "a1": 1, "b0": 10}
+
+
+def test_selector_spread_no_owners_scores_max():
+    nodes = [make_node("n0"), make_node("n1")]
+    infos = node_info_map(nodes, [])
+    ctx = oracle_ext.SchedulingContext(infos, [])
+    scores = oracle_ext.selector_spread_scores(
+        make_pod("p"), [infos["n0"], infos["n1"]], ctx)
+    assert scores == [10, 10]
+
+
+def _aff_term(labels, key="zone", namespaces=()):
+    return PodAffinityTerm(
+        label_selector=LabelSelector(match_labels=labels),
+        namespaces=list(namespaces), topology_key=key)
+
+
+def test_interpod_affinity_predicate_oracle():
+    zoneA = {"zone": "a"}
+    zoneB = {"zone": "b"}
+    nodes = [make_node("na", labels=zoneA), make_node("nb", labels=zoneB)]
+    store = make_pod("store", labels={"app": "store"})
+    store.node_name = "na"
+    infos = node_info_map(nodes, [store])
+    ctx = oracle_ext.SchedulingContext(infos)
+    # required affinity to app=store in same zone -> only na
+    web = make_pod("web")
+    web.affinity = Affinity(pod_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "store"})]))
+    assert oracle_ext.inter_pod_affinity_fits(web, nodes[0], ctx)
+    assert not oracle_ext.inter_pod_affinity_fits(web, nodes[1], ctx)
+    # required anti-affinity to app=store in same zone -> only nb
+    anti = make_pod("anti")
+    anti.affinity = Affinity(pod_anti_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "store"})]))
+    assert not oracle_ext.inter_pod_affinity_fits(anti, nodes[0], ctx)
+    assert oracle_ext.inter_pod_affinity_fits(anti, nodes[1], ctx)
+
+
+def test_interpod_affinity_bootstrap_self_match():
+    # first pod of a self-referencing group may schedule anywhere
+    nodes = [make_node("na", labels={"zone": "a"})]
+    infos = node_info_map(nodes, [])
+    ctx = oracle_ext.SchedulingContext(infos)
+    first = make_pod("first", labels={"app": "db"})
+    first.affinity = Affinity(pod_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "db"})]))
+    assert oracle_ext.inter_pod_affinity_fits(first, nodes[0], ctx)
+    # but a pod NOT matching its own term is stuck when no match exists
+    wannabe = make_pod("wannabe", labels={"app": "web"})
+    wannabe.affinity = Affinity(pod_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "db"})]))
+    assert not oracle_ext.inter_pod_affinity_fits(wannabe, nodes[0], ctx)
+
+
+def test_interpod_existing_anti_affinity_symmetry():
+    # an existing pod's required anti-affinity blocks the incoming pod
+    nodes = [make_node("na", labels={"zone": "a"}),
+             make_node("nb", labels={"zone": "b"})]
+    guard = make_pod("guard", labels={"app": "guard"})
+    guard.node_name = "na"
+    guard.affinity = Affinity(pod_anti_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "web"})]))
+    infos = node_info_map(nodes, [guard])
+    ctx = oracle_ext.SchedulingContext(infos)
+    web = make_pod("web", labels={"app": "web"})
+    assert not oracle_ext.inter_pod_affinity_fits(web, nodes[0], ctx)
+    assert oracle_ext.inter_pod_affinity_fits(web, nodes[1], ctx)
+    # unrelated pod unaffected
+    other = make_pod("other", labels={"app": "other"})
+    assert oracle_ext.inter_pod_affinity_fits(other, nodes[0], ctx)
+
+
+def test_interpod_affinity_priority_counts():
+    zoneA = {"zone": "a"}
+    zoneB = {"zone": "b"}
+    nodes = [make_node("na", labels=zoneA), make_node("nb", labels=zoneB)]
+    store = make_pod("store", labels={"app": "store"})
+    store.node_name = "na"
+    infos = node_info_map(nodes, [store])
+    ctx = oracle_ext.SchedulingContext(infos)
+    pod = make_pod("web")
+    pod.affinity = Affinity(pod_affinity=PodAffinity(
+        preferred_terms=[(10, _aff_term({"app": "store"}))]))
+    ordered = [infos[nm] for nm in sorted(infos)]
+    scores = oracle_ext.interpod_affinity_scores(pod, ordered, ctx)
+    # na gets +10 (same zone as store), nb 0 -> normalized [10, 0]
+    assert scores == [10, 0]
+
+
+def test_engine_schedules_affinity_pods_via_host_path():
+    """End-to-end through the engine: affinity pods take the oracle path and
+    land correctly relative to device-placed pods."""
+    from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+    from kubernetes_tpu.state.cache import SchedulerCache
+    cache = SchedulerCache()
+    cache.add_node(make_node("na", labels={"zone": "a"}))
+    cache.add_node(make_node("nb", labels={"zone": "b"}))
+    eng = SchedulingEngine(cache)
+    store = make_pod("store", labels={"app": "store"},
+                     node_selector={"zone": "a"})
+    [r] = eng.schedule([store])
+    assert r.node_name == "na"
+    web = make_pod("web", labels={"app": "web"})
+    web.affinity = Affinity(pod_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "store"})]))
+    [r2] = eng.schedule([web])
+    assert r2.node_name == "na"
+    anti = make_pod("anti")
+    anti.affinity = Affinity(pod_anti_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "store"})]))
+    [r3] = eng.schedule([anti])
+    assert r3.node_name == "nb"
+
+
+def test_engine_symmetry_blocks_non_affinity_pod():
+    """Regression: a plain pod matching an EXISTING pod's required
+    anti-affinity must not be placed by the device fast path onto a
+    conflicting topology (predicates.go:1146 symmetry)."""
+    from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+    from kubernetes_tpu.state.cache import SchedulerCache
+    cache = SchedulerCache()
+    cache.add_node(make_node("na", labels={"zone": "a"}))
+    cache.add_node(make_node("nb", labels={"zone": "b"}))
+    guard = make_pod("guard", labels={"app": "guard"})
+    guard.node_name = "na"
+    guard.affinity = Affinity(pod_anti_affinity=PodAffinity(
+        required_terms=[_aff_term({"app": "web"})]))
+    cache.add_pod(guard)
+    eng = SchedulingEngine(cache)
+    web = make_pod("web", labels={"app": "web"})  # NO affinity of its own
+    [r] = eng.schedule([web])
+    assert r.node_name == "nb"
+    # and a second web pod has nowhere to go once nb hosts... nothing blocks
+    # nb, so it also lands on nb
+    web2 = make_pod("web2", labels={"app": "web"})
+    [r2] = eng.schedule([web2])
+    assert r2.node_name == "nb"
+    # unrelated pod is unaffected and uses the fast path
+    other = make_pod("other", labels={"app": "other"})
+    [r3] = eng.schedule([other])
+    assert r3.node_name is not None

@@ -148,3 +148,14 @@ def test_providers():
     assert ("MostRequestedPriority", 1) in ca
     assert ("LeastRequestedPriority", 1) not in ca
     assert ("NodePreferAvoidPodsPriority", 10000) in dp
+
+
+def test_decode_pod_owner_uid():
+    # regression: uid drop silently disabled NodePreferAvoidPods matching
+    pod = serde.decode_pod({
+        "metadata": {"name": "p", "ownerReferences": [
+            {"kind": "ReplicaSet", "name": "rs", "uid": "u-42",
+             "controller": True}]},
+        "spec": {"containers": []}})
+    assert pod.owner_uid == "u-42"
+    assert pod.owner_kind == "ReplicaSet"
