@@ -37,6 +37,8 @@ from kubernetes_tpu.api.types import (
     TaintEffect,
     Toleration,
     TolerationOperator,
+    Volume,
+    VolumeKind,
 )
 
 _SUFFIX = {
@@ -168,6 +170,67 @@ def decode_affinity(aff: Optional[Dict]) -> Optional[Affinity]:
 # ---------------------------------------------------------------------------
 
 
+def decode_volume(v: Dict[str, Any]) -> Volume:
+    """v1 VolumeSource union -> scheduler-relevant identity
+    (the sources read by predicates.go:128-374; others -> OTHER)."""
+    name = v.get("name", "")
+    if "gcePersistentDisk" in v:
+        s = v["gcePersistentDisk"] or {}
+        return Volume(name=name, kind=VolumeKind.GCE_PD,
+                      volume_id=s.get("pdName", ""),
+                      read_only=bool(s.get("readOnly", False)))
+    if "awsElasticBlockStore" in v:
+        s = v["awsElasticBlockStore"] or {}
+        return Volume(name=name, kind=VolumeKind.AWS_EBS,
+                      volume_id=s.get("volumeID", ""),
+                      read_only=bool(s.get("readOnly", False)))
+    if "rbd" in v:
+        s = v["rbd"] or {}
+        return Volume(name=name, kind=VolumeKind.RBD,
+                      monitors=list(s.get("monitors") or []),
+                      pool=s.get("pool", ""), image=s.get("image", ""),
+                      read_only=bool(s.get("readOnly", False)))
+    if "iscsi" in v:
+        s = v["iscsi"] or {}
+        return Volume(name=name, kind=VolumeKind.ISCSI,
+                      volume_id=s.get("iqn", ""),
+                      read_only=bool(s.get("readOnly", False)))
+    if "azureDisk" in v:
+        s = v["azureDisk"] or {}
+        return Volume(name=name, kind=VolumeKind.AZURE_DISK,
+                      volume_id=s.get("diskName", ""),
+                      read_only=bool(s.get("readOnly", False)))
+    if "persistentVolumeClaim" in v:
+        s = v["persistentVolumeClaim"] or {}
+        return Volume(name=name, kind=VolumeKind.PVC,
+                      volume_id=s.get("claimName", ""),
+                      read_only=bool(s.get("readOnly", False)))
+    return Volume(name=name, kind=VolumeKind.OTHER)
+
+
+def encode_volume(v: Volume) -> Dict[str, Any]:
+    kind = VolumeKind(v.kind)
+    out: Dict[str, Any] = {"name": v.name}
+    if kind == VolumeKind.GCE_PD:
+        out["gcePersistentDisk"] = {"pdName": v.volume_id,
+                                    "readOnly": v.read_only}
+    elif kind == VolumeKind.AWS_EBS:
+        out["awsElasticBlockStore"] = {"volumeID": v.volume_id,
+                                       "readOnly": v.read_only}
+    elif kind == VolumeKind.RBD:
+        out["rbd"] = {"monitors": list(v.monitors), "pool": v.pool,
+                      "image": v.image, "readOnly": v.read_only}
+    elif kind == VolumeKind.ISCSI:
+        out["iscsi"] = {"iqn": v.volume_id, "readOnly": v.read_only}
+    elif kind == VolumeKind.AZURE_DISK:
+        out["azureDisk"] = {"diskName": v.volume_id,
+                            "readOnly": v.read_only}
+    elif kind == VolumeKind.PVC:
+        out["persistentVolumeClaim"] = {"claimName": v.volume_id,
+                                        "readOnly": v.read_only}
+    return out
+
+
 def decode_pod(obj: Dict[str, Any]) -> Pod:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
@@ -207,6 +270,7 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
         labels=dict(meta.get("labels") or {}),
         annotations=dict(meta.get("annotations") or {}),
         containers=containers,
+        volumes=[decode_volume(v) for v in spec.get("volumes") or []],
         node_name=spec.get("nodeName", ""),
         node_selector=dict(spec.get("nodeSelector") or {}),
         affinity=decode_affinity(spec.get("affinity")),
@@ -276,7 +340,8 @@ def encode_pod(pod: Pod) -> Dict[str, Any]:
                      "uid": pod.uid, "labels": pod.labels},
         "spec": {"containers": containers, "nodeName": pod.node_name,
                  "nodeSelector": pod.node_selector,
-                 "schedulerName": pod.scheduler_name},
+                 "schedulerName": pod.scheduler_name,
+                 "volumes": [encode_volume(v) for v in pod.volumes]},
     }
 
 

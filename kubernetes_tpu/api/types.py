@@ -249,6 +249,79 @@ class Toleration:
 
 
 # ---------------------------------------------------------------------------
+# Volumes
+# ---------------------------------------------------------------------------
+
+
+class VolumeKind(str, enum.Enum):
+    """The volume-source kinds the scheduler's volume predicates read
+    (reference: predicates.go:128-177 isVolumeConflict + the EBS/GCEPD/
+    AzureDisk VolumeFilters at predicates.go:324-374). Other sources
+    (EmptyDir, ConfigMap, Secret, HostPath, NFS, ...) are scheduling-inert
+    and collapse to OTHER."""
+
+    GCE_PD = "GCEPersistentDisk"
+    AWS_EBS = "AWSElasticBlockStore"
+    RBD = "RBD"
+    ISCSI = "ISCSI"
+    AZURE_DISK = "AzureDisk"
+    PVC = "PersistentVolumeClaim"
+    OTHER = "Other"
+
+
+@dataclass
+class Volume:
+    """One pod-spec volume, reduced to scheduler-relevant identity fields.
+
+    volume_id carries the per-kind identity: PDName (GCE), VolumeID (EBS),
+    DiskName (AzureDisk), IQN (ISCSI), claim name (PVC). RBD identity is
+    (any shared monitor, pool, image) — predicates.go:163-172."""
+
+    name: str = ""
+    kind: VolumeKind = VolumeKind.OTHER
+    volume_id: str = ""
+    read_only: bool = False
+    monitors: List[str] = field(default_factory=list)  # RBD CephMonitors
+    pool: str = ""  # RBD RBDPool
+    image: str = ""  # RBD RBDImage
+
+
+# PV node-affinity alpha annotation — v1.AlphaStorageNodeAffinityAnnotation
+# (staging/src/k8s.io/api/core/v1/types.go; read by
+# pkg/api/v1/helper/helpers.go:418 GetStorageNodeAffinityFromAnnotation)
+ALPHA_STORAGE_NODE_AFFINITY_ANNOTATION = \
+    "volume.alpha.kubernetes.io/node-affinity"
+
+
+@dataclass
+class PersistentVolume:
+    """Cluster-scoped PV, reduced to what VolumeZone / MaxPDVolumeCount /
+    VolumeNode read: zone labels, the backing source, and (alpha) node
+    affinity (reference: predicates.go:376-474, pkg/volume/util/util.go:193
+    CheckNodeAffinity)."""
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    source: Volume = field(default_factory=Volume)
+    # RequiredDuringScheduling node-selector terms; unlike pod node affinity
+    # these are ANDed (util.go:202-214 loops ALL terms, each must match)
+    node_affinity_terms: Optional[List["NodeSelectorTerm"]] = None
+    resource_version: int = 0
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """Namespaced PVC: binds a pod volume to a PV by name
+    (pvc.Spec.VolumeName — predicates.go:253-262)."""
+
+    name: str
+    namespace: str = "default"
+    volume_name: str = ""  # bound PV name; empty = unbound
+    resource_version: int = 0
+
+
+# ---------------------------------------------------------------------------
 # Pod
 # ---------------------------------------------------------------------------
 
@@ -279,6 +352,7 @@ class Pod:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
     node_name: str = ""  # spec.nodeName; non-empty once bound
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity: Optional[Affinity] = None
@@ -466,6 +540,7 @@ def make_pod(
     node_name: str = "",
     owner: Tuple[str, str] = ("", ""),
     extended: Optional[Dict[str, int]] = None,
+    volumes: Optional[List[Volume]] = None,
 ) -> Pod:
     """Test/bench convenience constructor (one container)."""
     requests: Dict[str, int] = {}
@@ -488,6 +563,7 @@ def make_pod(
         uid=namespace + "/" + name,
         labels=labels or {},
         containers=[container],
+        volumes=volumes or [],
         node_selector=node_selector or {},
         tolerations=tolerations or [],
         affinity=affinity,

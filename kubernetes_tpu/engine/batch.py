@@ -43,17 +43,25 @@ Arrays = Dict[str, jnp.ndarray]
 
 class NodeState(NamedTuple):
     """The mutable (carry) slice of node state. Static facts (alloc, labels,
-    taints, allowed_pods, conditions) stay outside the carry."""
+    taints, allowed_pods, conditions) stay outside the carry. Volume
+    presence/counts are carried because NoDiskConflict / MaxPDVolumeCount
+    are capacity-dependent: a pod committing its volumes must be visible to
+    the next pod in the batch (assume semantics)."""
 
     requested: jnp.ndarray  # int32 [N,R]
     nonzero: jnp.ndarray  # int32 [N,2]
     pod_count: jnp.ndarray  # int32 [N]
     port_bitmap: jnp.ndarray  # uint32 [N,W]
+    vol_present: jnp.ndarray  # int8 [N,Vc] conflict-key presence
+    vol_rw: jnp.ndarray  # int8 [N,Vc] read-write presence
+    pd_present: jnp.ndarray  # int8 [N,Vpd]
+    pd_counts: jnp.ndarray  # int32 [N,3] distinct filtered vols per kind
 
 
 def node_state(nodes: Arrays) -> NodeState:
     return NodeState(nodes["requested"], nodes["nonzero"], nodes["pod_count"],
-                     nodes["port_bitmap"])
+                     nodes["port_bitmap"], nodes["vol_present"],
+                     nodes["vol_rw"], nodes["pd_present"], nodes["pd_counts"])
 
 
 # priorities whose per-node score depends only on node spec + pod (no carry,
@@ -101,7 +109,9 @@ def _step_scores(pod_nonzero: jnp.ndarray, state: NodeState, alloc: jnp.ndarray,
 
 def _commit(state: NodeState, sel: jnp.ndarray, ok: jnp.ndarray,
             pod_req: jnp.ndarray, pod_nonzero: jnp.ndarray,
-            pod_ports: jnp.ndarray) -> NodeState:
+            pod_ports: jnp.ndarray, pod_vol_hard: jnp.ndarray,
+            pod_vol_ro: jnp.ndarray, pod_pd_req: jnp.ndarray,
+            pd_new_sel: jnp.ndarray) -> NodeState:
     """Decrement capacity at the selected node (the on-device AssumePod)."""
     safe = jnp.where(ok, sel, 0)
     gain = ok.astype(jnp.int32)
@@ -120,7 +130,17 @@ def _commit(state: NodeState, sel: jnp.ndarray, ok: jnp.ndarray,
     row = state.port_bitmap[safe].at[words].add(bits)
     port_bitmap = state.port_bitmap.at[safe].set(
         jnp.where(ok, row, state.port_bitmap[safe]))
-    return NodeState(requested, nonzero, pod_count, port_bitmap)
+    # volume commit: presence is an OR (int8 max); pd_counts grows by the
+    # number of distinct new ids the pod brought to this node
+    zero8 = jnp.zeros_like(pod_vol_hard)
+    presence = jnp.where(ok, pod_vol_hard | pod_vol_ro, zero8)
+    vol_present = state.vol_present.at[safe].max(presence)
+    vol_rw = state.vol_rw.at[safe].max(jnp.where(ok, pod_vol_hard, zero8))
+    pd_present = state.pd_present.at[safe].max(
+        jnp.where(ok, pod_pd_req, jnp.zeros_like(pod_pd_req)))
+    pd_counts = state.pd_counts.at[safe].add(pd_new_sel * gain)
+    return NodeState(requested, nonzero, pod_count, port_bitmap,
+                     vol_present, vol_rw, pd_present, pd_counts)
 
 
 @functools.partial(jax.jit, static_argnames=("priorities",))
@@ -155,13 +175,35 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
             static_score = static_score + \
                 prio.PRIORITY_REGISTRY[name](pods, nodes, None) * weight
 
+    pd_kind = nodes["pd_kind"]
+    pd_max = nodes["pd_max"]
+
     def step(carry, xs):
         state, counter = carry
-        p_static, p_tt, p_na, p_sscore, p_req, p_zero, p_nonzero, p_ports = xs
+        (p_static, p_tt, p_na, p_sscore, p_req, p_zero, p_nonzero, p_ports,
+         p_vol_hard, p_vol_ro, p_pd_req, p_pd_count) = xs
+        # NoDiskConflict against the evolving presence (int8 matvecs)
+        hard_hit = jnp.einsum("nv,v->n", state.vol_present, p_vol_hard,
+                              preferred_element_type=jnp.int32)
+        ro_hit = jnp.einsum("nv,v->n", state.vol_rw, p_vol_ro,
+                            preferred_element_type=jnp.int32)
+        disk_ok = (hard_hit == 0) & (ro_hit == 0)
+        # MaxPDVolumeCount per filter kind against evolving counts
+        pd_ok = jnp.ones_like(disk_ok)
+        pd_new = []
+        for k in range(3):
+            req_k = p_pd_req * pd_kind[k]
+            overlap = jnp.einsum("nv,v->n", state.pd_present, req_k,
+                                 preferred_element_type=jnp.int32)
+            new_k = p_pd_count[k] - overlap
+            pd_new.append(new_k)
+            pd_ok = pd_ok & ((p_pd_count[k] == 0)
+                             | (state.pd_counts[:, k] + new_k <= pd_max[k]))
         dyn = (
             preds.resources_fit(p_req[None], p_zero[None], alloc, state.requested)[0]
             & preds.pod_count_fit(state.pod_count, allowed)
             & preds.ports_fit(p_ports[None], state.port_bitmap)[0]
+            & disk_ok & pd_ok
         )
         fits = p_static & dyn
         fit_count = fits.sum().astype(jnp.int32)
@@ -181,11 +223,16 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
                         jnp.where(fit_count == 1, one_sel, rr_sel))
         ok = fit_count > 0
         counter = counter + jnp.where(fit_count > 1, jnp.uint32(1), jnp.uint32(0))
-        new_state = _commit(state, sel, ok, p_req, p_nonzero, p_ports)
+        safe_sel = jnp.where(ok, sel, 0)
+        pd_new_sel = jnp.stack([n[safe_sel] for n in pd_new])  # [3]
+        new_state = _commit(state, sel, ok, p_req, p_nonzero, p_ports,
+                            p_vol_hard, p_vol_ro, p_pd_req, pd_new_sel)
         return (new_state, counter), (sel, fit_count)
 
     xs = (static_fit, tt_cnt, na_cnt, static_score, pods["req"],
-          pods["zero_req"], pods["nonzero"], pods["ports"])
+          pods["zero_req"], pods["nonzero"], pods["ports"],
+          pods["vol_hard"], pods["vol_ro"], pods["pd_req"],
+          pods["pd_req_count"])
     (state, rr_counter), (selected, fit_counts) = lax.scan(
         step, (state, rr_counter), xs)
     return selected, fit_counts, state, rr_counter

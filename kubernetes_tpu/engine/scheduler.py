@@ -69,6 +69,7 @@ class Scheduler:
 
     WORKLOAD_KINDS = ("Service", "ReplicationController", "ReplicaSet",
                       "StatefulSet")
+    VOLUME_KINDS = ("PersistentVolume", "PersistentVolumeClaim")
 
     def start(self) -> None:
         """Initial List (reflector handshake): nodes + pods into cache/queue."""
@@ -79,6 +80,12 @@ class Scheduler:
             for w in self.api.list(kind)[0]:
                 self._workloads[kind + "/" + getattr(w, "namespace", "")
                                 + "/" + w.name] = w
+        vctx = self.engine.volume_ctx
+        for pv in self.api.list("PersistentVolume")[0]:
+            vctx.pvs[pv.name] = pv
+        for pvc in self.api.list("PersistentVolumeClaim")[0]:
+            vctx.pvcs[(pvc.namespace, pvc.name)] = pvc
+        vctx.version += 1
         pods, rv = self.api.list("Pod")
         for p in pods:
             self._pods[p.key()] = p
@@ -96,8 +103,9 @@ class Scheduler:
             self.start()
             return 0
         try:
-            events = self.api.watch_since(("Pod", "Node") + self.WORKLOAD_KINDS,
-                                          self._rv, timeout=wait)
+            events = self.api.watch_since(
+                ("Pod", "Node") + self.WORKLOAD_KINDS + self.VOLUME_KINDS,
+                self._rv, timeout=wait)
         except TooOldResourceVersion:
             self._relist()
             return 0
@@ -107,6 +115,8 @@ class Scheduler:
                 self._on_node_event(ev.type, ev.obj)
             elif ev.kind == "Pod":
                 self._on_pod_event(ev.type, ev.obj)
+            elif ev.kind in self.VOLUME_KINDS:
+                self._on_volume_event(ev.kind, ev.type, ev.obj)
             else:
                 key = (ev.kind + "/" + getattr(ev.obj, "namespace", "")
                        + "/" + ev.obj.name)
@@ -184,6 +194,24 @@ class Scheduler:
 
     def _responsible_for(self, pod: Pod) -> bool:
         return (pod.scheduler_name or DEFAULT_SCHEDULER_NAME) == self.scheduler_name
+
+    def _on_volume_event(self, kind: str, etype: str, obj) -> None:
+        """PV/PVC informer handlers (factory.go:120-140 wires both; events
+        invalidate the equivalence cache there — here they bump the
+        VolumeContext version so the snapshot re-resolves PD rows)."""
+        vctx = self.engine.volume_ctx
+        if kind == "PersistentVolume":
+            if etype == "DELETED":
+                vctx.pvs.pop(obj.name, None)
+            else:
+                vctx.pvs[obj.name] = obj
+        else:
+            key = (obj.namespace, obj.name)
+            if etype == "DELETED":
+                vctx.pvcs.pop(key, None)
+            else:
+                vctx.pvcs[key] = obj
+        vctx.version += 1
 
     def _on_node_event(self, etype: str, node: Node) -> None:
         if etype == "DELETED":

@@ -52,10 +52,15 @@ class SchedulingEngine:
     def __init__(self, cache: SchedulerCache,
                  priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
                  mem_shift: int = 10, workloads_provider=None,
-                 hard_pod_affinity_weight: int = 1):
+                 hard_pod_affinity_weight: int = 1,
+                 volume_ctx=None):
+        from kubernetes_tpu.state.volumes import VolumeContext
         self.cache = cache
         self.priorities = priorities
         self.snapshot = ClusterSnapshot(mem_shift=mem_shift)
+        # PV/PVC mirror (the pvInfo/pvcInfo listers of factory.go); the
+        # owner (Scheduler) mutates it and bumps .version on watch events
+        self.volume_ctx = volume_ctx if volume_ctx is not None else VolumeContext()
         self.rr = oracle.RoundRobin()  # shared counter, device + oracle paths
         # Service/RC/RS/SS objects for spreading & service affinity — the
         # factory's extra informers (factory.go:120-140)
@@ -74,7 +79,7 @@ class SchedulingEngine:
         if not pods:
             return []
         infos = self.cache.node_infos()
-        self.snapshot.refresh(infos)
+        self.snapshot.refresh(infos, volume_ctx=self.volume_ctx)
         # PodBatch first: selector compilation may grow the label vocab and
         # rebuild the label matrix; upload happens after, dirty-arrays only
         batch = PodBatch(pods, self.snapshot)
@@ -114,7 +119,9 @@ class SchedulingEngine:
                 fast_batch = PodBatch([pods[i] for i in fast_idx], self.snapshot)
             parr = pod_arrays(fast_batch)
             state = NodeState(nodes["requested"], nodes["nonzero"],
-                              nodes["pod_count"], nodes["port_bitmap"])
+                              nodes["pod_count"], nodes["port_bitmap"],
+                              nodes["vol_present"], nodes["vol_rw"],
+                              nodes["pd_present"], nodes["pd_counts"])
             selected, fit_counts, _, rr_end = place_batch(
                 parr, nodes, state, jnp.uint32(self.rr.counter),
                 self.priorities)
@@ -136,7 +143,8 @@ class SchedulingEngine:
             names = self.snapshot.node_names
             ctx = SchedulingContext(
                 infos, self.workloads_provider(),
-                hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+                hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                volume_ctx=self.volume_ctx)
             for i in slow_idx:
                 name = oracle.schedule_one(pods[i], names, infos, self.rr,
                                            self.priorities, ctx)
@@ -159,7 +167,8 @@ class SchedulingEngine:
                         "allowed_pods", "schedulable", "mem_pressure",
                         "disk_pressure", "labels", "taints_sched",
                         "taints_pref", "port_bitmap", "valid", "avoid",
-                        "image_sizes")
+                        "image_sizes", "has_zone", "vol_present", "vol_rw",
+                        "pd_present", "pd_counts", "pd_kind", "pd_max")
 
     def _nodes_on_device(self):
         """Incremental host->HBM sync: re-upload an array only when its shape

@@ -124,9 +124,9 @@ def check_disk_pressure(node: Node) -> bool:
 
 
 def pod_fits(pod: Pod, info: NodeInfo, ctx=None, affinity_meta=None) -> bool:
-    """Default-provider predicate chain (defaults.go:118): GeneralPredicates
-    + taints + conditions + (with a SchedulingContext) MatchInterPodAffinity.
-    Volume predicates pending (SURVEY.md §7 step 7)."""
+    """Default-provider predicate chain (defaults.go:118): volume predicates
+    + GeneralPredicates + taints + conditions + (with a SchedulingContext)
+    MatchInterPodAffinity."""
     node = info.node
     if node is None:
         return False
@@ -139,6 +139,10 @@ def pod_fits(pod: Pod, info: NodeInfo, ctx=None, affinity_meta=None) -> bool:
           and check_node_condition(node)
           and check_memory_pressure(pod, node)
           and check_disk_pressure(node))
+    if ok and pod.volumes:
+        from kubernetes_tpu.ops.oracle_volumes import volume_predicates_fit
+        ok = volume_predicates_fit(
+            pod, info, getattr(ctx, "volume_ctx", None))
     if ok and ctx is not None:
         from kubernetes_tpu.ops.oracle_ext import inter_pod_affinity_fits
         ok = inter_pod_affinity_fits(pod, node, ctx, affinity_meta)

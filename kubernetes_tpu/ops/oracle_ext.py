@@ -30,7 +30,7 @@ from kubernetes_tpu.state.node_info import NodeInfo
 
 ZONE_REGION_LABEL = "failure-domain.beta.kubernetes.io/region"
 ZONE_LABEL = "failure-domain.beta.kubernetes.io/zone"
-AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+from kubernetes_tpu.api.annotations import AVOID_PODS_ANNOTATION  # noqa: E402
 
 MB = 1024 * 1024
 MIN_IMG_SIZE = 23 * MB
@@ -44,10 +44,13 @@ class SchedulingContext:
 
     def __init__(self, infos: Dict[str, NodeInfo],
                  workloads: Sequence[WorkloadObject] = (),
-                 hard_pod_affinity_weight: int = 1):
+                 hard_pod_affinity_weight: int = 1,
+                 volume_ctx=None):
         self.infos = infos
         self.workloads = list(workloads)
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        # PV/PVC mirror for the volume predicates (state/volumes.VolumeContext)
+        self.volume_ctx = volume_ctx
         self._all_pods: Optional[List[Tuple[Pod, Optional[Node]]]] = None
         self._affinity_pods: Optional[List[Tuple[Pod, Optional[Node]]]] = None
 
@@ -337,21 +340,13 @@ def node_affinity_scores(pod: Pod, filtered: Sequence[NodeInfo]) -> List[int]:
 
 
 def node_avoids_pod(node: Node, pod: Pod) -> bool:
-    """node_prefer_avoid_pods.go:29-60 + GetAvoidPodsFromNodeAnnotations."""
+    """node_prefer_avoid_pods.go:29-60 + GetAvoidPodsFromNodeAnnotations
+    (parsing shared with the snapshot path — api/annotations.py)."""
     if pod.owner_kind not in ("ReplicationController", "ReplicaSet"):
         return False
-    raw = node.annotations.get(AVOID_PODS_ANNOTATION)
-    if not raw:
-        return False
-    try:
-        avoids = json.loads(raw)
-    except ValueError:
-        return False
-    for avoid in avoids.get("preferAvoidPods", []):
-        ctrl = (avoid.get("podSignature") or {}).get("podController") or {}
-        if ctrl.get("kind") == pod.owner_kind and ctrl.get("uid") == pod.owner_uid:
-            return True
-    return False
+    from kubernetes_tpu.api.annotations import parse_avoid_annotation
+    return (pod.owner_kind, pod.owner_uid) in \
+        parse_avoid_annotation(node.annotations)
 
 
 def prefer_avoid_scores(pod: Pod, filtered: Sequence[NodeInfo]) -> List[int]:

@@ -59,6 +59,13 @@ def node_arrays(snap) -> Arrays:
         "valid": jnp.asarray(snap.valid),
         "avoid": jnp.asarray(snap.avoid),
         "image_sizes": jnp.asarray(snap.image_sizes),
+        "vol_present": jnp.asarray(snap.vol_present),
+        "vol_rw": jnp.asarray(snap.vol_rw),
+        "pd_present": jnp.asarray(snap.pd_present),
+        "pd_counts": jnp.asarray(snap.pd_counts),
+        "pd_kind": jnp.asarray(snap.pd_kind),
+        "pd_max": jnp.asarray(snap.pd_max),
+        "has_zone": jnp.asarray(snap.has_zone),
     }
 
 
@@ -92,6 +99,18 @@ def pod_arrays(batch) -> Arrays:
         "pref_weight": jnp.asarray(batch.pref_weight),
         "avoid_idx": jnp.asarray(batch.avoid_idx),
         "img_count": jnp.asarray(batch.img_count),
+        "vol_hard": jnp.asarray(batch.vol_hard),
+        "vol_ro": jnp.asarray(batch.vol_ro),
+        "pd_req": jnp.asarray(batch.pd_req),
+        "pd_req_count": jnp.asarray(batch.pd_req_count),
+        "vz_req": jnp.asarray(batch.vz_req),
+        "vz_err": jnp.asarray(batch.vz_err),
+        "pvaff_req_all": jnp.asarray(batch.pvaff_req_all),
+        "pvaff_req_any": jnp.asarray(batch.pvaff_req_any),
+        "pvaff_forbid": jnp.asarray(batch.pvaff_forbid),
+        "pvaff_any_used": jnp.asarray(batch.pvaff_any_used),
+        "pvaff_unsat": jnp.asarray(batch.pvaff_unsat),
+        "pvaff_has": jnp.asarray(batch.pvaff_has),
     }
 
 
@@ -154,9 +173,77 @@ def ports_fit(ports: jnp.ndarray, port_bitmap: jnp.ndarray) -> jnp.ndarray:
     return ~conflict.T
 
 
+def no_disk_conflict(vol_hard: jnp.ndarray, vol_ro: jnp.ndarray,
+                     vol_present: jnp.ndarray, vol_rw: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """NoDiskConflict (predicates.go:183-196) as two int8 matmuls over the
+    conflict-key vocab: a HARD key (EBS, or any read-write mount) conflicts
+    with any presence; an RO key conflicts only with a read-write mount.
+    vol_hard/vol_ro [P,Vc]; vol_present/vol_rw [N,Vc] -> bool [P,N]."""
+    hard_hit = jnp.einsum("pv,nv->pn", vol_hard, vol_present,
+                          preferred_element_type=jnp.int32)
+    ro_hit = jnp.einsum("pv,nv->pn", vol_ro, vol_rw,
+                        preferred_element_type=jnp.int32)
+    return (hard_hit == 0) & (ro_hit == 0)
+
+
+def max_pd_fit(pd_req: jnp.ndarray, pd_req_count: jnp.ndarray,
+               pd_kind: jnp.ndarray, pd_present: jnp.ndarray,
+               pd_counts: jnp.ndarray, pd_max: jnp.ndarray) -> jnp.ndarray:
+    """MaxPDVolumeCount for all three filters (predicates.go:285-323):
+    numExisting + numNew <= max, where numNew = pod's distinct filtered ids
+    not already on the node; a pod with no kind-f volumes passes filter f
+    (the quick return at :297-300).
+
+    pd_req [P,Vpd], pd_req_count [P,3], pd_kind [3,Vpd], pd_present [N,Vpd],
+    pd_counts [N,3], pd_max [3] -> bool [P,N]."""
+    fit = None
+    for k in range(3):
+        req_k = pd_req * pd_kind[k][None, :]  # [P,Vpd] int8
+        overlap = jnp.einsum("pv,nv->pn", req_k, pd_present,
+                             preferred_element_type=jnp.int32)
+        new = pd_req_count[:, k][:, None] - overlap
+        ok = ((pd_req_count[:, k][:, None] == 0)
+              | (pd_counts[None, :, k] + new <= pd_max[k]))
+        fit = ok if fit is None else fit & ok
+    return fit
+
+
 # ---------------------------------------------------------------------------
 # capacity-independent predicates (computed once per batch, MXU matmuls)
 # ---------------------------------------------------------------------------
+
+
+def volume_zone_fit(vz_req: jnp.ndarray, vz_err: jnp.ndarray,
+                    labels: jnp.ndarray, has_zone: jnp.ndarray) -> jnp.ndarray:
+    """NoVolumeZoneConflict (predicates.go:404-474): nodes with no
+    zone/region labels pass (fast-path BEFORE PVC resolution, so resolution
+    errors — vz_err — fail only zone-labeled nodes); otherwise every
+    (zone-key, value) pair demanded by the pod's bound PVs must be present.
+    vz_req [P,L] over the label-pair vocab; labels [N,L]; has_zone [N]."""
+    cnt = jnp.einsum("pl,nl->pn", vz_req, labels.astype(jnp.int8),
+                     preferred_element_type=jnp.int32)
+    need = vz_req.astype(jnp.int32).sum(axis=-1)[:, None]
+    return (~has_zone[None, :]) | ((cnt == need) & ~vz_err[:, None])
+
+
+def pv_affinity_fit(pods: Arrays, labels: jnp.ndarray) -> jnp.ndarray:
+    """NoVolumeNodeConflict (predicates.go:1354-1411 + util.go:193): the
+    pod's bound PVs' node-affinity requirements, ANDed into one conjunct,
+    evaluated like one selector term. Pass-through for pods without PV
+    affinity (pvaff_has False)."""
+    lab = labels.astype(jnp.int8)
+    all_cnt = jnp.einsum("pl,nl->pn", pods["pvaff_req_all"], lab,
+                         preferred_element_type=jnp.int32)
+    need = pods["pvaff_req_all"].astype(jnp.int32).sum(axis=-1)[:, None]
+    forbid_cnt = jnp.einsum("pl,nl->pn", pods["pvaff_forbid"], lab,
+                            preferred_element_type=jnp.int32)
+    any_cnt = jnp.einsum("pal,nl->pan", pods["pvaff_req_any"], lab,
+                         preferred_element_type=jnp.int32)
+    any_ok = ((any_cnt > 0) | ~pods["pvaff_any_used"][:, :, None]).all(axis=1)
+    ok = ((all_cnt == need) & (forbid_cnt == 0) & any_ok
+          & ~pods["pvaff_unsat"][:, None])
+    return ok | ~pods["pvaff_has"][:, None]
 
 
 def selector_fit(pods: Arrays, labels: jnp.ndarray) -> jnp.ndarray:
@@ -219,7 +306,11 @@ def static_fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
         & taints_fit(pods["intolerated"], nodes["taints_sched"])
         & host_fit(pods["has_host"], pods["host_required"], n)
         & node_condition_fit(pods, nodes)
-        & ~pods["impossible"][:, None]  # ext resource no node advertises
+        & volume_zone_fit(pods["vz_req"], pods["vz_err"], nodes["labels"],
+                          nodes["has_zone"])
+        & pv_affinity_fit(pods, nodes["labels"])
+        & ~pods["impossible"][:, None]  # ext resource no node advertises /
+        # unresolvable PVC (predicate error in the reference)
     )
 
 
@@ -236,6 +327,10 @@ def fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
         & resources_fit(pods["req"], pods["zero_req"], nodes["alloc"], nodes["requested"])
         & pod_count_fit(nodes["pod_count"], nodes["allowed_pods"])[None, :]
         & ports_fit(pods["ports"], nodes["port_bitmap"])
+        & no_disk_conflict(pods["vol_hard"], pods["vol_ro"],
+                           nodes["vol_present"], nodes["vol_rw"])
+        & max_pd_fit(pods["pd_req"], pods["pd_req_count"], nodes["pd_kind"],
+                     nodes["pd_present"], nodes["pd_counts"], nodes["pd_max"])
     )
 
 

@@ -32,7 +32,8 @@ NODE_AXIS = "nodes"
 _NODE_SHARDED_KEYS = frozenset({
     "alloc", "requested", "nonzero", "pod_count", "allowed_pods",
     "schedulable", "mem_pressure", "disk_pressure", "labels", "taints_sched",
-    "taints_pref", "port_bitmap", "valid",
+    "taints_pref", "port_bitmap", "valid", "avoid", "image_sizes",
+    "has_zone", "vol_present", "vol_rw", "pd_present", "pd_counts",
 })
 
 

@@ -55,6 +55,7 @@ from kubernetes_tpu.api.types import (
     Toleration,
 )
 from kubernetes_tpu.state.node_info import NodeInfo
+from kubernetes_tpu.state import volumes as volmod
 
 # Base resource columns (extended resources follow, via vocab)
 R_CPU, R_MEM, R_GPU, R_SCRATCH, R_OVERLAY = 0, 1, 2, 3, 4
@@ -69,27 +70,10 @@ def _pad(n: int, to: int = 8) -> int:
     return max(to, ((n + to - 1) // to) * to)
 
 
-AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
-
-
-def _parse_avoid_annotation(annotations: Dict[str, str]) -> List[Tuple[str, str]]:
-    """-> [(kind, uid)] from the preferAvoidPods node annotation
-    (reference: pkg/api/v1/helper GetAvoidPodsFromNodeAnnotations;
-    node_prefer_avoid_pods.go:48-58). Malformed JSON -> no avoidance."""
-    raw = annotations.get(AVOID_PODS_ANNOTATION)
-    if not raw:
-        return []
-    import json
-    try:
-        avoids = json.loads(raw)
-    except ValueError:
-        return []
-    out = []
-    for avoid in avoids.get("preferAvoidPods", []):
-        ctrl = (avoid.get("podSignature") or {}).get("podController") or {}
-        if ctrl.get("kind") and ctrl.get("uid"):
-            out.append((ctrl["kind"], ctrl["uid"]))
-    return out
+from kubernetes_tpu.api.annotations import (  # shared with ops.oracle_ext
+    AVOID_PODS_ANNOTATION,
+    parse_avoid_annotation as _parse_avoid_annotation,
+)
 
 
 class Vocab:
@@ -155,7 +139,7 @@ class ClusterSnapshot:
     DYNAMIC = ("requested", "nonzero", "pod_count")
     STATIC = ("alloc", "allowed_pods", "schedulable", "mem_pressure",
               "disk_pressure", "labels", "taints_sched", "taints_pref", "valid",
-              "avoid", "image_sizes")
+              "avoid", "image_sizes", "has_zone")
 
     def __init__(self, mem_shift: int = 10, node_pad: int = 8):
         self.mem_shift = mem_shift
@@ -181,6 +165,20 @@ class ClusterSnapshot:
         self._row_images: List[list] = []
         self._images_width = _pad(0, 4)
         self._image_vocab_dirty = False
+        # Volume predicates: demand-driven vocabs of conflict keys
+        # (NoDiskConflict) and PD ids (MaxPDVolumeCount). Presence matrices
+        # rebuilt on vocab growth like images; pd_counts (unique filtered
+        # volumes per node per kind) is vocab-independent host math.
+        self.conflict_vocab = Vocab()
+        self.pd_vocab = Vocab()  # key = "<kind_idx>\x00<id>"
+        self.pd_max = np.array(volmod.max_pd_volumes(), dtype=np.int32)
+        self.volume_ctx: volmod.VolumeContext = volmod.EMPTY_VOLUME_CONTEXT
+        self._vol_ctx_ver = -1
+        self._row_vol_conflicts: List[list] = []  # [(key, read_only)]
+        self._row_vol_pds: List[list] = []  # [(kind_idx, id)]
+        self._conflict_width = _pad(0, 4)
+        self._pd_width = _pad(0, 4)
+        self._vol_vocab_dirty = False
         # arrays created on first refresh
         self.alloc: np.ndarray
         self.requested: np.ndarray
@@ -269,6 +267,46 @@ class ClusterSnapshot:
             self.version += 1
         return self._images_width
 
+    def ensure_conflict_key(self, key: str) -> int:
+        before = len(self.conflict_vocab)
+        idx = self.conflict_vocab.add(key, "")
+        if len(self.conflict_vocab) != before:
+            self._vol_vocab_dirty = True
+        return idx
+
+    def ensure_pd_id(self, kind_idx: int, vid: str) -> int:
+        before = len(self.pd_vocab)
+        idx = self.pd_vocab.add(str(kind_idx) + "\x00" + vid, "")
+        if len(self.pd_vocab) != before:
+            self._vol_vocab_dirty = True
+        return idx
+
+    def finalize_volumes(self) -> Tuple[int, int]:
+        """Rebuild the node-side volume presence matrices if either volume
+        vocab grew (PodBatch compile interns pending pods' keys). Returns
+        (conflict_width, pd_width)."""
+        want_c = _pad(len(self.conflict_vocab), 4)
+        want_p = _pad(len(self.pd_vocab), 4)
+        if (self._vol_vocab_dirty or want_c != self._conflict_width
+                or want_p != self._pd_width):
+            self._conflict_width = want_c
+            self._pd_width = want_p
+            n = self.alloc.shape[0] if self._shape_sig else 0
+            self.vol_present = np.zeros((n, want_c), dtype=np.int8)
+            self.vol_rw = np.zeros((n, want_c), dtype=np.int8)
+            self.pd_present = np.zeros((n, want_p), dtype=np.int8)
+            for i in range(len(self.node_names)):
+                self._write_volume_presence_row(i)
+            # [3, Vpd] kind mask over pd vocab columns
+            self.pd_kind = np.zeros((3, want_p), dtype=np.int8)
+            for col, (key, _) in enumerate(self.pd_vocab.items()):
+                self.pd_kind[int(key.split("\x00", 1)[0]), col] = 1
+            self._vol_vocab_dirty = False
+            self.dirty.update(("vol_present", "vol_rw", "pd_present",
+                               "pd_kind"))
+            self.version += 1
+        return self._conflict_width, self._pd_width
+
     def finalize_labels(self) -> int:
         """Rebuild the [N, L] label matrix if the vocab grew (called by
         PodBatch after selector compilation). Returns the padded width L."""
@@ -290,9 +328,16 @@ class ClusterSnapshot:
                 self._shape_sig = tuple(sig)
         return self._labels_width
 
-    def refresh(self, infos: Dict[str, NodeInfo]) -> bool:
+    def refresh(self, infos: Dict[str, NodeInfo],
+                volume_ctx: Optional[volmod.VolumeContext] = None) -> bool:
         """Sync arrays with the cache. Returns True on full rebuild (shape or
-        membership change), False for in-place delta."""
+        membership change), False for in-place delta. A PV/PVC change
+        (volume_ctx.version moved) re-resolves every node's PD rows — the
+        ecache-style invalidation of factory.go:261-601 for PV/PVC events."""
+        if volume_ctx is not None:
+            self.volume_ctx = volume_ctx
+        vol_ctx_moved = self._vol_ctx_ver != self.volume_ctx.version
+        self._vol_ctx_ver = self.volume_ctx.version
         # node-driven vocabs (taints, extended resources, avoid signatures) —
         # interned before shaping, re-scanned only for changed node specs.
         # The skip-cache keys on (spec_generation, node object identity): a
@@ -339,7 +384,8 @@ class ClusterSnapshot:
             for nm in names:
                 prev = self._generations.get(nm)
                 info = infos[nm]
-                if prev is None or prev[0] != info.generation or prev[3] is not info:
+                if (prev is None or prev[0] != info.generation
+                        or prev[3] is not info or vol_ctx_moved):
                     changed.append(nm)
         label_index_stale = rebuild
         for nm in changed:
@@ -386,8 +432,20 @@ class ClusterSnapshot:
         self.avoid = np.zeros((n, _pad(len(self.avoid_vocab), 4)), dtype=np.int8)
         self.image_sizes = np.zeros((n, self._images_width), dtype=np.int32)
         self._row_images = [[] for _ in range(n)]
+        self.has_zone = np.zeros(n, dtype=bool)
+        self.vol_present = np.zeros((n, self._conflict_width), dtype=np.int8)
+        self.vol_rw = np.zeros((n, self._conflict_width), dtype=np.int8)
+        self.pd_present = np.zeros((n, self._pd_width), dtype=np.int8)
+        self.pd_counts = np.zeros((n, 3), dtype=np.int32)
+        if not hasattr(self, "pd_kind") or self.pd_kind.shape[1] != self._pd_width:
+            self.pd_kind = np.zeros((3, self._pd_width), dtype=np.int8)
+            for col, (key, _) in enumerate(self.pd_vocab.items()):
+                self.pd_kind[int(key.split("\x00", 1)[0]), col] = 1
+        self._row_vol_conflicts = [[] for _ in range(n)]
+        self._row_vol_pds = [[] for _ in range(n)]
         self.dirty = {"requested", "nonzero", "pod_count", "port_bitmap",
-                      *self.STATIC}
+                      "vol_present", "vol_rw", "pd_present", "pd_counts",
+                      "pd_kind", *self.STATIC}
 
     def _write_dynamic_row(self, i: int, info: NodeInfo) -> None:
         r = self.num_resources
@@ -399,6 +457,28 @@ class ClusterSnapshot:
         self.nonzero[i, 0] = info.nonzero_cpu
         self.nonzero[i, 1] = self.quant_mem(info.nonzero_mem, up=True)
         self.pod_count[i] = len(info.pods)
+        # volume aggregates over the node's (bound+assumed) pods; volume
+        # arrays are dirtied only when the node's volume set actually moved,
+        # so volume-less churn keeps steady-state uploads tiny
+        conflicts: List[Tuple[str, bool]] = []
+        pd_ids: List[Tuple[int, str]] = []
+        if any(p.volumes for p in info.pods):
+            for p in info.pods:
+                if p.volumes:
+                    conflicts.extend(volmod.pod_conflict_keys(p))
+                    pd_ids.extend(volmod.pd_filter_ids(p, self.volume_ctx))
+        vol_changed = (conflicts != self._row_vol_conflicts[i]
+                       or pd_ids != self._row_vol_pds[i])
+        self._row_vol_conflicts[i] = conflicts
+        self._row_vol_pds[i] = pd_ids
+        if vol_changed:
+            counts = [set(), set(), set()]
+            for k, vid in pd_ids:
+                counts[k].add(vid)
+            self.pd_counts[i] = [len(s) for s in counts]
+            self._write_volume_presence_row(i)
+            self.dirty.update(("vol_present", "vol_rw", "pd_present",
+                               "pd_counts"))
         self.dirty.update(self.DYNAMIC)
 
     def _write_static_row(self, i: int, info: NodeInfo) -> None:
@@ -443,6 +523,8 @@ class ClusterSnapshot:
 
         self._row_images[i] = node.images
         self._write_image_row(i, node.images)
+        self.has_zone[i] = any(k in (volmod.ZONE_LABEL, volmod.REGION_LABEL)
+                               for k in node.labels)
         self.dirty.update(self.STATIC)
 
     def _write_image_row(self, i: int, images) -> None:
@@ -456,6 +538,31 @@ class ClusterSnapshot:
         if getattr(self, "image_sizes", None) is not None \
                 and self.image_sizes.shape[1] == self._images_width:
             self.image_sizes[i] = row
+
+    def _write_volume_presence_row(self, i: int) -> None:
+        """Multi-hot conflict/PD presence over the demand-driven vocabs; a
+        key no pending pod references has no column (and cannot conflict)."""
+        if (getattr(self, "vol_present", None) is None
+                or self.vol_present.shape[1] != self._conflict_width
+                or self.pd_present.shape[1] != self._pd_width
+                or i >= len(self._row_vol_conflicts)):
+            return
+        vc = np.zeros(self._conflict_width, dtype=np.int8)
+        vr = np.zeros(self._conflict_width, dtype=np.int8)
+        for key, ro in self._row_vol_conflicts[i]:
+            idx = self.conflict_vocab.get(key, "")
+            if idx >= 0:
+                vc[idx] = 1
+                if not ro:
+                    vr[idx] = 1
+        self.vol_present[i] = vc
+        self.vol_rw[i] = vr
+        pdrow = np.zeros(self._pd_width, dtype=np.int8)
+        for k, vid in self._row_vol_pds[i]:
+            idx = self.pd_vocab.get(str(k) + "\x00" + vid, "")
+            if idx >= 0:
+                pdrow[idx] = 1
+        self.pd_present[i] = pdrow
 
     def _write_label_row(self, i: int, labels: Dict[str, str]) -> None:
         lbl = np.zeros(self.labels.shape[1], dtype=np.int8)
@@ -615,11 +722,51 @@ class PodBatch:
             for c in pod.containers:
                 if c.image:
                     snap.ensure_image(c.image)
+        # volume compilation: interns conflict/PD keys and (for VolumeZone)
+        # zone label pairs / (for VolumeNode) PV-affinity pairs into the
+        # demand-driven vocabs BEFORE the matrices are finalized
+        from kubernetes_tpu.utils import features as featmod
+        vol_node_on = featmod.enabled("PersistentLocalVolumes")
+        vol_compiled = []
+        for p, pod in enumerate(self.pods):
+            if not pod.volumes:
+                vol_compiled.append(None)
+                continue
+            entry = {"err": False, "zone_err": False, "conf": [], "pd": [],
+                     "zone": [], "pvaff": None}
+            for key, ro in volmod.pod_conflict_keys(pod):
+                entry["conf"].append((snap.ensure_conflict_key(key), ro))
+            for k, vid in volmod.pd_filter_ids(pod, snap.volume_ctx):
+                entry["pd"].append((k, snap.ensure_pd_id(k, vid)))
+            try:
+                for zk, zv in volmod.zone_constraints(pod, snap.volume_ctx):
+                    if zv == "":
+                        # node missing the key passes in the reference
+                        # ("" == ""); exact host path handles this rarity
+                        self.needs_host_check[p] = True
+                        continue
+                    entry["zone"].append(snap.ensure_label_pair(zk, zv))
+            except volmod.UnresolvedVolume:
+                # VolumeZone errors AFTER its no-zone-labels fast-path
+                # (predicates.go:425-430): fails zone-labeled nodes only
+                entry["zone_err"] = True
+            if vol_node_on:
+                try:
+                    reqs = volmod.pv_affinity_requirements(pod, snap.volume_ctx)
+                    if reqs:
+                        comp = compile_requirements(reqs, snap)
+                        entry["pvaff"] = comp
+                        n_any = max(n_any, len(comp[1]))
+                except volmod.UnresolvedVolume:
+                    # VolumeNode errors unconditionally -> schedule fails
+                    entry["err"] = True
+            vol_compiled.append(entry)
         n_terms = min(n_terms, max_terms)
         n_any = min(n_any, max_any)
         n_pref = min(n_pref, max_pref)
         L = snap.finalize_labels()
         I = snap.finalize_images()
+        Vc, Vpd = snap.finalize_volumes()
         self.sel_req_all = np.zeros((P, n_terms, L), dtype=np.int8)
         self.sel_req_any = np.zeros((P, n_terms, n_any, L), dtype=np.int8)
         self.sel_forbid = np.zeros((P, n_terms, L), dtype=np.int8)
@@ -642,6 +789,23 @@ class PodBatch:
         self.avoid_idx = np.full(P, -1, dtype=np.int32)
         # ImageLocality: per-image container counts
         self.img_count = np.zeros((P, I), dtype=np.int32)
+        # volume predicates: NoDiskConflict hard (conflicts with any
+        # presence) / ro (conflicts with read-write presence) key rows,
+        # MaxPDVolumeCount id rows + per-kind distinct counts, VolumeZone
+        # required label pairs, VolumeNode compiled PV affinity (one conjunct
+        # — PV terms are ANDed, util.go:202)
+        self.vol_hard = np.zeros((P, Vc), dtype=np.int8)
+        self.vol_ro = np.zeros((P, Vc), dtype=np.int8)
+        self.pd_req = np.zeros((P, Vpd), dtype=np.int8)
+        self.pd_req_count = np.zeros((P, 3), dtype=np.int32)
+        self.vz_req = np.zeros((P, L), dtype=np.int8)
+        self.vz_err = np.zeros(P, dtype=bool)
+        self.pvaff_req_all = np.zeros((P, L), dtype=np.int8)
+        self.pvaff_req_any = np.zeros((P, n_any, L), dtype=np.int8)
+        self.pvaff_forbid = np.zeros((P, L), dtype=np.int8)
+        self.pvaff_any_used = np.zeros((P, n_any), dtype=bool)
+        self.pvaff_unsat = np.zeros(P, dtype=bool)
+        self.pvaff_has = np.zeros(P, dtype=bool)
 
         for p, pod in enumerate(self.pods):
             self._encode_pod(p, pod, snap, compiled[p], n_terms, n_any)
@@ -654,6 +818,7 @@ class PodBatch:
                     idx = snap.image_vocab.get(c.image, "")
                     if idx >= 0:
                         self.img_count[p, idx] += 1
+            self._encode_volumes(p, vol_compiled[p], n_any)
 
     # -------------------------------------------------------------- helpers
 
@@ -764,6 +929,46 @@ class PodBatch:
                 self.sel_any_used[p, t, a] = True
                 for i in group:
                     self.sel_req_any[p, t, a, i] = 1
+
+    def _encode_volumes(self, p: int, entry, n_any: int) -> None:
+        if entry is None:
+            return
+        if entry["err"]:
+            # UnresolvedVolume from VolumeNode: predicate error fails the
+            # whole schedule attempt for this pod -> unplaceable this round
+            self.impossible[p] = True
+            return
+        if entry["zone_err"]:
+            self.vz_err[p] = True
+        for idx, ro in entry["conf"]:
+            if ro:
+                self.vol_ro[p, idx] = 1
+            else:
+                self.vol_hard[p, idx] = 1
+        seen = [set(), set(), set()]
+        for k, idx in entry["pd"]:
+            self.pd_req[p, idx] = 1
+            seen[k].add(idx)
+        self.pd_req_count[p] = [len(s) for s in seen]
+        for idx in entry["zone"]:
+            self.vz_req[p, idx] = 1
+        comp = entry["pvaff"]
+        if comp is not None:
+            req_all, any_groups, forbid, unsat = comp
+            self.pvaff_has[p] = True
+            if len(any_groups) > n_any:
+                self.needs_host_check[p] = True
+                any_groups = []
+            if unsat:
+                self.pvaff_unsat[p] = True
+            for i in req_all:
+                self.pvaff_req_all[p, i] = 1
+            for i in forbid:
+                self.pvaff_forbid[p, i] = 1
+            for a, group in enumerate(any_groups):
+                self.pvaff_any_used[p, a] = True
+                for i in group:
+                    self.pvaff_req_any[p, a, i] = 1
 
     def _encode_pref(self, p: int, pod: Pod, snap: ClusterSnapshot, prefs,
                      n_pref: int, n_any: int) -> None:

@@ -1,0 +1,277 @@
+"""Volume predicate tests: oracle semantics tables + kernel parity + engine
+sequential (carry) behavior.
+
+Table cases adapted from the reference's predicates_test.go volume sections
+(TestDiskConflicts/TestAWSDiskConflicts/TestRBDDiskConflicts,
+TestVolumeCountConflicts, TestVolumeZonePredicate) — semantics, not code.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    ALPHA_STORAGE_NODE_AFFINITY_ANNOTATION,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Volume,
+    VolumeKind,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.engine.batch import place_batch
+from kubernetes_tpu.ops import oracle
+from kubernetes_tpu.ops import oracle_volumes as ov
+from kubernetes_tpu.ops.predicates import fits_jit, node_arrays, pod_arrays
+from kubernetes_tpu.state.node_info import node_info_map
+from kubernetes_tpu.state.snapshot import ClusterSnapshot, PodBatch
+from kubernetes_tpu.state.volumes import (
+    REGION_LABEL,
+    ZONE_LABEL,
+    VolumeContext,
+)
+from kubernetes_tpu.utils import features
+
+
+def gce(pd, ro=False):
+    return Volume(name=pd, kind=VolumeKind.GCE_PD, volume_id=pd, read_only=ro)
+
+
+def ebs(vid, ro=False):
+    return Volume(name=vid, kind=VolumeKind.AWS_EBS, volume_id=vid, read_only=ro)
+
+
+def rbd(mons, pool, image, ro=False):
+    return Volume(name=image, kind=VolumeKind.RBD, monitors=list(mons),
+                  pool=pool, image=image, read_only=ro)
+
+
+def iscsi(iqn, ro=False):
+    return Volume(name=iqn, kind=VolumeKind.ISCSI, volume_id=iqn, read_only=ro)
+
+
+def azure(name):
+    return Volume(name=name, kind=VolumeKind.AZURE_DISK, volume_id=name)
+
+
+def pvc(claim):
+    return Volume(name=claim, kind=VolumeKind.PVC, volume_id=claim)
+
+
+def _info(node, pods=()):
+    return node_info_map([node], list(pods))[node.name]
+
+
+# ---------------------------------------------------------------- oracle
+
+
+def test_no_disk_conflict_tables():
+    node = make_node("n1")
+    # (pod volume, existing volume, conflict?)
+    cases = [
+        (gce("a"), gce("a"), True),
+        (gce("a"), gce("b"), False),
+        (gce("a", ro=True), gce("a", ro=True), False),  # both RO OK
+        (gce("a", ro=True), gce("a"), True),  # existing RW
+        (gce("a"), gce("a", ro=True), True),  # new RW
+        (ebs("v1"), ebs("v1"), True),
+        (ebs("v1", ro=True), ebs("v1", ro=True), True),  # EBS: RO irrelevant
+        (ebs("v1"), ebs("v2"), False),
+        (rbd(["m1", "m2"], "p", "i"), rbd(["m2", "m3"], "p", "i"), True),
+        (rbd(["m1"], "p", "i"), rbd(["m2"], "p", "i"), False),  # no shared mon
+        (rbd(["m1"], "p", "i"), rbd(["m1"], "q", "i"), False),  # diff pool
+        (rbd(["m1"], "p", "i", ro=True), rbd(["m1"], "p", "i", ro=True), False),
+        (iscsi("iqn1"), iscsi("iqn1"), True),
+        (iscsi("iqn1", ro=True), iscsi("iqn1", ro=True), False),
+        (gce("a"), ebs("a"), False),  # cross-kind never conflicts
+    ]
+    for new_vol, old_vol, want_conflict in cases:
+        holder = make_pod("holder", node_name="n1", volumes=[old_vol])
+        info = _info(node, [holder])
+        pod = make_pod("p", volumes=[new_vol])
+        assert ov.no_disk_conflict(pod, info) == (not want_conflict), \
+            (new_vol, old_vol)
+
+
+def test_max_pd_volume_count_inline_and_pvc():
+    node = make_node("n1")
+    ctx = VolumeContext(
+        pvs={"pv-ebs": PersistentVolume("pv-ebs", source=ebs("pv-vol"))},
+        pvcs={("default", "claim1"): PersistentVolumeClaim(
+            "claim1", volume_name="pv-ebs")},
+    )
+    # node already has 2 distinct EBS volumes (one inline, one via PVC)
+    holders = [
+        make_pod("h1", node_name="n1", volumes=[ebs("v1")]),
+        make_pod("h2", node_name="n1", volumes=[pvc("claim1")]),
+    ]
+    info = _info(node, holders)
+    limits = (2, 2, 2)
+    # new distinct volume exceeds the limit of 2
+    assert ov.max_pd_volume_count(
+        make_pod("p", volumes=[ebs("v9")]), info, ctx, limits) == [False, True, True]
+    # re-using an existing volume does not count as new
+    assert ov.max_pd_volume_count(
+        make_pod("p", volumes=[ebs("v1")]), info, ctx, limits) == [True, True, True]
+    # no relevant volumes -> quick pass even at the limit
+    assert ov.max_pd_volume_count(
+        make_pod("p", volumes=[gce("g")]), info, ctx, limits)[0] is True
+    # missing PVC counts as a unique volume toward every filter
+    missing = make_pod("p", volumes=[pvc("nope")])
+    assert ov.max_pd_volume_count(missing, info, ctx, limits)[0] is False
+
+
+def test_volume_zone_tables():
+    ctx = VolumeContext(
+        pvs={"pv1": PersistentVolume("pv1", labels={ZONE_LABEL: "us-1a"}),
+             "pv2": PersistentVolume("pv2", labels={REGION_LABEL: "us"})},
+        pvcs={("default", "c1"): PersistentVolumeClaim("c1", volume_name="pv1"),
+              ("default", "c2"): PersistentVolumeClaim("c2", volume_name="pv2")},
+    )
+    pod = make_pod("p", volumes=[pvc("c1")])
+    same = _info(make_node("n1", labels={ZONE_LABEL: "us-1a"}))
+    other = _info(make_node("n2", labels={ZONE_LABEL: "us-1b"}))
+    nozone = _info(make_node("n3"))
+    assert ov.no_volume_zone_conflict(pod, same, ctx)
+    assert not ov.no_volume_zone_conflict(pod, other, ctx)
+    assert ov.no_volume_zone_conflict(pod, nozone, ctx)  # fast-path
+    # region-labeled PV vs zone-labeled node: missing region key fails
+    pod2 = make_pod("p2", volumes=[pvc("c2")])
+    assert not ov.no_volume_zone_conflict(pod2, same, ctx)
+    region_node = _info(make_node("n4", labels={REGION_LABEL: "us"}))
+    assert ov.no_volume_zone_conflict(pod2, region_node, ctx)
+    # unresolvable claim -> error (pod fails the round)
+    with pytest.raises(Exception):
+        ov.no_volume_zone_conflict(
+            make_pod("p3", volumes=[pvc("missing")]), same, ctx)
+
+
+def test_volume_node_affinity_gated():
+    ann = json.dumps({
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "kubernetes.io/hostname", "operator": "In",
+                     "values": ["n1"]}]}]}})
+    ctx = VolumeContext(
+        pvs={"local1": PersistentVolume(
+            "local1", annotations={ALPHA_STORAGE_NODE_AFFINITY_ANNOTATION: ann})},
+        pvcs={("default", "lc"): PersistentVolumeClaim("lc", volume_name="local1")},
+    )
+    pod = make_pod("p", volumes=[pvc("lc")])
+    n1 = _info(make_node("n1", labels={"kubernetes.io/hostname": "n1"}))
+    n2 = _info(make_node("n2", labels={"kubernetes.io/hostname": "n2"}))
+    # gate off (default): predicate passes everywhere
+    assert ov.no_volume_node_conflict(pod, n2, ctx)
+    features.DEFAULT_FEATURE_GATE.set("PersistentLocalVolumes", True)
+    try:
+        assert ov.no_volume_node_conflict(pod, n1, ctx)
+        assert not ov.no_volume_node_conflict(pod, n2, ctx)
+    finally:
+        features.DEFAULT_FEATURE_GATE.reset()
+
+
+# ---------------------------------------------------------------- kernel
+
+
+def _kernel_matrix(pods, nodes, bound=(), ctx=None):
+    infos = node_info_map(nodes, list(bound))
+    snap = ClusterSnapshot()
+    snap.refresh(infos, volume_ctx=ctx or VolumeContext())
+    # PodBatch interns volume keys; finalize_volumes (called inside) rebuilds
+    # the node presence matrices from the cached per-row volume lists
+    batch = PodBatch(pods, snap)
+    m = np.asarray(fits_jit(pod_arrays(batch), node_arrays(snap)))
+    return m, snap, infos, batch
+
+
+def _rand_volume(rng):
+    r = rng.random()
+    ro = rng.random() < 0.4
+    if r < 0.25:
+        return gce(rng.choice(["pd1", "pd2", "pd3"]), ro=ro)
+    if r < 0.5:
+        return ebs(rng.choice(["v1", "v2", "v3"]), ro=ro)
+    if r < 0.65:
+        return rbd(rng.sample(["m1", "m2", "m3"], rng.randint(1, 2)),
+                   rng.choice(["p1", "p2"]), rng.choice(["i1", "i2"]), ro=ro)
+    if r < 0.8:
+        return iscsi(rng.choice(["q1", "q2"]), ro=ro)
+    if r < 0.9:
+        return azure(rng.choice(["d1", "d2"]))
+    return pvc(rng.choice(["c1", "c2", "c-missing"]))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_kernel_matches_oracle_with_volumes(seed):
+    rng = random.Random(seed)
+    ctx = VolumeContext(
+        pvs={"pv1": PersistentVolume("pv1", source=gce("pd-shared"),
+                                     labels={ZONE_LABEL: "us-1a"}),
+             "pv2": PersistentVolume("pv2", source=ebs("ebs-shared"))},
+        pvcs={("default", "c1"): PersistentVolumeClaim("c1", volume_name="pv1"),
+              ("default", "c2"): PersistentVolumeClaim("c2", volume_name="pv2")},
+    )
+    nodes = []
+    for i in range(12):
+        labels = {}
+        if rng.random() < 0.5:
+            labels[ZONE_LABEL] = rng.choice(["us-1a", "us-1b"])
+        nodes.append(make_node(f"vn-{i:02d}", labels=labels))
+    names = [n.name for n in nodes]
+    bound = []
+    for i in range(25):
+        vols = [_rand_volume(rng) for _ in range(rng.randint(0, 2))]
+        p = make_pod(f"bound-{i}", volumes=vols, node_name=rng.choice(names))
+        bound.append(p)
+    pending = [make_pod(f"pend-{i}", cpu=100,
+                        volumes=[_rand_volume(rng)
+                                 for _ in range(rng.randint(0, 2))])
+               for i in range(30)]
+
+    import os
+    os.environ["KUBE_MAX_PD_VOLS"] = "3"  # small limit to exercise MaxPD
+    try:
+        m, snap, infos, batch = _kernel_matrix(pending, nodes, bound, ctx)
+        from kubernetes_tpu.ops.oracle_ext import SchedulingContext
+        octx = SchedulingContext(infos, [], volume_ctx=ctx)
+        mismatches = []
+        for pi, pod in enumerate(pending):
+            for ni, nm in enumerate(snap.node_names):
+                expect = oracle.pod_fits(pod, infos[nm], octx)
+                if batch.needs_host_check[pi]:
+                    if expect and not m[pi, ni]:
+                        mismatches.append((pod.name, nm, expect))
+                elif bool(m[pi, ni]) != expect:
+                    mismatches.append((pod.name, nm, expect, bool(m[pi, ni])))
+        assert not mismatches, mismatches[:10]
+    finally:
+        del os.environ["KUBE_MAX_PD_VOLS"]
+
+
+def test_batch_carry_sees_committed_volumes():
+    """Two pods mounting the same EBS volume must land on different nodes —
+    the on-device commit makes pod 0's volume visible to pod 1 (the assume
+    semantics of scheduler.go:188 inside one device program)."""
+    nodes = [make_node("a"), make_node("b")]
+    infos = node_info_map(nodes, [])
+    snap = ClusterSnapshot()
+    snap.refresh(infos)
+    pods = [make_pod("p0", cpu=100, volumes=[ebs("shared")]),
+            make_pod("p1", cpu=100, volumes=[ebs("shared")]),
+            make_pod("p2", cpu=100, volumes=[ebs("shared")])]
+    batch = PodBatch(pods, snap)
+    narr = node_arrays(snap)
+    from kubernetes_tpu.engine.batch import node_state
+    import jax.numpy as jnp
+    from kubernetes_tpu.ops import priorities as prio
+    selected, fit_counts, state, _ = place_batch(
+        pod_arrays(batch), narr, node_state(narr), jnp.uint32(0),
+        prio.DEFAULT_PRIORITIES)
+    sel = np.asarray(selected)
+    assert sel[0] >= 0 and sel[1] >= 0
+    assert sel[0] != sel[1]  # conflict forced apart
+    assert sel[2] == -1  # only two nodes; third pod cannot fit anywhere
+    assert int(np.asarray(fit_counts)[2]) == 0
