@@ -359,6 +359,12 @@ class Pod:
     tolerations: List[Toleration] = field(default_factory=list)
     scheduler_name: str = "default-scheduler"
     priority: int = 0
+    priority_class: str = ""
+    # PodStatus.Phase (v1.PodPhase): Pending | Running | Succeeded | Failed.
+    # Set by the node agent (models/hollow kubelet) after bind; controllers
+    # and endpoints read it.
+    phase: str = "Pending"
+    restart_policy: str = "Always"  # Always | OnFailure | Never
     resource_version: int = 0
     owner_kind: str = ""  # controllerRef: equivalence classes, spreading,
     owner_name: str = ""  # NodePreferAvoidPods

@@ -1,0 +1,344 @@
+"""Controller reconcile tests, deterministic pump mode.
+
+Behavioral shape follows the reference's controller unit tests
+(replica_set_test.go, deployment_controller_test.go, job_controller_test.go,
+daemoncontroller_test.go, gc_controller_test.go) — spec vs observed diffs
+through a fake-clock pump, no threads.
+"""
+
+import dataclasses
+
+from kubernetes_tpu.api.types import LabelSelector, Pod, make_node, make_pod
+from kubernetes_tpu.api.workloads import (
+    DaemonSet,
+    Deployment,
+    Job,
+    Namespace,
+    ReplicaSet,
+    Service,
+    StatefulSet,
+)
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.controllers.namespace import delete_namespace
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+
+def mk_template(labels):
+    return dataclasses.replace(make_pod("", labels=dict(labels), cpu=100), name="")
+
+
+def mk_manager():
+    api = ApiServerLite()
+    cm = ControllerManager(api, record_events=False)
+    return api, cm
+
+
+def pods_of(api, ns="default"):
+    return [p for p in api.list("Pod")[0] if p.namespace == ns]
+
+
+def set_phase(api, pod, phase, node="n1"):
+    fresh = api.get("Pod", pod.namespace, pod.name)
+    api.update("Pod", dataclasses.replace(fresh, phase=phase,
+                                          node_name=fresh.node_name or node))
+
+
+# ----------------------------------------------------------------- replicaset
+
+
+def test_replicaset_scales_up_and_down():
+    api, cm = mk_manager()
+    rs = ReplicaSet(name="web", replicas=3,
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    template=mk_template({"app": "web"}))
+    api.create("ReplicaSet", rs)
+    cm.pump_until_stable()
+    assert len(pods_of(api)) == 3
+    got = api.get("ReplicaSet", "default", "web")
+    assert got.observed_replicas == 3
+    # scale down to 1
+    api.update("ReplicaSet", dataclasses.replace(got, replicas=1))
+    cm.pump_until_stable()
+    assert len(pods_of(api)) == 1
+
+
+def test_replicaset_replaces_failed_pod_and_reports_ready():
+    api, cm = mk_manager()
+    rs = ReplicaSet(name="web", replicas=2,
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    template=mk_template({"app": "web"}))
+    api.create("ReplicaSet", rs)
+    cm.pump_until_stable()
+    p0, p1 = pods_of(api)
+    set_phase(api, p0, "Running")
+    set_phase(api, p1, "Failed")
+    cm.pump_until_stable()
+    live = [p for p in pods_of(api) if p.phase != "Failed"]
+    assert len(live) == 2  # failed pod replaced
+    assert api.get("ReplicaSet", "default", "web").ready_replicas == 1
+
+
+def test_replicaset_adopts_matching_orphan():
+    api, cm = mk_manager()
+    api.create("Pod", make_pod("orphan", labels={"app": "web"}))
+    rs = ReplicaSet(name="web", replicas=1,
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    template=mk_template({"app": "web"}))
+    api.create("ReplicaSet", rs)
+    cm.pump_until_stable()
+    pods = pods_of(api)
+    assert len(pods) == 1 and pods[0].name == "orphan"
+    assert pods[0].owner_kind == "ReplicaSet"
+
+
+# ----------------------------------------------------------------- deployment
+
+
+def test_deployment_creates_rs_and_rolls_template():
+    api, cm = mk_manager()
+    dep = Deployment(name="api", replicas=3,
+                     selector=LabelSelector(match_labels={"app": "api"}),
+                     template=mk_template({"app": "api"}),
+                     max_surge=1, max_unavailable=1)
+    api.create("Deployment", dep)
+    cm.pump_until_stable()
+    rses = api.list("ReplicaSet")[0]
+    assert len(rses) == 1 and rses[0].replicas == 3
+    assert rses[0].owner_kind == "Deployment"
+    # pods ready
+    for p in pods_of(api):
+        set_phase(api, p, "Running")
+    cm.pump_until_stable()
+    assert api.get("Deployment", "default", "api").ready_replicas == 3
+
+    # roll: change the template (new image -> new hash)
+    fresh = api.get("Deployment", "default", "api")
+    new_tpl = dataclasses.replace(fresh.template)
+    new_tpl.containers = [dataclasses.replace(new_tpl.containers[0], image="v2")] \
+        if new_tpl.containers else []
+    new_tpl = dataclasses.replace(new_tpl, annotations={"rev": "2"})
+    api.update("Deployment", dataclasses.replace(fresh, template=new_tpl))
+    for _ in range(10):  # drive the rollout, marking new pods ready as they come
+        cm.pump_until_stable()
+        for p in pods_of(api):
+            if p.phase != "Running":
+                set_phase(api, p, "Running")
+    cm.pump_until_stable()
+    rses = api.list("ReplicaSet")[0]
+    by_replicas = sorted(rses, key=lambda r: r.replicas)
+    assert len(rses) == 2
+    assert by_replicas[0].replicas == 0  # old RS fully drained
+    assert by_replicas[1].replicas == 3  # new RS at target
+    dep_now = api.get("Deployment", "default", "api")
+    assert dep_now.revision == 2 and dep_now.updated_replicas == 3
+
+
+def test_deployment_scale_down_shrinks_new_rs():
+    api, cm = mk_manager()
+    dep = Deployment(name="api", replicas=5,
+                     selector=LabelSelector(match_labels={"app": "api"}),
+                     template=mk_template({"app": "api"}))
+    api.create("Deployment", dep)
+    cm.pump_until_stable()
+    assert len(pods_of(api)) == 5
+    fresh = api.get("Deployment", "default", "api")
+    api.update("Deployment", dataclasses.replace(fresh, replicas=3))
+    cm.pump_until_stable()
+    assert len(pods_of(api)) == 3
+    assert api.list("ReplicaSet")[0][0].replicas == 3
+
+
+def test_replicaset_selector_template_mismatch_stops_not_loops():
+    api, cm = mk_manager()
+    rs = ReplicaSet(name="bad", replicas=3,
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    template=mk_template({"app": "api"}))  # mismatched
+    api.create("ReplicaSet", rs)
+    cm.pump_until_stable()
+    assert pods_of(api) == []  # no unbounded creation
+
+
+def test_endpoints_drop_pod_relabeled_out_of_selector():
+    api, cm = mk_manager()
+    api.create("Service", Service(name="svc", selector={"app": "web"}))
+    api.create("Pod", dataclasses.replace(
+        make_pod("w1", labels={"app": "web"}, node_name="n1"), phase="Running"))
+    cm.pump_until_stable()
+    assert [a.pod_key for a in api.get("Endpoints", "default", "svc").addresses] \
+        == ["default/w1"]
+    p = api.get("Pod", "default", "w1")
+    api.update("Pod", dataclasses.replace(p, labels={"app": "db"}))
+    cm.pump_until_stable()
+    assert api.get("Endpoints", "default", "svc").addresses == []
+
+
+# ----------------------------------------------------------------------- job
+
+
+def test_job_runs_to_completion():
+    api, cm = mk_manager()
+    job = Job(name="calc", completions=3, parallelism=2,
+              template=dataclasses.replace(mk_template({"job": "calc"}),
+                                           restart_policy="Never"))
+    api.create("Job", job)
+    cm.pump_until_stable()
+    assert len(pods_of(api)) == 2  # parallelism cap
+    for p in pods_of(api):
+        set_phase(api, p, "Succeeded")
+    cm.pump_until_stable()
+    # 2 done, 1 to go -> one more pod
+    active = [p for p in pods_of(api) if p.phase == "Pending"]
+    assert len(active) == 1
+    set_phase(api, active[0], "Succeeded")
+    cm.pump_until_stable()
+    got = api.get("Job", "default", "calc")
+    assert got.complete and got.succeeded == 3 and got.active == 0
+
+
+# ------------------------------------------------------------------ daemonset
+
+
+def test_daemonset_one_pod_per_eligible_node():
+    api, cm = mk_manager()
+    for i in range(3):
+        api.create("Node", make_node(f"n{i}"))
+    api.create("Node", make_node("cordoned", ready=False))
+    ds = DaemonSet(name="agent",
+                   selector=LabelSelector(match_labels={"ds": "agent"}),
+                   template=mk_template({"ds": "agent"}))
+    api.create("DaemonSet", ds)
+    cm.pump_until_stable()
+    pods = pods_of(api)
+    assert {p.node_name for p in pods} == {"n0", "n1", "n2"}  # direct binding
+    got = api.get("DaemonSet", "default", "agent")
+    assert got.desired_scheduled == 3 and got.current_scheduled == 3
+    # node joins -> pod appears
+    api.create("Node", make_node("n3"))
+    cm.pump_until_stable()
+    assert {p.node_name for p in pods_of(api)} == {"n0", "n1", "n2", "n3"}
+
+
+# ----------------------------------------------------------------- statefulset
+
+
+def test_statefulset_ordered_creation_and_reverse_scale_down():
+    api, cm = mk_manager()
+    ss = StatefulSet(name="db", replicas=3,
+                     selector=LabelSelector(match_labels={"ss": "db"}),
+                     template=mk_template({"ss": "db"}))
+    api.create("StatefulSet", ss)
+    cm.pump_until_stable()
+    assert [p.name for p in pods_of(api)] == ["db-0"]  # strict ordering
+    set_phase(api, pods_of(api)[0], "Running")
+    cm.pump_until_stable()
+    names = sorted(p.name for p in pods_of(api))
+    assert names == ["db-0", "db-1"]
+    for p in pods_of(api):
+        if p.phase != "Running":
+            set_phase(api, p, "Running")
+    cm.pump_until_stable()
+    assert sorted(p.name for p in pods_of(api)) == ["db-0", "db-1", "db-2"]
+    # scale to 1: highest ordinals go first
+    fresh = api.get("StatefulSet", "default", "db")
+    api.update("StatefulSet", dataclasses.replace(fresh, replicas=1))
+    cm.pump_until_stable()
+    assert sorted(p.name for p in pods_of(api)) == ["db-0"]
+
+
+# ------------------------------------------------------------------ endpoints
+
+
+def test_endpoints_track_ready_pods():
+    api, cm = mk_manager()
+    api.create("Service", Service(name="svc", selector={"app": "web"}))
+    api.create("Pod", make_pod("w1", labels={"app": "web"}, node_name="n1"))
+    api.create("Pod", make_pod("w2", labels={"app": "web"}, node_name="n2"))
+    api.create("Pod", make_pod("other", labels={"app": "db"}, node_name="n1"))
+    cm.pump_until_stable()
+    eps = api.get("Endpoints", "default", "svc")
+    assert eps.addresses == []  # none Running yet
+    for name in ("w1", "w2"):
+        p = api.get("Pod", "default", name)
+        api.update("Pod", dataclasses.replace(p, phase="Running"))
+    cm.pump_until_stable()
+    eps = api.get("Endpoints", "default", "svc")
+    assert sorted(a.pod_key for a in eps.addresses) == ["default/w1", "default/w2"]
+    # pod dies -> address removed
+    api.delete("Pod", "default", "w1")
+    cm.pump_until_stable()
+    eps = api.get("Endpoints", "default", "svc")
+    assert [a.pod_key for a in eps.addresses] == ["default/w2"]
+
+
+# -------------------------------------------------------------------- gc
+
+
+def test_gc_cascade_on_owner_delete():
+    api, cm = mk_manager()
+    dep = Deployment(name="api", replicas=2,
+                     selector=LabelSelector(match_labels={"app": "api"}),
+                     template=mk_template({"app": "api"}))
+    api.create("Deployment", dep)
+    cm.pump_until_stable()
+    assert len(pods_of(api)) == 2
+    api.delete("Deployment", "default", "api")
+    cm.pump_until_stable()
+    assert api.list("ReplicaSet")[0] == []  # RS collected
+    assert pods_of(api) == []  # pods collected transitively
+
+
+def test_podgc_reaps_pods_on_vanished_nodes_and_terminated_excess():
+    api, cm = mk_manager()
+    cm.controllers["podgc"].terminated_threshold = 1
+    api.create("Node", make_node("n1"))
+    api.create("Pod", make_pod("on-gone-node", node_name="ghost"))
+    api.create("Pod", dataclasses.replace(make_pod("done1"), phase="Succeeded"))
+    api.create("Pod", dataclasses.replace(make_pod("done2"), phase="Succeeded"))
+    cm.pump_until_stable()
+    cm.controllers["podgc"].resync()
+    cm.pump_until_stable()
+    names = {p.name for p in pods_of(api)}
+    assert "on-gone-node" not in names
+    assert names == {"done2"}  # oldest terminated reaped down to threshold
+
+
+# ------------------------------------------------------------------ namespace
+
+
+def test_namespace_lifecycle_deletes_contents():
+    api, cm = mk_manager()
+    api.create("Namespace", Namespace(name="team-a"))
+    api.create("Pod", make_pod("p1", namespace="team-a"))
+    api.create("Service", Service(name="s1", namespace="team-a"))
+    api.create("Pod", make_pod("keep", namespace="default"))
+    cm.pump_until_stable()
+    delete_namespace(api, "team-a")
+    cm.pump_until_stable()
+    assert all(p.namespace != "team-a" for p in api.list("Pod")[0])
+    assert api.list("Service")[0] == []
+    assert [p.name for p in api.list("Pod")[0]] == ["keep"]
+    import pytest
+    from kubernetes_tpu.server.apiserver_lite import NotFound
+    with pytest.raises(NotFound):
+        api.get("Namespace", "", "team-a")
+
+
+# ------------------------------------------------------------------ threaded
+
+
+def test_controller_manager_threaded_converges():
+    api = ApiServerLite()
+    cm = ControllerManager(api, record_events=False)
+    rs = ReplicaSet(name="web", replicas=5,
+                    selector=LabelSelector(match_labels={"app": "web"}),
+                    template=mk_template({"app": "web"}))
+    api.create("ReplicaSet", rs)
+    cm.start(workers=2, poll=0.005)
+    import time
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if len(pods_of(api)) == 5:
+            break
+        time.sleep(0.02)
+    cm.stop()
+    assert len(pods_of(api)) == 5
