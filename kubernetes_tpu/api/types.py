@@ -453,6 +453,10 @@ class Node:
     unschedulable: bool = False
     conditions: List[NodeCondition] = field(default_factory=list)
     images: List[ContainerImage] = field(default_factory=list)
+    # LastHeartbeatTime of the Ready condition (v1.NodeCondition) — written by
+    # the kubelet status loop, read by the node lifecycle controller's
+    # monitorNodeStatus (pkg/controller/node/node_controller.go:523)
+    heartbeat: float = 0.0
     resource_version: int = 0
 
     def condition(self, ctype: str) -> ConditionStatus:

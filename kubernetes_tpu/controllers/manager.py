@@ -22,6 +22,7 @@ from kubernetes_tpu.controllers.endpoint import EndpointController
 from kubernetes_tpu.controllers.gc import GarbageCollector, PodGCController
 from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.namespace import NamespaceController
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.server.apiserver_lite import ApiServerLite
@@ -45,7 +46,12 @@ class ControllerManager:
             "namespace": NamespaceController(api, self.factory),
             "garbagecollector": GarbageCollector(api, self.factory),
             "podgc": PodGCController(api, self.factory),
+            "nodelifecycle": NodeLifecycleController(api, self.factory, **kw),
         }
+        self.monitor_period = 5.0  # --node-monitor-period
+        self.gc_resync_period = 60.0  # GC full-orphan-scan cadence
+        self._ticker: Optional[threading.Thread] = None
+        self._ticker_stop = threading.Event()
         self.elector: Optional[LeaderElector] = None
         if leader_elect:
             self.elector = LeaderElector(
@@ -84,11 +90,39 @@ class ControllerManager:
             self._start_workers(workers)
 
     def _start_workers(self, workers: int = 2) -> None:
+        if self._running:
+            return  # leadership re-acquired: workers/ticker already live
         self._running = True
         for c in self.controllers.values():
             c.run(workers=workers)
+        # periodic monitors: node heartbeat checks every --node-monitor-period
+        # (5s); GC resyncs on their own much slower cadence (the reference
+        # resyncs GC on the order of minutes, not the heartbeat period)
+        def guarded(fn):
+            # one bad tick must not kill monitoring forever
+            # (Controller._worker gives workers the same shield)
+            try:
+                fn()
+            except Exception:
+                pass
+
+        def tick_loop():
+            last_gc = time.monotonic()
+            while not self._ticker_stop.wait(self.monitor_period):
+                guarded(self.controllers["nodelifecycle"].monitor_tick)
+                if time.monotonic() - last_gc >= self.gc_resync_period:
+                    last_gc = time.monotonic()
+                    guarded(self.controllers["garbagecollector"].resync)
+                    guarded(self.controllers["podgc"].resync)
+
+        t = threading.Thread(target=tick_loop, daemon=True, name="cm-ticker")
+        t.start()
+        self._ticker = t
 
     def stop(self) -> None:
+        self._ticker_stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=2.0)
         if self.elector is not None:
             self.elector.stop()
         for c in self.controllers.values():

@@ -1,0 +1,228 @@
+"""Hollow kubelet: the node agent's pod lifecycle + status machinery.
+
+What is mirrored from pkg/kubelet (kubelet.go syncLoop/syncPod and kubemark's
+hollow_kubelet.go):
+
+- consume bound pods for this node from the watch stream (the apiserver pod
+  source, pkg/kubelet/config/apiserver.go)
+- node-side admission re-running GeneralPredicates against local state
+  (kubelet lifecycle handler, pkg/kubelet/lifecycle/predicate.go) — a pod the
+  scheduler raced onto a full node goes Failed/OutOfResources, it does not run
+- pod startup: Pending -> Running after a simulated runtime latency (the
+  kubemark FakeDockerClient EnableSleep behavior,
+  cmd/kubemark/hollow-node.go:119-121)
+- run-to-completion: pods annotated `bench/run-seconds` go Succeeded (or
+  Failed via `bench/fail`) when their runtime elapses — restartPolicy Never
+  semantics for Job benchmarking
+- status loop: heartbeat on the Node object (status manager + node status
+  update, kubelet.go:1255 Run's updateRuntimeUp/syncNodeStatus)
+
+HollowFleet multiplexes one informer across N kubelets by node-name index —
+5k kubelets cost one watch cursor, the way kubemark's shared apiserver watch
+cache absorbs 5k real watches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import (
+    ConditionStatus,
+    Node,
+    NodeCondition,
+    Pod,
+)
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite, Conflict, NotFound
+
+RUN_SECONDS_ANNOTATION = "bench/run-seconds"
+FAIL_ANNOTATION = "bench/fail"
+
+
+class HollowKubelet:
+    def __init__(self, api: ApiServerLite, node: Node,
+                 startup_latency: float = 0.0,
+                 now: Callable[[], float] = time.monotonic):
+        self.api = api
+        self.node_name = node.name
+        self._template = node
+        self._now = now
+        self.startup_latency = startup_latency
+        # pod key -> ready_at (startup in flight)
+        self._starting: Dict[str, float] = {}
+        # pod key -> finish_at (run-to-completion in flight)
+        self._running_until: Dict[str, float] = {}
+        self._admitted: Dict[str, Pod] = {}  # local running set
+
+    # ----------------------------------------------------------- node status
+
+    def register(self) -> None:
+        """Initial node registration (kubelet registerWithAPIServer)."""
+        node = dataclasses.replace(self._template, heartbeat=self._now())
+        try:
+            self.api.create("Node", node)
+        except Conflict:
+            self.heartbeat()
+
+    def heartbeat(self) -> None:
+        """syncNodeStatus: bump heartbeat + assert Ready."""
+        try:
+            cur: Node = self.api.get("Node", "", self.node_name)
+        except NotFound:
+            return
+        conds = [c for c in cur.conditions if c.type != "Ready"]
+        conds.append(NodeCondition("Ready", ConditionStatus.TRUE))
+        self.api.update("Node", dataclasses.replace(
+            cur, heartbeat=self._now(), conditions=conds))
+
+    # ------------------------------------------------------------- pod flow
+
+    def _local_usage(self) -> tuple:
+        cpu = mem = count = 0
+        for p in self._admitted.values():
+            r = p.resource_request()
+            cpu += r.milli_cpu
+            mem += r.memory
+            count += 1
+        return cpu, mem, count
+
+    def _admit(self, pod: Pod) -> Optional[str]:
+        """GeneralPredicates node-side: capacity re-check against local state
+        (lifecycle/predicate.go). Returns rejection reason or None."""
+        r = pod.resource_request()
+        cpu, mem, count = self._local_usage()
+        alloc = self._template.allocatable
+        if count + 1 > self._template.allowed_pod_number:
+            return "OutOfPods"
+        if cpu + r.milli_cpu > alloc.milli_cpu:
+            return "OutOfcpu"
+        if mem + r.memory > alloc.memory:
+            return "OutOfmemory"
+        return None
+
+    def handle_pod(self, pod: Pod) -> None:
+        """A bound pod appeared/changed for this node (syncLoopIteration
+        ADD/UPDATE)."""
+        key = pod.key()
+        if pod.phase in ("Succeeded", "Failed"):
+            self._forget(key)
+            return
+        if key in self._admitted or key in self._starting:
+            return
+        reason = self._admit(pod)
+        if reason is not None:
+            self._set_phase(pod, "Failed", reason)
+            return
+        self._admitted[key] = pod
+        self._starting[key] = self._now() + self.startup_latency
+
+    def forget_pod(self, pod: Pod) -> None:
+        """Pod deleted from the apiserver (kubelet HandlePodRemoves)."""
+        self._forget(pod.key())
+
+    def _forget(self, key: str) -> None:
+        self._admitted.pop(key, None)
+        self._starting.pop(key, None)
+        self._running_until.pop(key, None)
+
+    def step(self) -> int:
+        """One PLEG relist: advance startups and completions. Returns number
+        of status transitions written."""
+        now = self._now()
+        wrote = 0
+        for key, ready_at in list(self._starting.items()):
+            if now < ready_at:
+                continue
+            del self._starting[key]
+            pod = self._admitted.get(key)
+            if pod is None:
+                continue
+            run_s = pod.annotations.get(RUN_SECONDS_ANNOTATION)
+            if self._set_phase(pod, "Running"):
+                wrote += 1
+            if run_s is not None:
+                self._running_until[key] = now + float(run_s)
+        for key, done_at in list(self._running_until.items()):
+            if now < done_at:
+                continue
+            del self._running_until[key]
+            pod = self._admitted.pop(key, None)
+            if pod is None:
+                continue
+            final = "Failed" if pod.annotations.get(FAIL_ANNOTATION) else "Succeeded"
+            if self._set_phase(pod, final):
+                wrote += 1
+        return wrote
+
+    def _set_phase(self, pod: Pod, phase: str, reason: str = "") -> bool:
+        """Status-manager PATCH with conflict retry."""
+        for _ in range(3):
+            try:
+                cur: Pod = self.api.get("Pod", pod.namespace, pod.name)
+            except NotFound:
+                self._forget(pod.key())
+                return False
+            if cur.node_name != self.node_name:
+                return False  # rebound elsewhere
+            ann = dict(cur.annotations)
+            if reason:
+                ann["kubernetes.io/failure-reason"] = reason
+            try:
+                self.api.update("Pod", dataclasses.replace(
+                    cur, phase=phase, annotations=ann),
+                    expect_rv=cur.resource_version)
+                return True
+            except Conflict:
+                continue
+            except NotFound:
+                return False
+        return False
+
+
+class HollowFleet:
+    """N hollow kubelets behind ONE pod informer (by-node index dispatch)."""
+
+    def __init__(self, api: ApiServerLite, factory: SharedInformerFactory,
+                 startup_latency: float = 0.0,
+                 now: Callable[[], float] = time.monotonic):
+        self.api = api
+        self._now = now
+        self.startup_latency = startup_latency
+        self.kubelets: Dict[str, HollowKubelet] = {}
+        self.pod_informer = factory.informer("Pod")
+        self.pod_informer.add_event_handler(
+            on_add=self._dispatch_add,
+            on_update=self._dispatch_update,
+            on_delete=self._dispatch_delete)
+
+    def add_node(self, node: Node, register: bool = True) -> HollowKubelet:
+        kl = HollowKubelet(self.api, node,
+                           startup_latency=self.startup_latency, now=self._now)
+        self.kubelets[node.name] = kl
+        if register:
+            kl.register()
+        return kl
+
+    def _dispatch_add(self, pod: Pod) -> None:
+        if pod.node_name and pod.node_name in self.kubelets:
+            self.kubelets[pod.node_name].handle_pod(pod)
+
+    def _dispatch_update(self, old: Pod, new: Pod) -> None:
+        if old.node_name and old.node_name != new.node_name \
+                and old.node_name in self.kubelets:
+            self.kubelets[old.node_name].forget_pod(old)
+        self._dispatch_add(new)
+
+    def _dispatch_delete(self, pod: Pod) -> None:
+        if pod.node_name and pod.node_name in self.kubelets:
+            self.kubelets[pod.node_name].forget_pod(pod)
+
+    def step(self) -> int:
+        """Advance every kubelet's pod state machines."""
+        return sum(kl.step() for kl in self.kubelets.values())
+
+    def heartbeat_all(self) -> None:
+        for kl in self.kubelets.values():
+            kl.heartbeat()

@@ -1,0 +1,69 @@
+"""Hollow proxy: the kube-proxy analog — Services/Endpoints -> routing table.
+
+Mirrors pkg/proxy/iptables/proxier.go's shape without the kernel: every sync
+is a FULL table rebuild from watched state (syncProxyRules at proxier.go:966
+rewrites the whole KUBE-SERVICES chain each pass — same idiom here, a dict
+swap), and routing picks a backend per connection. The reference's iptables
+probability-based load balancing becomes deterministic round-robin.
+
+The table is identical on every node (kube-proxy programs the same rules
+fleet-wide), so one HollowProxy instance serves the whole hollow cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.client.informer import SharedInformerFactory
+
+# route key: "<ns>/<service>:<port>" -> list of (ip, target_port, node_name)
+Backend = Tuple[str, int, str]
+
+
+class HollowProxy:
+    def __init__(self, factory: SharedInformerFactory):
+        self.svc_informer = factory.informer("Service")
+        self.eps_informer = factory.informer("Endpoints")
+        self._lock = threading.Lock()
+        self._table: Dict[str, List[Backend]] = {}
+        self._rr: Dict[str, int] = {}
+        self.sync_count = 0
+        # any change triggers a full resync, proxier.go-style
+        for inf in (self.svc_informer, self.eps_informer):
+            inf.add_event_handler(
+                on_add=lambda o: self.sync_rules(),
+                on_update=lambda old, new: self.sync_rules(),
+                on_delete=lambda o: self.sync_rules())
+
+    def sync_rules(self) -> None:
+        """Full-table rewrite from current Services x Endpoints."""
+        eps_by_key = {e.key(): e for e in self.eps_informer.store.list()}
+        table: Dict[str, List[Backend]] = {}
+        for svc in self.svc_informer.store.list():
+            eps = eps_by_key.get(svc.key())
+            backends_src = eps.addresses if eps else []
+            for port in svc.ports or []:
+                route_key = f"{svc.key()}:{port.port}"
+                table[route_key] = [
+                    (a.ip, port.target_port or port.port, a.node_name)
+                    for a in backends_src]
+        with self._lock:
+            self._table = table
+            self.sync_count += 1
+
+    def route(self, service_key: str, port: int) -> Optional[Backend]:
+        """One connection: round-robin over ready backends (the userspace
+        proxy's LoadBalancerRR, pkg/proxy/userspace/roundrobin.go)."""
+        key = f"{service_key}:{port}"
+        with self._lock:
+            backends = self._table.get(key)
+            if not backends:
+                return None
+            i = self._rr.get(key, 0) % len(backends)
+            self._rr[key] = i + 1
+            return backends[i]
+
+    def backends(self, service_key: str, port: int) -> List[Backend]:
+        with self._lock:
+            return list(self._table.get(f"{service_key}:{port}", ()))

@@ -1,0 +1,294 @@
+"""Hollow kubelet / proxy / node lifecycle tests.
+
+Behavioral shape from the reference's kubelet status tests, kubemark
+hollow-node flow, proxier sync tests, and node_controller_test.go's
+fake-clock eviction scenarios.
+"""
+
+import dataclasses
+
+from kubernetes_tpu.api.types import (
+    ConditionStatus,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TolerationOperator,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.api.workloads import Service, ServicePort
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.nodelifecycle import (
+    ZONE_LABEL,
+    NodeLifecycleController,
+    TAINT_UNREACHABLE,
+)
+from kubernetes_tpu.nodes.kubelet import HollowFleet
+from kubernetes_tpu.nodes.proxy import HollowProxy
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.utils import features
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def mk_fleet(n_nodes=2, clock=None, **kw):
+    clock = clock or FakeClock()
+    api = ApiServerLite()
+    factory = SharedInformerFactory(api)
+    fleet = HollowFleet(api, factory, now=clock, **kw)
+    for i in range(n_nodes):
+        fleet.add_node(make_node(f"n{i}", cpu=1000, memory=1 << 30, pods=4))
+    factory.step_all()
+    return api, factory, fleet, clock
+
+
+# ------------------------------------------------------------------ kubelet
+
+
+def test_kubelet_runs_bound_pod():
+    api, factory, fleet, clock = mk_fleet()
+    api.create("Pod", make_pod("p", cpu=100, node_name="n0"))
+    factory.step_all()
+    fleet.step()
+    assert api.get("Pod", "default", "p").phase == "Running"
+
+
+def test_kubelet_startup_latency_and_completion():
+    api, factory, fleet, clock = mk_fleet(startup_latency=3.0)
+    pod = make_pod("job-pod", cpu=100, node_name="n0")
+    pod.annotations["bench/run-seconds"] = "10"
+    api.create("Pod", pod)
+    factory.step_all()
+    fleet.step()
+    assert api.get("Pod", "default", "job-pod").phase == "Pending"  # starting
+    clock.t += 3.0
+    fleet.step()
+    assert api.get("Pod", "default", "job-pod").phase == "Running"
+    clock.t += 10.0
+    fleet.step()
+    assert api.get("Pod", "default", "job-pod").phase == "Succeeded"
+
+
+def test_kubelet_admission_rejects_over_capacity():
+    api, factory, fleet, clock = mk_fleet()  # nodes: 1000m cpu
+    api.create("Pod", make_pod("big1", cpu=800, node_name="n0"))
+    factory.step_all()
+    fleet.step()
+    # second pod over cpu capacity on the same node
+    api.create("Pod", make_pod("big2", cpu=800, node_name="n0"))
+    factory.step_all()
+    fleet.step()
+    p2 = api.get("Pod", "default", "big2")
+    assert p2.phase == "Failed"
+    assert p2.annotations["kubernetes.io/failure-reason"] == "OutOfcpu"
+    # but it fits on the other node
+    api.create("Pod", make_pod("big3", cpu=800, node_name="n1"))
+    factory.step_all()
+    fleet.step()
+    assert api.get("Pod", "default", "big3").phase == "Running"
+
+
+def test_kubelet_heartbeat_updates_node():
+    api, factory, fleet, clock = mk_fleet()
+    clock.t += 100.0
+    fleet.heartbeat_all()
+    node = api.get("Node", "", "n0")
+    assert node.heartbeat == clock.t
+    assert node.condition("Ready") == ConditionStatus.TRUE
+
+
+def test_kubelet_forgets_deleted_pod_freeing_capacity():
+    api, factory, fleet, clock = mk_fleet()
+    api.create("Pod", make_pod("a", cpu=800, node_name="n0"))
+    factory.step_all()
+    fleet.step()
+    api.delete("Pod", "default", "a")
+    factory.step_all()
+    api.create("Pod", make_pod("b", cpu=800, node_name="n0"))
+    factory.step_all()
+    fleet.step()
+    assert api.get("Pod", "default", "b").phase == "Running"
+
+
+# -------------------------------------------------------------------- proxy
+
+
+def test_proxy_routes_round_robin_and_resyncs():
+    api = ApiServerLite()
+    factory = SharedInformerFactory(api)
+    from kubernetes_tpu.controllers.endpoint import EndpointController
+    epc = EndpointController(api, factory, record_events=False)
+    proxy = HollowProxy(factory)
+    api.create("Service", Service(name="web", selector={"app": "web"},
+                                  ports=[ServicePort(port=80, target_port=8080)]))
+    for i in range(3):
+        api.create("Pod", dataclasses.replace(
+            make_pod(f"w{i}", labels={"app": "web"}, node_name=f"n{i}"),
+            phase="Running"))
+    factory.step_all()
+    epc.pump()
+    factory.step_all()
+    backends = proxy.backends("default/web", 80)
+    assert len(backends) == 3
+    assert all(port == 8080 for _, port, _ in backends)
+    picked = {proxy.route("default/web", 80)[2] for _ in range(3)}
+    assert picked == {"n0", "n1", "n2"}  # round robin covers all
+    # endpoint removal propagates
+    api.delete("Pod", "default", "w0")
+    factory.step_all()
+    epc.pump()
+    factory.step_all()
+    assert len(proxy.backends("default/web", 80)) == 2
+    assert proxy.route("default/unknown", 80) is None
+
+
+# ----------------------------------------------------------- node lifecycle
+
+
+def mk_lifecycle(n_nodes=4, zones=1, clock=None, **kw):
+    clock = clock or FakeClock()
+    api = ApiServerLite()
+    factory = SharedInformerFactory(api)
+    for i in range(n_nodes):
+        node = make_node(f"n{i}", labels={ZONE_LABEL: f"z{i % zones}"})
+        node.heartbeat = clock.t
+        api.create("Node", node)
+    nlc = NodeLifecycleController(
+        api, factory, grace_period=40.0, eviction_timeout=300.0,
+        record_events=False, now=clock, **kw)
+    factory.step_all()
+    return api, factory, nlc, clock
+
+
+def test_dead_node_marked_unknown_then_pods_evicted():
+    api, factory, nlc, clock = mk_lifecycle()
+    api.create("Pod", make_pod("victim", node_name="n0"))
+    api.create("Pod", make_pod("safe", node_name="n1"))
+    factory.step_all()
+    # n0's kubelet dies; others keep heartbeating
+    for tick in range(8):
+        clock.t += 60.0
+        for i in (1, 2, 3):
+            n = api.get("Node", "", f"n{i}")
+            api.update("Node", dataclasses.replace(n, heartbeat=clock.t))
+        factory.step_all()
+        nlc.monitor_tick()
+        factory.step_all()
+    assert api.get("Node", "", "n0").condition("Ready") == ConditionStatus.UNKNOWN
+    names = {p.name for p in api.list("Pod")[0]}
+    assert "victim" not in names and "safe" in names
+
+
+def test_static_node_gets_grace_from_first_observation():
+    """A Node that never heartbeat (heartbeat=0.0: decoded/static objects)
+    must get the grace period from first observation, not be drained on the
+    first tick."""
+    clock = FakeClock(t=50_000.0)  # monotonic clock far from 0
+    api = ApiServerLite()
+    factory = SharedInformerFactory(api)
+    api.create("Node", make_node("static"))  # heartbeat defaults to 0.0
+    api.create("Node", make_node("live"))
+    api.create("Pod", make_pod("p", node_name="static"))
+    nlc = NodeLifecycleController(api, factory, grace_period=40.0,
+                                  eviction_timeout=60.0, record_events=False,
+                                  now=clock)
+    factory.step_all()
+
+    def tick(dt):
+        clock.t += dt
+        n = api.get("Node", "", "live")
+        api.update("Node", dataclasses.replace(n, heartbeat=clock.t))
+        factory.step_all()
+        nlc.monitor_tick()
+        factory.step_all()
+
+    tick(0.0)
+    assert api.get("Node", "", "static").condition("Ready") == ConditionStatus.TRUE
+    assert len(api.list("Pod")[0]) == 1  # not drained on first observation
+    # but with nobody ever heartbeating it, it IS eventually drained
+    for _ in range(8):
+        tick(30.0)
+    assert api.get("Node", "", "static").condition("Ready") == ConditionStatus.UNKNOWN
+    assert api.list("Pod")[0] == []
+
+
+def test_full_zone_disruption_stops_evictions():
+    api, factory, nlc, clock = mk_lifecycle(n_nodes=4, zones=2)
+    api.create("Pod", make_pod("p0", node_name="n0"))
+    factory.step_all()
+    # zone z0 = {n0, n2}: kill both kubelets; z1 stays healthy
+    for tick in range(8):
+        clock.t += 60.0
+        for i in (1, 3):
+            n = api.get("Node", "", f"n{i}")
+            api.update("Node", dataclasses.replace(n, heartbeat=clock.t))
+        factory.step_all()
+        nlc.monitor_tick()
+        factory.step_all()
+    assert nlc.zone_states["z0"] == "FullDisruption"
+    # pods NOT evicted despite timeout: master assumes its own partition
+    assert any(p.name == "p0" for p in api.list("Pod")[0])
+
+
+def test_taint_based_eviction_spares_tolerating_pods():
+    features.DEFAULT_FEATURE_GATE.set("TaintBasedEvictions", True)
+    try:
+        api, factory, nlc, clock = mk_lifecycle()
+        tol = Toleration(key=TAINT_UNREACHABLE,
+                         operator=TolerationOperator.EXISTS,
+                         effect=TaintEffect.NO_EXECUTE)
+        api.create("Pod", make_pod("tolerant", node_name="n0",
+                                   tolerations=[tol]))
+        api.create("Pod", make_pod("intolerant", node_name="n0"))
+        factory.step_all()
+        for tick in range(10):
+            clock.t += 60.0
+            for i in (1, 2, 3):
+                n = api.get("Node", "", f"n{i}")
+                api.update("Node", dataclasses.replace(n, heartbeat=clock.t))
+            factory.step_all()
+            nlc.monitor_tick()
+            factory.step_all()
+        node = api.get("Node", "", "n0")
+        assert any(t.key == TAINT_UNREACHABLE for t in node.taints)
+        names = {p.name for p in api.list("Pod")[0]}
+        assert names == {"tolerant"}
+        # node recovers: taint removed
+        api.update("Node", dataclasses.replace(node, heartbeat=clock.t))
+        factory.step_all()
+        nlc.monitor_tick()
+        factory.step_all()
+        assert api.get("Node", "", "n0").taints == []
+    finally:
+        features.DEFAULT_FEATURE_GATE.reset()
+
+
+def test_eviction_rate_limited_across_nodes_in_zone():
+    api, factory, nlc, clock = mk_lifecycle(n_nodes=10)
+    for i in range(5):  # 5 dead nodes with a pod each
+        api.create("Pod", make_pod(f"p{i}", node_name=f"n{i}"))
+    factory.step_all()
+
+    def tick(dt):
+        clock.t += dt
+        for i in range(5, 10):  # n5..n9 keep heartbeating
+            n = api.get("Node", "", f"n{i}")
+            api.update("Node", dataclasses.replace(n, heartbeat=clock.t))
+        factory.step_all()
+        nlc.monitor_tick()
+        factory.step_all()
+        return len(api.list("Pod")[0])
+
+    counts = [tick(60.0) for _ in range(12)]
+    # evictions begin once unhealthy-duration crosses 300s, then proceed at
+    # most one node-drain per tick (rate 0.1/s, burst 1, 60s ticks)
+    assert counts[0] == 5  # within timeout: nothing evicted
+    assert counts[-1] == 0  # eventually all drained
+    drops = [a - b for a, b in zip(counts, counts[1:])]
+    assert max(drops) == 1, f"rate limit breached: {counts}"
