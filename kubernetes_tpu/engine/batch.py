@@ -144,6 +144,16 @@ def _commit(state: NodeState, sel: jnp.ndarray, ok: jnp.ndarray,
 
 
 @functools.partial(jax.jit, static_argnames=("priorities",))
+def gather_place_batch(cls_arr: Arrays, pc: jnp.ndarray, nodes: Arrays,
+                       state: "NodeState", rr: jnp.ndarray, priorities):
+    """place_batch over per-pod rows gathered from class rows (pc = class
+    index per pod). The gather runs inside the jit so padding/bucketed
+    shapes cost no standalone eager-op compiles."""
+    parr = jax.tree.map(lambda a: a[pc], cls_arr)
+    return place_batch(parr, nodes, state, rr, priorities)
+
+
+@functools.partial(jax.jit, static_argnames=("priorities",))
 def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
                 rr_counter: jnp.ndarray,
                 priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,

@@ -30,8 +30,6 @@ from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
 from kubernetes_tpu.ops import priorities as prio
 from kubernetes_tpu.server.apiserver_lite import (
     ApiServerLite,
-    Conflict,
-    NotFound,
     TooOldResourceVersion,
 )
 from kubernetes_tpu.state.cache import SchedulerCache
@@ -46,9 +44,13 @@ class Scheduler:
                  priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
                  assumed_ttl: float = 30.0,
                  record_events: bool = True,
+                 batch_mode: str = "wave",
                  now=time.monotonic):
         self.api = api
         self.scheduler_name = scheduler_name
+        # "wave" = wave-parallel throughput mode (engine/waves.py, default);
+        # "strict" = bit-exact sequential scheduleOne parity (engine/batch.py)
+        self.batch_mode = batch_mode
         self._now = now
         self.cache = SchedulerCache(ttl_seconds=assumed_ttl, now=now)
         # Service/RC/RS/StatefulSet mirror for spreading & service affinity —
@@ -140,9 +142,11 @@ class Scheduler:
             self.queue.backoff.gc()
             return stats
         t0 = time.monotonic()
-        results = self.engine.schedule(pods, assume=True)
+        results = self.engine.schedule(pods, assume=True,
+                                       mode=self.batch_mode)
         t_alg = time.monotonic() - t0
         per_pod_alg = t_alg / max(len(pods), 1)
+        placed = []
         for r in results:
             if r.node_name is None:
                 stats["unschedulable"] += 1
@@ -151,28 +155,35 @@ class Scheduler:
                             f"0/{len(self.engine.snapshot.node_names)} nodes "
                             f"available (fit_count={r.fit_count})")
                 self.queue.add_backoff(r.pod)
-                continue
-            tb0 = time.monotonic()
-            try:
-                self.api.bind(Binding(r.pod.name, r.pod.namespace, r.pod.uid,
-                                      r.node_name))
-            except (Conflict, NotFound) as e:
+            else:
+                placed.append(r)
+        # one batched /binding pass (per-binding semantics identical to the
+        # per-pod POST; scheduler.go:224-250 error paths preserved per pod)
+        tb0 = time.monotonic()
+        errs = self.api.bind_many(
+            [Binding(r.pod.name, r.pod.namespace, r.pod.uid, r.node_name)
+             for r in placed])
+        per_bind = (time.monotonic() - tb0) / max(len(placed), 1)
+        bound_pods = []
+        for r, err in zip(placed, errs):
+            if err is not None:
                 # undo the optimistic assume (scheduler.go:234-245)
                 stats["bind_errors"] += 1
                 self.cache.forget_pod(r.pod)
-                self._event(r.pod, "Warning", "FailedBinding", str(e))
+                self._event(r.pod, "Warning", "FailedBinding", err)
                 retry = dataclasses.replace(r.pod, node_name="")
                 self.queue.add_backoff(retry)
                 continue
-            t_bind = time.monotonic() - tb0
-            self.cache.finish_binding(r.pod)
+            bound_pods.append(r.pod)
             stats["bound"] += 1
-            self.metrics.scheduled.inc()
-            self.metrics.algorithm_latency.observe(per_pod_alg)
-            self.metrics.binding_latency.observe(t_bind)
-            self.metrics.e2e_latency.observe(per_pod_alg + t_bind)
             self._event(r.pod, "Normal", "Scheduled",
                         f"Successfully assigned {r.pod.key()} to {r.node_name}")
+        self.cache.finish_bindings_bulk(bound_pods)
+        n = len(bound_pods)
+        self.metrics.scheduled.inc(n)
+        self.metrics.algorithm_latency.observe_many(per_pod_alg, n)
+        self.metrics.binding_latency.observe_many(per_bind, n)
+        self.metrics.e2e_latency.observe_many(per_pod_alg + per_bind, n)
         self.cache.cleanup_assumed()
         self.queue.backoff.gc()
         return stats

@@ -28,12 +28,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.types import Pod
-from kubernetes_tpu.engine.batch import NodeState, place_batch
+from kubernetes_tpu.engine.batch import NodeState, gather_place_batch
+from kubernetes_tpu.engine import waves
 from kubernetes_tpu.ops import oracle
 from kubernetes_tpu.ops import priorities as prio
-from kubernetes_tpu.ops.predicates import node_arrays, pod_arrays
+from kubernetes_tpu.ops.predicates import bucket
 from kubernetes_tpu.state.cache import SchedulerCache
-from kubernetes_tpu.state.snapshot import ClusterSnapshot, PodBatch
+from kubernetes_tpu.state.classes import ClassBatch
+from kubernetes_tpu.state.snapshot import ClusterSnapshot
 
 
 class PlacementResult:
@@ -71,25 +73,33 @@ class SchedulingEngine:
 
     # ------------------------------------------------------------------ api
 
-    def schedule(self, pods: Sequence[Pod], assume: bool = True
-                 ) -> List[PlacementResult]:
+    def schedule(self, pods: Sequence[Pod], assume: bool = True,
+                 mode: str = "strict") -> List[PlacementResult]:
         """Schedule a batch. Returns one PlacementResult per pod, in input
         order. When assume=True, successful placements are assumed into the
-        cache with pod.node_name set (the caller binds asynchronously)."""
+        cache with pod.node_name set (the caller binds asynchronously).
+
+        mode="strict" reproduces the reference's sequential scheduleOne
+        semantics exactly (engine/batch.py lax.scan); mode="wave" is the
+        wave-parallel throughput mode (engine/waves.py) with identical
+        predicate/priority integer semantics but batch-defined tie-spreading.
+        """
         if not pods:
             return []
         infos = self.cache.node_infos()
         self.snapshot.refresh(infos, volume_ctx=self.volume_ctx)
-        # PodBatch first: selector compilation may grow the label vocab and
-        # rebuild the label matrix; upload happens after, dirty-arrays only
-        batch = PodBatch(pods, self.snapshot)
-        nodes = self._nodes_on_device()
+        # ClassBatch first: selector compilation may grow the label vocab and
+        # rebuild the label matrix; upload happens after, dirty-arrays only.
+        # Encoding runs once per distinct pod spec (state/classes.py — the
+        # tensor analog of the equivalence cache, equivalence_cache.go:54).
+        batch = ClassBatch(pods, self.snapshot)
 
         # Symmetry routing (predicates.go:1146): a pod with NO affinity of
         # its own can still be blocked by an EXISTING pod's required
         # anti-affinity (or by an affinity pod earlier in this batch). Pods
         # matching any such term take the exact host path — the device kernel
-        # doesn't model the symmetry check yet.
+        # doesn't model the symmetry check yet. Class-level: the verdict
+        # depends only on spec fields covered by the class key.
         from kubernetes_tpu.ops.oracle_ext import term_matches_pod
         anti_terms = []
         for info in infos.values():
@@ -97,43 +107,77 @@ class SchedulingEngine:
                 if e.affinity and e.affinity.pod_anti_affinity:
                     for term in e.affinity.pod_anti_affinity.required_terms:
                         anti_terms.append((term, e))
-        for p in pods:
+        for p in batch.reps:
             if p.affinity and p.affinity.pod_anti_affinity:
                 for term in p.affinity.pod_anti_affinity.required_terms:
                     anti_terms.append((term, p))
         if anti_terms:
-            for i in range(len(pods)):
-                if not batch.needs_host_check[i] and any(
-                        term_matches_pod(term, owner, pods[i])
+            for c, rep in enumerate(batch.reps):
+                if not batch.reps_batch.needs_host_check[c] and any(
+                        term_matches_pod(term, owner, rep)
                         for term, owner in anti_terms):
-                    batch.needs_host_check[i] = True
+                    batch.mark_host_check_class(c)
+        # size the port bitmap to the highest word any node uses or any batch
+        # pod requests (power-of-2 bucketed so the compiled shapes are stable)
+        max_words = self.snapshot.port_words_used()
+        if np.any(batch.reps_batch.ports >= 0):
+            max_words = max(max_words,
+                            int(batch.reps_batch.ports.max()) // 32 + 1)
+        port_words = bucket(max(max_words, 1), lo=1)
+        nodes = self._nodes_on_device(port_words=port_words)
 
-        fast_idx = [i for i in range(len(pods)) if not batch.needs_host_check[i]]
-        slow_idx = [i for i in range(len(pods)) if batch.needs_host_check[i]]
+        nhc = batch.reps_batch.needs_host_check[batch.pod_class]
+        fast_idx = np.nonzero(~nhc)[0]
+        slow_idx = np.nonzero(nhc)[0].tolist()
         results: List[Optional[PlacementResult]] = [None] * len(pods)
 
-        if fast_idx:
-            if len(fast_idx) == len(pods):
-                fast_batch = batch
-            else:
-                fast_batch = PodBatch([pods[i] for i in fast_idx], self.snapshot)
-            parr = pod_arrays(fast_batch)
+        if len(fast_idx):
+            # shape bucketing: pad the class axis and the pod axis to
+            # power-of-2 buckets so round-over-round batch sizes reuse the
+            # same compiled kernels. Padding classes are `impossible` (fit
+            # nothing, commit nothing, no RR ticks) and padding pods map to
+            # the first padding class.
+            from kubernetes_tpu.ops.predicates import pod_arrays_padded
+            c_pad = bucket(batch.num_classes + 1)
+            cls_arr = pod_arrays_padded(batch.reps_batch, c_pad)
+            pf = len(fast_idx)
+            p_pad = bucket(pf)
+            pc_fast = np.full(p_pad, batch.num_classes, dtype=np.int32)
+            pc_fast[:pf] = batch.pod_class[fast_idx]
             state = NodeState(nodes["requested"], nodes["nonzero"],
                               nodes["pod_count"], nodes["port_bitmap"],
                               nodes["vol_present"], nodes["vol_rw"],
                               nodes["pd_present"], nodes["pd_counts"])
-            selected, fit_counts, _, rr_end = place_batch(
-                parr, nodes, state, jnp.uint32(self.rr.counter),
-                self.priorities)
-            selected = np.asarray(selected)
-            fit_counts = np.asarray(fit_counts)
+            if mode == "wave":
+                selected, fit_counts, _, rr_end = waves.place_waves(
+                    cls_arr, nodes, state, pc_fast, self.rr.counter,
+                    self.priorities)
+            else:
+                selected, fit_counts, _, rr_end = gather_place_batch(
+                    cls_arr, jnp.asarray(pc_fast), nodes, state,
+                    jnp.uint32(self.rr.counter), self.priorities)
+            selected = np.asarray(selected)[:pf]
+            fit_counts = np.asarray(fit_counts)[:pf]
             self.rr.counter = int(rr_end)
+            names = self.snapshot.node_names
+            placements = []
             for j, i in enumerate(fast_idx):
-                sel = int(selected[j])
-                name = self.snapshot.node_names[sel] if sel >= 0 else None
+                sel = selected[j]
+                name = names[sel] if sel >= 0 else None
                 results[i] = PlacementResult(pods[i], name, int(fit_counts[j]))
                 if name is not None and assume:
-                    self._assume(pods[i], name)
+                    pods[i].node_name = name
+                    placements.append((pods[i], pc_fast[j]))
+            if placements:
+                # one lock + one derived-quantity walk per PLACED class
+                derived: Dict[int, tuple] = {}
+                for _, c in placements:
+                    if c not in derived:
+                        rep = batch.reps[c]
+                        derived[c] = (rep.resource_request(),
+                                      *rep.nonzero_request(),
+                                      rep.used_ports())
+                self.cache.assume_pods_bulk(placements, derived)
 
         # exact host path for over-approximated pods, AFTER device placements
         # so they see committed capacity (FIFO order within themselves)
@@ -170,18 +214,28 @@ class SchedulingEngine:
                         "image_sizes", "has_zone", "vol_present", "vol_rw",
                         "pd_present", "pd_counts", "pd_kind", "pd_max")
 
-    def _nodes_on_device(self):
+    def _nodes_on_device(self, port_words: int = 1):
         """Incremental host->HBM sync: re-upload an array only when its shape
         changed or the snapshot marked it dirty. Steady-state rounds move only
-        requested/nonzero/pod_count (~KBs), not the 40MB+ full snapshot."""
+        requested/nonzero/pod_count (~KBs), not the 40MB+ full snapshot.
+
+        port_words: how many 32-bit words of the 65536-bit per-node port
+        bitmap to ship — the caller sizes it to cover the highest port in use
+        by any node or requested by any batch pod (bucketed, so width changes
+        rarely); a cluster with no host ports uploads one zero word per node
+        instead of 8KB."""
         snap = self.snapshot
         if self._device_nodes is None:
             self._device_nodes = {}
         for k in self._NODE_ARRAY_KEYS:
-            host = getattr(snap, k)
+            if k == "port_bitmap":
+                host = snap.port_bitmap[:, :port_words]
+            else:
+                host = getattr(snap, k)
             cur = self._device_nodes.get(k)
             if cur is None or cur.shape != host.shape or k in snap.dirty:
-                self._device_nodes[k] = jnp.asarray(host)
+                self._device_nodes[k] = jnp.asarray(
+                    np.ascontiguousarray(host) if k == "port_bitmap" else host)
         snap.dirty.clear()
         self._device_version = snap.version
         return self._device_nodes

@@ -1,0 +1,412 @@
+"""Wave-parallel batch placement: the whole queue in a handful of MXU passes.
+
+The strict engine (engine/batch.py) reproduces the reference's one-pod-at-a-
+time loop (scheduler.go:253 scheduleOne) exactly with a 30k-step lax.scan —
+bit-faithful, but latency-bound (~90us/step of sequential VPU work). This
+module is the throughput mode: batch placement is *new capability* relative
+to the reference (SURVEY.md §2.3 — the only in-tree batching notion is the
+strictly-sequential loop), so its semantics are defined here, TPU-first, per
+the SURVEY §7 step-2 design ("top-k per pod + greedy conflict resolution,
+capacity decremented as pods commit"):
+
+Wave semantics (deterministic, documented, score-exact):
+  1. All still-pending pods score every node against a FROZEN node state
+     using the *identical* predicate/priority kernels as the strict engine
+     (ops/predicates.py, ops/priorities.py — integer semantics preserved, so
+     individual scores bit-match generic_scheduler.go:88-142).
+  2. Each pod draws from the shared round-robin counter in FIFO order (a pod
+     with >1 fitting nodes consumes one draw, mirroring selectHost's counter
+     discipline at generic_scheduler.go:144-160) and targets the
+     (draw mod m)-th node of its class's max-score tie set — so a wave of
+     identical pods fans out across the whole tie set in ONE device program
+     instead of m sequential steps.
+  3. Per-node conflict resolution ON DEVICE: pods that picked the same node
+     are ordered FIFO; the longest prefix run of spec-equal pods that still
+     fits (exact integer capacity math, including the overlay->scratch
+     fallback of predicates.go:590-604) commits; the rest re-enter the next
+     wave against the updated state. Pods with host ports or volumes commit
+     at most one per node per wave (their within-wave interactions are not
+     modeled, so they serialize).
+  4. A pod whose class fits NO node under the frozen state is unschedulable:
+     capacity only shrinks as pods commit, so it could not have fit later in
+     the strict order either (monotonicity makes this verdict exact).
+
+  5. Score-aware acceptance: rank r on a node commits only while the node's
+     score AFTER r commits (exact integer re-evaluation of the dynamic
+     priorities at the evolved utilization) stays >= the frozen runner-up
+     score — reproducing the strict engine's score trajectory at integer
+     score granularity, so LeastRequested still spreads and MostRequested
+     still bin-packs within a single wave.
+
+Inputs are CLASS-level arrays (state/classes.py) — fits/scores are [C, N]
+with C = distinct pod specs, recovered per pod by gather. A uniform 30k-pod
+storm is C=1: one [1,N] score row + O(P) index math per wave.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kubernetes_tpu.api.types import MAX_PRIORITY
+from kubernetes_tpu.engine.batch import NodeState, gather_place_batch
+from kubernetes_tpu.ops import predicates as preds
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.state.snapshot import (
+    NUM_BASE_RESOURCES,
+    R_OVERLAY,
+    R_SCRATCH,
+)
+
+Arrays = Dict[str, jnp.ndarray]
+
+_BIG = np.int32(2 ** 31 - 1)
+
+
+def _dynamic_fits(cls: Arrays, nodes: Arrays, state: NodeState) -> jnp.ndarray:
+    """Capacity-dependent predicate chain vs the wave's frozen state, [C,N].
+    Same math as ops/predicates.fits but reading the evolving NodeState."""
+    return (
+        preds.resources_fit(cls["req"], cls["zero_req"], nodes["alloc"],
+                            state.requested)
+        & preds.pod_count_fit(state.pod_count, nodes["allowed_pods"])[None, :]
+        & preds.ports_fit(cls["ports"], state.port_bitmap)
+        & preds.no_disk_conflict(cls["vol_hard"], cls["vol_ro"],
+                                 state.vol_present, state.vol_rw)
+        & preds.max_pd_fit(cls["pd_req"], cls["pd_req_count"], nodes["pd_kind"],
+                           state.pd_present, state.pd_counts, nodes["pd_max"])
+    )
+
+
+def _wave_scores(cls: Arrays, nodes: Arrays, state: NodeState,
+                 fits: jnp.ndarray,
+                 priorities: Tuple[Tuple[str, int], ...]) -> jnp.ndarray:
+    """Weighted priority sum [C,N] against the frozen state; identical
+    per-node integer formulas as the strict path (batch._step_scores)."""
+    c, n = fits.shape
+    total = jnp.zeros((c, n), dtype=jnp.int32)
+    alloc = nodes["alloc"]
+    for name, weight in priorities:
+        if name == "LeastRequestedPriority":
+            s = prio.least_requested(cls["nonzero"], state.nonzero, alloc)
+        elif name == "MostRequestedPriority":
+            s = prio.most_requested(cls["nonzero"], state.nonzero, alloc)
+        elif name == "BalancedResourceAllocation":
+            s = prio.balanced_allocation(cls["nonzero"], state.nonzero, alloc)
+        elif name == "TaintTolerationPriority":
+            cnt = jnp.einsum("ct,nt->cn", cls["intolerated_pref"],
+                             nodes["taints_pref"].astype(jnp.int8),
+                             preferred_element_type=jnp.int32)
+            masked = jnp.where(fits, cnt, 0)
+            mx = masked.max(axis=1, keepdims=True)
+            s = jnp.where(mx == 0, MAX_PRIORITY,
+                          (MAX_PRIORITY * (mx - cnt)) // jnp.maximum(mx, 1))
+        elif name == "NodeAffinityPriority":
+            cnt = prio.node_affinity_counts(cls, nodes["labels"])
+            masked = jnp.where(fits, cnt, 0)
+            mx = masked.max(axis=1, keepdims=True)
+            s = jnp.where(mx > 0, (MAX_PRIORITY * cnt) // jnp.maximum(mx, 1), 0)
+        elif name in prio.HOST_ONLY_PRIORITIES:
+            continue
+        else:
+            s = prio.PRIORITY_REGISTRY[name](cls, nodes, fits)
+        total = total + s * weight
+    return total
+
+
+def _class_capacity(cls: Arrays, nodes: Arrays, state: NodeState) -> jnp.ndarray:
+    """cap[C,N]: how many MORE pods of class c fit on node n, by exact
+    integer division per resource column (mirrors resources_fit semantics,
+    including the overlay->scratch fallback and the zero-request early-exit
+    of predicates.go:576-604) plus the allowed-pod-number ceiling. Division
+    keeps everything in int32 with no long-prefix cumsums."""
+    alloc = nodes["alloc"]
+    rem = alloc - state.requested  # [N,R]
+    req = cls["req"]  # [C,R]
+
+    def col_cap(rem_col, req_col):  # [N],[C] -> [C,N]
+        r = jnp.maximum(req_col, 1)[:, None]
+        cap = jnp.maximum(rem_col, 0)[None, :] // r
+        return jnp.where(req_col[:, None] > 0, cap, _BIG)
+
+    plain_cols = [0, 1, 2] + list(range(NUM_BASE_RESOURCES, alloc.shape[1]))
+    cap = _BIG * jnp.ones((req.shape[0], alloc.shape[0]), dtype=jnp.int32)
+    for col in plain_cols:
+        cap = jnp.minimum(cap, col_cap(rem[:, col], req[:, col]))
+    # storage special case (predicates.go:590-604)
+    no_ov = alloc[:, R_OVERLAY] == 0  # [N]
+    scr_rem = jnp.where(no_ov,
+                        alloc[:, R_SCRATCH] - state.requested[:, R_SCRATCH]
+                        - state.requested[:, R_OVERLAY],
+                        rem[:, R_SCRATCH])
+    scr_add = jnp.where(no_ov[None, :],
+                        (req[:, R_SCRATCH] + req[:, R_OVERLAY])[:, None],
+                        req[:, R_SCRATCH][:, None])  # [C,N]
+    scr_cap = jnp.where(scr_add > 0,
+                        jnp.maximum(scr_rem, 0)[None, :]
+                        // jnp.maximum(scr_add, 1), _BIG)
+    cap = jnp.minimum(cap, scr_cap)
+    ov_cap = jnp.where(no_ov[None, :], _BIG,
+                       col_cap(rem[:, R_OVERLAY], req[:, R_OVERLAY]))
+    cap = jnp.minimum(cap, ov_cap)
+    cap = jnp.where(cls["zero_req"][:, None], _BIG, cap)
+    count_cap = jnp.maximum(nodes["allowed_pods"] - state.pod_count, 0)
+    return jnp.minimum(cap, count_cap[None, :])
+
+
+# per-wave per-node acceptance window; bounds rank*request products so all
+# acceptance math stays exact in int32 (see _rank_scores overflow analysis)
+K_WAVE = 4096
+
+
+def _dyn_at(total_cpu: jnp.ndarray, total_mem: jnp.ndarray,
+            cap_cpu: jnp.ndarray, cap_mem: jnp.ndarray,
+            priorities: Tuple[Tuple[str, int], ...]) -> jnp.ndarray:
+    """Utilization-dependent priority sum for per-row totals (any shape).
+    Mirrors least_requested/most_requested/balanced_allocation exactly."""
+    out = jnp.zeros_like(total_cpu)
+    for name, weight in priorities:
+        if name == "LeastRequestedPriority":
+            s = (prio._unused_score(total_cpu, cap_cpu)
+                 + prio._unused_score(total_mem, cap_mem)) // 2
+        elif name == "MostRequestedPriority":
+            s = (prio._used_score(total_cpu, cap_cpu)
+                 + prio._used_score(total_mem, cap_mem)) // 2
+        elif name == "BalancedResourceAllocation":
+            f32 = jnp.float32
+            frac_c = jnp.where(cap_cpu == 0, f32(1.0),
+                               total_cpu.astype(f32)
+                               / jnp.maximum(cap_cpu, 1).astype(f32))
+            frac_m = jnp.where(cap_mem == 0, f32(1.0),
+                               total_mem.astype(f32)
+                               / jnp.maximum(cap_mem, 1).astype(f32))
+            diff = jnp.abs(frac_c - frac_m)
+            s = jnp.where((frac_c >= 1.0) | (frac_m >= 1.0), 0,
+                          ((f32(1.0) - diff) * MAX_PRIORITY
+                           ).astype(jnp.int32))
+        else:
+            continue
+        out = out + s * weight
+    return out
+
+
+def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
+               pod_class: jnp.ndarray, active: jnp.ndarray,
+               counter: jnp.ndarray,
+               priorities: Tuple[Tuple[str, int], ...],
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                          NodeState, jnp.ndarray]:
+    """One wave (pure traceable body — jitted standalone as wave_step and
+    iterated on device by waves_loop). Returns (selected [P] (-1 = no fit),
+    accepted [P] bool, fit_count [P] int32, new state, new counter)."""
+    P = pod_class.shape[0]
+    N = nodes["alloc"].shape[0]
+    iota = jnp.arange(P, dtype=jnp.int32)
+    idx_n = jnp.arange(N, dtype=jnp.int32)
+
+    static_fit = preds.static_fits(cls, nodes)
+    fits = static_fit & _dynamic_fits(cls, nodes, state)  # [C,N]
+    fitcnt = fits.sum(axis=1).astype(jnp.int32)  # [C]
+    scores = _wave_scores(cls, nodes, state, fits, priorities)
+    masked = jnp.where(fits, scores, jnp.int32(-1))
+    best = masked.max(axis=1, keepdims=True)
+    ties = (masked == best) & fits  # [C,N]
+    m = ties.sum(axis=1).astype(jnp.int32)  # [C]
+    # tiemat[c, r] = node index of the r-th tie (ascending node order)
+    rank = jnp.cumsum(ties.astype(jnp.int32), axis=1) - 1
+    cols = jnp.where(ties, rank, N)
+    rows = jnp.broadcast_to(jnp.arange(ties.shape[0])[:, None], ties.shape)
+    tiemat = jnp.zeros(ties.shape, dtype=jnp.int32).at[rows, cols].set(
+        jnp.broadcast_to(idx_n[None, :], ties.shape), mode="drop")
+
+    fc = fitcnt[pod_class]  # [P]
+    # FIFO draw from the shared RR counter (selectHost counter discipline)
+    multi = active & (fc > 1)
+    draw = counter.astype(jnp.int32) + jnp.cumsum(multi.astype(jnp.int32)) \
+        - multi.astype(jnp.int32)
+    mz = jnp.maximum(m[pod_class], 1)
+    kz = (draw % mz).astype(jnp.int32)
+    sel_multi = tiemat[pod_class, kz]
+    sel_single = jnp.argmax(fits, axis=1).astype(jnp.int32)[pod_class]
+    sel = jnp.where(~active | (fc == 0), jnp.int32(-1),
+                    jnp.where(fc == 1, sel_single, sel_multi))
+    new_counter = counter + multi.sum().astype(jnp.uint32)
+
+    # ---- per-node FIFO conflict resolution --------------------------------
+    placeable = sel >= 0
+    key = jnp.where(placeable, sel, N) * P + iota  # unique, segment-sorted
+    order = jnp.argsort(key)
+    s_sel = sel[order]
+    s_class = pod_class[order]
+    s_place = placeable[order]
+    seg_start = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), s_sel[1:] != s_sel[:-1]])
+    bs = jax.lax.cummax(jnp.where(seg_start, iota, 0))  # segment-start index
+    rank_in_seg = iota - bs
+    first_class = s_class[bs]
+    same_run = jnp.cumsum((s_class != first_class).astype(jnp.int32))
+    same_run = (same_run - same_run[bs]) == 0  # prefix run of first class
+    cap = _class_capacity(cls, nodes, state)  # [C,N]
+    safe_sel = jnp.maximum(s_sel, 0)
+    cap_lim = jnp.minimum(cap[s_class, safe_sel], K_WAVE)
+    special = ((cls["ports"][:, 0] >= 0)
+               | (cls["vol_hard"].sum(axis=1) + cls["vol_ro"].sum(axis=1)
+                  + cls["pd_req"].sum(axis=1) > 0))[s_class]
+    # score-aware window: node score after r commits of this class must stay
+    # >= the frozen runner-up (max score over non-tie nodes). Overflow-safe:
+    # r_eff*nz is bounded either by cap (r*req <= alloc per resources_fit)
+    # or by K_WAVE * the nonzero defaults (~8.4e8 < 2^31).
+    thr = jnp.where(ties, jnp.int32(-1), masked).max(axis=1)  # [C]
+    r_eff = jnp.minimum(rank_in_seg, cap_lim)
+    nz_z = cls["nonzero"][s_class]  # [P,2]
+    nz_node = state.nonzero[safe_sel]
+    alloc_rows = nodes["alloc"][safe_sel]
+    tot0 = nz_node + nz_z
+    tot_r = nz_node + (r_eff[:, None] + 1) * nz_z
+    dyn0 = _dyn_at(tot0[:, 0], tot0[:, 1], alloc_rows[:, 0], alloc_rows[:, 1],
+                   priorities)
+    dyn_r = _dyn_at(tot_r[:, 0], tot_r[:, 1], alloc_rows[:, 0],
+                    alloc_rows[:, 1], priorities)
+    score_r = masked[s_class, safe_sel] - dyn0 + dyn_r
+    acc_core = (s_place & same_run & (rank_in_seg < cap_lim)
+                & (~special | (rank_in_seg == 0))
+                & ((rank_in_seg == 0) | (score_r >= thr[s_class])))
+    # prefix closure: rank r commits only if ranks 0..r-1 all did (the rank/
+    # capacity math above assumes the accepted set is a contiguous prefix;
+    # BalancedResourceAllocation is not monotone in r, so enforce explicitly)
+    fail = (~acc_core).astype(jnp.int32)
+    pre_fail = jnp.cumsum(fail) - fail  # failures strictly before each row
+    acc_s = acc_core & ((pre_fail - pre_fail[bs]) == 0)
+    accepted = jnp.zeros(P, dtype=bool).at[order].set(acc_s)
+
+    # ---- commit (batched AssumePod) ---------------------------------------
+    seg_ids = jnp.where(acc_s, s_sel, N)
+    gain = acc_s.astype(jnp.int32)
+    add_req = jax.ops.segment_sum(cls["req"][s_class] * gain[:, None],
+                                  seg_ids, num_segments=N + 1)[:N]
+    add_nz = jax.ops.segment_sum(cls["nonzero"][s_class] * gain[:, None],
+                                 seg_ids, num_segments=N + 1)[:N]
+    add_cnt = jax.ops.segment_sum(gain, seg_ids, num_segments=N + 1)[:N]
+    requested = state.requested + add_req
+    nonzero = state.nonzero + add_nz
+    pod_count = state.pod_count + add_cnt
+    # specials: at most one accepted per node -> direct batched scatters
+    sp = acc_s & special
+    sp_gain = sp.astype(jnp.int32)
+    sp_sel = jnp.where(sp, s_sel, N)
+    ports = cls["ports"][s_class]  # [P,8]
+    want = (ports >= 0) & sp[:, None]
+    wsafe = jnp.maximum(ports, 0)
+    words = jnp.where(want, wsafe // 32, state.port_bitmap.shape[1])
+    bits = jnp.where(want, jnp.uint32(1) << (wsafe % 32).astype(jnp.uint32),
+                     jnp.uint32(0))
+    port_bitmap = state.port_bitmap.at[
+        jnp.where(sp, s_sel, N)[:, None], words].add(bits, mode="drop")
+    vh = cls["vol_hard"][s_class]
+    vr = cls["vol_ro"][s_class]
+    pdq = cls["pd_req"][s_class]
+    sp8 = sp[:, None].astype(jnp.int8)
+    vol_present = state.vol_present.at[sp_sel].max((vh | vr) * sp8,
+                                                   mode="drop")
+    vol_rw = state.vol_rw.at[sp_sel].max(vh * sp8, mode="drop")
+    pd_present = state.pd_present.at[sp_sel].max(pdq * sp8, mode="drop")
+    # distinct new PD ids the pod brings to its node, per kind
+    pd_new = []
+    for k in range(3):
+        req_k = pdq * nodes["pd_kind"][k][None, :]
+        overlap = jnp.einsum("pv,pv->p", req_k.astype(jnp.int32),
+                             state.pd_present[safe_sel].astype(jnp.int32))
+        pd_new.append(cls["pd_req_count"][s_class, k] - overlap)
+    pd_counts = state.pd_counts.at[sp_sel].add(
+        jnp.stack(pd_new, axis=1) * sp_gain[:, None], mode="drop")
+
+    new_state = NodeState(requested, nonzero, pod_count, port_bitmap,
+                          vol_present, vol_rw, pd_present, pd_counts)
+    return sel, accepted, fc, new_state, new_counter
+
+
+wave_step = functools.partial(jax.jit, static_argnames=("priorities",))(
+    _wave_once)
+
+
+@functools.partial(jax.jit, static_argnames=("priorities", "max_waves"))
+def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
+               pod_class: jnp.ndarray, counter: jnp.ndarray,
+               priorities: Tuple[Tuple[str, int], ...],
+               max_waves: int = 32,
+               ) -> Tuple[jnp.ndarray, NodeState]:
+    """The whole wave iteration as ONE device program (lax.while_loop over
+    _wave_once) — a single dispatch + a single [3P+2] host fetch regardless
+    of wave count; device sync latency dominates small fetches on a tunneled
+    TPU, so per-wave host round-trips would swamp the kernel time.
+
+    Returns (packed, final state) with packed = [selected(P), fit_count(P),
+    still_active(P), counter, waves_used]; still_active pods exhausted
+    max_waves (the host finishes them via the strict scan)."""
+    P = pod_class.shape[0]
+
+    def cond(carry):
+        _, active, _, _, _, w = carry
+        return (w < max_waves) & active.any()
+
+    def body(carry):
+        state, active, counter, fsel, ffc, w = carry
+        sel, accepted, fc, state2, counter2 = _wave_once(
+            cls, nodes, state, pod_class, active, counter, priorities)
+        placed = active & accepted
+        fsel = jnp.where(placed, sel, fsel)
+        ffc = jnp.where(active, fc, ffc)
+        active2 = active & ~accepted & (sel >= 0)
+        return (state2, active2, counter2, fsel, ffc, w + 1)
+
+    init = (state, jnp.ones(P, dtype=bool), counter,
+            jnp.full(P, -1, dtype=jnp.int32), jnp.zeros(P, dtype=jnp.int32),
+            jnp.int32(0))
+    state, active, counter, fsel, ffc, w = lax.while_loop(cond, body, init)
+    packed = jnp.concatenate([fsel, ffc, active.astype(jnp.int32),
+                              counter.astype(jnp.int32)[None], w[None]])
+    return packed, state
+
+
+def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
+                pod_class: np.ndarray, counter: int,
+                priorities: Tuple[Tuple[str, int], ...],
+                max_waves: int = 64,
+                ) -> Tuple[np.ndarray, np.ndarray, NodeState, int]:
+    """Run waves until every pod is placed or proven unplaceable — one
+    device program (waves_loop) + one host fetch. Returns (selected [P]
+    int32 node index or -1, fit_count [P], final NodeState, final counter).
+    Each non-empty conflict segment commits at least its first pod per wave,
+    so the device loop terminates in <= P waves (typically 1-3)."""
+    P = len(pod_class)
+    packed, state = waves_loop(cls, nodes, state, jnp.asarray(pod_class),
+                               jnp.uint32(counter), priorities, max_waves)
+    packed_h = np.asarray(packed)  # the ONLY device->host sync
+    final_sel = packed_h[:P].copy()
+    final_fc = packed_h[P:2 * P].copy()
+    act_h = packed_h[2 * P:3 * P].astype(bool)
+    counter_h = int(np.uint32(packed_h[3 * P]))
+    if act_h.any():
+        # pathological interleaving exhausted max_waves: finish the
+        # stragglers strictly. The straggler count is padded to a bucket
+        # (inert rows) so this rare path doesn't mint a compile per count.
+        idx = np.nonzero(act_h)[0]
+        n_strag = len(idx)
+        if bool(np.asarray(cls["impossible"][-1])):
+            pad_class = cls["req"].shape[0] - 1  # inert padding class row
+            pc = np.full(preds.bucket(n_strag), pad_class, dtype=np.int32)
+        else:  # caller passed unpadded class arrays: no inert row to map to
+            pc = np.empty(n_strag, dtype=np.int32)
+        pc[:n_strag] = pod_class[idx]
+        sel, fcs, state, counter_d = gather_place_batch(
+            cls, jnp.asarray(pc), nodes, state, jnp.uint32(counter_h),
+            priorities)
+        final_sel[idx] = np.asarray(sel)[:n_strag]
+        final_fc[idx] = np.asarray(fcs)[:n_strag]
+        counter_h = int(counter_d)
+    return final_sel, final_fc, state, counter_h

@@ -69,48 +69,85 @@ def node_arrays(snap) -> Arrays:
     }
 
 
+def bucket(n: int, lo: int = 16) -> int:
+    """Power-of-2 shape bucket: jit kernels specialize per shape, so batch
+    axes are padded to buckets to bound recompiles at log2(max) variants."""
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+def pod_arrays_padded(batch, rows: int) -> Arrays:
+    """pod_arrays with the batch axis padded to `rows`. Padding rows are
+    marked `impossible` so they fit nothing, commit nothing, and never tick
+    the RR counter — inert in both the strict scan and the wave kernel.
+    Padding happens in NUMPY: eager jnp ops each compile a tiny XLA program
+    (expensive per-shape on a tunneled backend); np.pad + one device_put per
+    array costs no compiles."""
+    import numpy as _np
+    arrs = _pod_arrays_np(batch)
+    c = len(batch)
+    if rows < c:
+        raise ValueError(f"rows {rows} < batch size {c}")
+    out = {}
+    for k, a in arrs.items():
+        if rows > c:
+            pad = _np.zeros((rows - c,) + a.shape[1:], dtype=a.dtype)
+            if k == "impossible":
+                pad[:] = True
+            a = _np.concatenate([a, pad], axis=0)
+        out[k] = jnp.asarray(a)
+    return out
+
+
 def pod_arrays(batch) -> Arrays:
-    """Assemble the pod-side pytree from a PodBatch."""
+    """Assemble the pod-side pytree from a PodBatch (one device_put each)."""
+    return {k: jnp.asarray(v) for k, v in _pod_arrays_np(batch).items()}
+
+
+def _pod_arrays_np(batch):
+    """The pod-side arrays as host numpy, keyed like pod_arrays."""
     return {
-        "req": jnp.asarray(batch.req),
-        "nonzero": jnp.asarray(batch.nonzero),
-        "zero_req": jnp.asarray(batch.zero_req),
-        "impossible": jnp.asarray(batch.impossible),
-        "best_effort": jnp.asarray(batch.best_effort),
-        "ports": jnp.asarray(batch.ports),
-        "intolerated": jnp.asarray(batch.intolerated),
-        "intolerated_pref": jnp.asarray(batch.intolerated_pref),
-        "host_required": jnp.asarray(batch.host_required),
-        "has_host": jnp.asarray(batch.has_host),
-        "sel_req_all": jnp.asarray(batch.sel_req_all),
-        "sel_req_any": jnp.asarray(batch.sel_req_any),
-        "sel_forbid": jnp.asarray(batch.sel_forbid),
-        "sel_term_valid": jnp.asarray(batch.sel_term_valid),
-        "sel_any_used": jnp.asarray(batch.sel_any_used),
-        "sel_unsat": jnp.asarray(batch.sel_unsat),
-        "has_selector": jnp.asarray(batch.has_selector),
-        "pref_req_all": jnp.asarray(batch.pref_req_all),
-        "pref_req_any": jnp.asarray(batch.pref_req_any),
-        "pref_forbid": jnp.asarray(batch.pref_forbid),
-        "pref_any_used": jnp.asarray(batch.pref_any_used),
-        "pref_valid": jnp.asarray(batch.pref_valid),
-        "pref_unsat": jnp.asarray(batch.pref_unsat),
-        "pref_empty": jnp.asarray(batch.pref_empty),
-        "pref_weight": jnp.asarray(batch.pref_weight),
-        "avoid_idx": jnp.asarray(batch.avoid_idx),
-        "img_count": jnp.asarray(batch.img_count),
-        "vol_hard": jnp.asarray(batch.vol_hard),
-        "vol_ro": jnp.asarray(batch.vol_ro),
-        "pd_req": jnp.asarray(batch.pd_req),
-        "pd_req_count": jnp.asarray(batch.pd_req_count),
-        "vz_req": jnp.asarray(batch.vz_req),
-        "vz_err": jnp.asarray(batch.vz_err),
-        "pvaff_req_all": jnp.asarray(batch.pvaff_req_all),
-        "pvaff_req_any": jnp.asarray(batch.pvaff_req_any),
-        "pvaff_forbid": jnp.asarray(batch.pvaff_forbid),
-        "pvaff_any_used": jnp.asarray(batch.pvaff_any_used),
-        "pvaff_unsat": jnp.asarray(batch.pvaff_unsat),
-        "pvaff_has": jnp.asarray(batch.pvaff_has),
+        "req": batch.req,
+        "nonzero": batch.nonzero,
+        "zero_req": batch.zero_req,
+        "impossible": batch.impossible,
+        "best_effort": batch.best_effort,
+        "ports": batch.ports,
+        "intolerated": batch.intolerated,
+        "intolerated_pref": batch.intolerated_pref,
+        "host_required": batch.host_required,
+        "has_host": batch.has_host,
+        "sel_req_all": batch.sel_req_all,
+        "sel_req_any": batch.sel_req_any,
+        "sel_forbid": batch.sel_forbid,
+        "sel_term_valid": batch.sel_term_valid,
+        "sel_any_used": batch.sel_any_used,
+        "sel_unsat": batch.sel_unsat,
+        "has_selector": batch.has_selector,
+        "pref_req_all": batch.pref_req_all,
+        "pref_req_any": batch.pref_req_any,
+        "pref_forbid": batch.pref_forbid,
+        "pref_any_used": batch.pref_any_used,
+        "pref_valid": batch.pref_valid,
+        "pref_unsat": batch.pref_unsat,
+        "pref_empty": batch.pref_empty,
+        "pref_weight": batch.pref_weight,
+        "avoid_idx": batch.avoid_idx,
+        "img_count": batch.img_count,
+        "vol_hard": batch.vol_hard,
+        "vol_ro": batch.vol_ro,
+        "pd_req": batch.pd_req,
+        "pd_req_count": batch.pd_req_count,
+        "vz_req": batch.vz_req,
+        "vz_err": batch.vz_err,
+        "pvaff_req_all": batch.pvaff_req_all,
+        "pvaff_req_any": batch.pvaff_req_any,
+        "pvaff_forbid": batch.pvaff_forbid,
+        "pvaff_any_used": batch.pvaff_any_used,
+        "pvaff_unsat": batch.pvaff_unsat,
+        "pvaff_has": batch.pvaff_has,
     }
 
 

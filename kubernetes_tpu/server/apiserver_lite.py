@@ -123,19 +123,43 @@ class ApiServerLite:
     def bind(self, binding: Binding) -> int:
         """The /binding subresource (BindingREST, storage.go:128)."""
         with self._lock:
-            key = ("Pod", binding.pod_namespace, binding.pod_name)
-            pod: Optional[Pod] = self._objects.get(key)
-            if pod is None:
-                raise NotFound(f"pod {binding.pod_namespace}/{binding.pod_name}")
-            if pod.node_name:
-                raise Conflict(
-                    f"pod {pod.key()} is already assigned to node {pod.node_name}")
-            new = dataclasses.replace(pod, node_name=binding.node_name)
-            self._rv += 1
-            new.resource_version = self._rv
-            self._objects[key] = new
-            self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
-            return self._rv
+            return self._bind_locked(binding)
+
+    def bind_many(self, bindings: List[Binding]) -> List[Optional[str]]:
+        """Batch of /binding POSTs under one lock acquisition (the scheduler
+        issues one per placement; semantics per binding are identical to
+        bind()). Returns one entry per binding: None on success, else the
+        error string ('conflict: ...' / 'not found: ...')."""
+        out: List[Optional[str]] = []
+        with self._lock:
+            for b in bindings:
+                try:
+                    self._bind_locked(b)
+                    out.append(None)
+                except Conflict as e:
+                    out.append("conflict: " + str(e))
+                except NotFound as e:
+                    out.append("not found: " + str(e))
+        return out
+
+    def _bind_locked(self, binding: Binding) -> int:
+        key = ("Pod", binding.pod_namespace, binding.pod_name)
+        pod: Optional[Pod] = self._objects.get(key)
+        if pod is None:
+            raise NotFound(f"pod {binding.pod_namespace}/{binding.pod_name}")
+        if pod.node_name:
+            raise Conflict(
+                f"pod {pod.key()} is already assigned to node {pod.node_name}")
+        # shallow clone (same effect as dataclasses.replace, ~4x faster on
+        # the 30k-binding storm path; watchers keep seeing the old object)
+        new = object.__new__(Pod)
+        new.__dict__.update(pod.__dict__)
+        new.node_name = binding.node_name
+        self._rv += 1
+        new.resource_version = self._rv
+        self._objects[key] = new
+        self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
+        return self._rv
 
     # --------------------------------------------------------------- watch
 

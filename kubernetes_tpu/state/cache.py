@@ -63,6 +63,26 @@ class SchedulerCache:
             st.assumed = True
             self._pod_states[key] = st
 
+    def assume_pods_bulk(self, placements, derived) -> None:
+        """AssumePod for a whole batch under one lock acquisition.
+        placements = [(pod, class_index)] with pod.node_name already set;
+        derived = per-class [(Resource, nonzero_cpu, nonzero_mem, ports)].
+        Semantics identical to assume_pod per pod, in order."""
+        with self._lock:
+            for pod, c in placements:
+                key = pod.key()
+                if key in self._pod_states:
+                    raise KeyError(f"pod {key} is already in the cache")
+                req, ncpu, nmem, ports = derived[c]
+                info = self._nodes.get(pod.node_name)
+                if info is None:
+                    info = NodeInfo()
+                    self._nodes[pod.node_name] = info
+                info.add_pod_precomputed(pod, req, ncpu, nmem, ports)
+                st = _PodState(pod)
+                st.assumed = True
+                self._pod_states[key] = st
+
     def finish_binding(self, pod: Pod) -> None:
         key = pod.key()
         with self._lock:
@@ -71,6 +91,17 @@ class SchedulerCache:
                 return
             st.binding_finished = True
             st.deadline = self._now() + self._ttl
+
+    def finish_bindings_bulk(self, pods: List[Pod]) -> None:
+        """FinishBinding for a batch under one lock; one clock read."""
+        deadline = self._now() + self._ttl
+        with self._lock:
+            for pod in pods:
+                st = self._pod_states.get(pod.key())
+                if st is None or not st.assumed:
+                    continue
+                st.binding_finished = True
+                st.deadline = deadline
 
     def forget_pod(self, pod: Pod) -> None:
         key = pod.key()

@@ -55,11 +55,17 @@ class NodeInfo:
 
     def add_pod(self, pod: Pod) -> None:
         req = pod.resource_request()
-        self.requested.add(req)
         ncpu, nmem = pod.nonzero_request()
+        self.add_pod_precomputed(pod, req, ncpu, nmem, pod.used_ports())
+
+    def add_pod_precomputed(self, pod: Pod, req: Resource, ncpu: int,
+                            nmem: int, ports: List[int]) -> None:
+        """add_pod with the derived quantities supplied by the caller — the
+        bulk-assume path computes them once per equivalence class instead of
+        once per pod (30k identical pods -> one resource_request walk)."""
+        self.requested.add(req)
         self.nonzero_cpu += ncpu
         self.nonzero_mem += nmem
-        ports = pod.used_ports()
         if ports:
             self.used_ports.update(ports)
             self.ports_generation += 1

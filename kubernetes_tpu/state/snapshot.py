@@ -427,6 +427,7 @@ class ClusterSnapshot:
         self.taints_sched = np.zeros((n, t), dtype=np.int8)
         self.taints_pref = np.zeros((n, t), dtype=np.int8)
         self.port_bitmap = np.zeros((n, PORT_WORDS), dtype=np.uint32)
+        self._port_words_used = None
         self.valid = np.zeros(n, dtype=bool)
         self.valid[: len(names)] = True
         self.avoid = np.zeros((n, _pad(len(self.avoid_vocab), 4)), dtype=np.int8)
@@ -579,6 +580,21 @@ class ClusterSnapshot:
                 bm[port // 32] |= np.uint32(1 << (port % 32))
         self.port_bitmap[i] = bm
         self.dirty.add("port_bitmap")
+        self._port_words_used = None
+
+    def port_words_used(self) -> int:
+        """Highest port-bitmap word in use across all nodes, plus one — the
+        engine uploads only [:, :W] of the (otherwise 8KB/node, mostly-zero)
+        bitmap. Recomputed lazily when any ports row changed."""
+        cached = getattr(self, "_port_words_used", None)
+        if cached is None:
+            if getattr(self, "port_bitmap", None) is None \
+                    or not self.port_bitmap.any():
+                cached = 0
+            else:
+                cached = int(np.nonzero(self.port_bitmap.any(axis=0))[0][-1]) + 1
+            self._port_words_used = cached
+        return cached
 
     def _rebuild_label_index(self, infos: Dict[str, NodeInfo],
                              names: List[str]) -> None:

@@ -35,16 +35,25 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
-        self._values: List[float] = []  # for exact percentiles in benches
+        # (value, multiplicity) samples for exact percentiles in benches —
+        # weighted so a 30k-pod batch round is one entry, not 30k appends
+        self._values: List[tuple] = []
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
+        self.observe_many(v, 1)
+
+    def observe_many(self, v: float, n: int) -> None:
+        """Record n observations of the same value (one lock, one append) —
+        the batch rounds attribute per-pod latency as elapsed/batch."""
+        if n <= 0:
+            return
         with self._lock:
             i = bisect.bisect_left(self.buckets, v)
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
-            self._values.append(v)
+            self._counts[i] += n
+            self._sum += v * n
+            self._count += n
+            self._values.append((v, n))
 
     @property
     def count(self) -> int:
@@ -59,8 +68,14 @@ class Histogram:
             if not self._values:
                 return 0.0
             vs = sorted(self._values)
-            idx = min(int(p / 100.0 * len(vs)), len(vs) - 1)
-            return vs[idx]
+            total = sum(n for _, n in vs)
+            target = min(int(p / 100.0 * total), total - 1)
+            cum = 0
+            for v, n in vs:
+                cum += n
+                if target < cum:
+                    return v
+            return vs[-1][0]
 
     def render(self) -> str:
         with self._lock:

@@ -82,15 +82,16 @@ def test_bind_conflict_forgets_and_requeues():
     sched.start()
     # an external scheduler binds the pod in the window between our queue pop
     # and our bind call (the race scheduler.go:234 handles via ForgetPod) —
-    # injected by wrapping api.bind so the foreign bind lands first
-    real_bind = api.bind
+    # injected by wrapping the batched bind path so the foreign bind lands
+    # first
+    real_bind_many = api.bind_many
 
-    def racing_bind(binding):
-        api.bind = real_bind
-        real_bind(Binding("contested", "default", pod.uid, "n1"))
-        return real_bind(binding)
+    def racing_bind_many(bindings):
+        api.bind_many = real_bind_many
+        api.bind(Binding("contested", "default", pod.uid, "n1"))
+        return real_bind_many(bindings)
 
-    api.bind = racing_bind
+    api.bind_many = racing_bind_many
     stats = sched.schedule_round()
     assert stats["bind_errors"] == 1
     assert any(e.reason == "FailedBinding" for e in sched.events)
