@@ -54,7 +54,13 @@ def main():
 
     if warmup:  # compile-warm the kernels at identical shapes, then measure
         run_once(n_nodes, n_pods, profile)
-    totals, elapsed, sched = run_once(n_nodes, n_pods, profile)
+    try:
+        totals, elapsed, sched = run_once(n_nodes, n_pods, profile)
+    except Exception as e:  # tunneled-TPU transport flakes are transient;
+        # one retry so a single dropped RPC doesn't zero the round's number
+        import sys
+        print(f"bench: retrying after transient error: {e}", file=sys.stderr)
+        totals, elapsed, sched = run_once(n_nodes, n_pods, profile)
 
     bound = totals["bound"]
     pods_per_s = bound / elapsed if elapsed > 0 else 0.0

@@ -9,21 +9,17 @@ import os as _os
 def _enable_persistent_compile_cache() -> None:
     """Opt-out persistent XLA compilation cache: the placement kernels cost
     seconds to compile per shape bucket; caching them on disk makes fresh
-    processes (benches, tests, sidecars) start warm. Disable with
-    KUBERNETES_TPU_NO_COMPILE_CACHE=1 or by setting your own cache dir."""
+    processes (benches, tests, sidecars) start warm. Set via environment so
+    importing the package costs nothing — jax reads these when (if) it is
+    imported. Disable with KUBERNETES_TPU_NO_COMPILE_CACHE=1 or override by
+    setting your own cache dir."""
     if _os.environ.get("KUBERNETES_TPU_NO_COMPILE_CACHE"):
         return
-    try:
-        import jax
-
-        if jax.config.jax_compilation_cache_dir is None:
-            jax.config.update(
-                "jax_compilation_cache_dir",
-                _os.path.expanduser("~/.cache/kubernetes_tpu/xla"))
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              0.5)
-    except Exception:  # pragma: no cover - cache is an optimization only
-        pass
+    _os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        _os.path.expanduser("~/.cache/kubernetes_tpu/xla"))
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           "0.5")
 
 
 _enable_persistent_compile_cache()

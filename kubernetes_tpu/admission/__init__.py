@@ -1,0 +1,6 @@
+from kubernetes_tpu.admission.chain import (  # noqa: F401
+    AdmissionChain,
+    AdmissionRequest,
+    Rejected,
+    default_plugins,
+)

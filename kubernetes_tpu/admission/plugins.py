@@ -1,0 +1,352 @@
+"""Admission plugin implementations (reference: plugin/pkg/admission/*).
+
+Each plugin mirrors the decision logic of its Go counterpart; store access is
+through the apiserver-lite store handed to the chain (the reference plugins
+use informers/listers — same data, same freshness model in-process).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from kubernetes_tpu.admission.chain import (
+    AdmissionRequest,
+    CREATE,
+    DELETE,
+    Rejected,
+    UPDATE,
+)
+from kubernetes_tpu.api.cluster import LimitRange, ResourceQuota
+from kubernetes_tpu.api.types import (
+    Pod,
+    Taint,
+    TaintEffect,
+    Toleration,
+    TolerationOperator,
+)
+from kubernetes_tpu.quota import (
+    exceeds,
+    quota_scopes_match,
+    usage_for,
+)
+
+
+class _StorePlugin:
+    store = None
+
+    def set_store(self, store) -> None:
+        self.store = store
+
+    def _get(self, kind, ns, name):
+        try:
+            return self.store.get(kind, ns, name)
+        except Exception:
+            return None
+
+
+class NamespaceLifecycle(_StorePlugin):
+    """plugin/pkg/admission/namespace/lifecycle: creates in a missing or
+    terminating namespace are rejected; deletes of the immortal namespaces
+    (default, kube-system) are rejected."""
+
+    IMMORTAL = ("default", "kube-system")
+    NAMESPACED_KINDS_EXEMPT = ("Namespace", "Node", "PersistentVolume",
+                               "ClusterRole", "ClusterRoleBinding")
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.operation in (CREATE, DELETE)
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if req.operation == DELETE and req.kind == "Namespace" \
+                and req.name in self.IMMORTAL:
+            raise Rejected(f"namespace {req.name} is immortal")
+        if req.operation != CREATE or req.kind in self.NAMESPACED_KINDS_EXEMPT:
+            return
+        if not req.namespace or self.store is None:
+            return
+        ns = self._get("Namespace", "", req.namespace)
+        if ns is None:
+            # auto-provision default like the provision plugin? The reference
+            # runs lifecycle which 404s unknown namespaces.
+            raise Rejected(f"namespace {req.namespace} not found")
+        if getattr(ns, "phase", "Active") == "Terminating":
+            raise Rejected(
+                f"namespace {req.namespace} is terminating: cannot create")
+
+
+class AlwaysPullImages:
+    """plugin/pkg/admission/alwayspullimages: force imagePullPolicy=Always.
+    Modeled as an annotation since the pull policy lives node-side here."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return self.enabled and req.kind == "Pod" \
+            and req.operation in (CREATE, UPDATE)
+
+    def admit(self, req: AdmissionRequest) -> None:
+        req.obj.annotations["kubernetes.io/image-pull-policy"] = "Always"
+
+
+class LimitRanger(_StorePlugin):
+    """plugin/pkg/admission/limitranger: apply container default requests/
+    limits from LimitRange objects, reject min/max violations."""
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.kind == "Pod" and req.operation == CREATE
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self.store is None:
+            return
+        pod: Pod = req.obj
+        ranges = [lr for lr in self.store.list("LimitRange")[0]
+                  if lr.namespace == req.namespace]
+        for lr in ranges:
+            for item in lr.limits:
+                if item.type != "Container":
+                    continue
+                for c in pod.containers:
+                    for res, dv in item.default_request.items():
+                        c.requests.setdefault(res, dv)
+                    for res, dv in item.default.items():
+                        c.limits.setdefault(res, dv)
+                    for res, mn in item.min.items():
+                        if res in c.requests and c.requests[res] < mn:
+                            raise Rejected(
+                                f"minimum {res} usage per Container is {mn}")
+                    for res, mx in item.max.items():
+                        if c.requests.get(res, 0) > mx \
+                                or c.limits.get(res, 0) > mx:
+                            raise Rejected(
+                                f"maximum {res} usage per Container is {mx}")
+
+
+class ServiceAccountPlugin(_StorePlugin):
+    """plugin/pkg/admission/serviceaccount: default the pod's service
+    account, reject references to missing service accounts. The SA name is
+    carried in annotations (the Pod model doesn't reserve a field)."""
+
+    KEY = "kubernetes.io/service-account.name"
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.kind == "Pod" and req.operation == CREATE
+
+    def admit(self, req: AdmissionRequest) -> None:
+        pod: Pod = req.obj
+        name = pod.annotations.get(self.KEY) or "default"
+        pod.annotations[self.KEY] = name
+        if self.store is None:
+            return
+        sa = self._get("ServiceAccount", req.namespace, name)
+        if sa is None and name != "default":
+            raise Rejected(
+                f"service account {req.namespace}/{name} does not exist")
+
+
+class PodNodeSelector(_StorePlugin):
+    """plugin/pkg/admission/podnodeselector: merge the namespace's
+    node-selector annotation into the pod; conflicts reject."""
+
+    ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.kind == "Pod" and req.operation == CREATE
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self.store is None:
+            return
+        ns = self._get("Namespace", "", req.namespace)
+        if ns is None:
+            return
+        raw = getattr(ns, "annotations", {}).get(self.ANNOTATION, "")
+        if not raw:
+            return
+        selector: Dict[str, str] = {}
+        for part in raw.split(","):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                selector[k.strip()] = v.strip()
+        pod: Pod = req.obj
+        for k, v in selector.items():
+            if k in pod.node_selector and pod.node_selector[k] != v:
+                raise Rejected(
+                    f"pod node label selector conflicts with namespace "
+                    f"node label selector for key {k}")
+            pod.node_selector[k] = v
+
+
+class PodTolerationRestriction(_StorePlugin):
+    """plugin/pkg/admission/podtolerationrestriction: merge namespace
+    default tolerations; enforce the namespace whitelist."""
+
+    DEFAULT_KEY = "scheduler.alpha.kubernetes.io/defaultTolerations"
+    WHITELIST_KEY = "scheduler.alpha.kubernetes.io/tolerationsWhitelist"
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.kind == "Pod" and req.operation == CREATE
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self.store is None:
+            return
+        ns = self._get("Namespace", "", req.namespace)
+        if ns is None:
+            return
+        anns = getattr(ns, "annotations", {})
+        pod: Pod = req.obj
+        defaults = self._parse(anns.get(self.DEFAULT_KEY, ""))
+        if defaults and not pod.tolerations:
+            pod.tolerations = defaults
+        whitelist = self._parse(anns.get(self.WHITELIST_KEY, ""))
+        if whitelist:
+            allowed = {(t.key, t.value) for t in whitelist}
+            for t in pod.tolerations:
+                if (t.key, t.value) not in allowed:
+                    raise Rejected(
+                        f"pod toleration {t.key}={t.value} not in namespace "
+                        "whitelist")
+
+    @staticmethod
+    def _parse(raw: str):
+        out = []
+        for part in raw.split(";"):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                out.append(Toleration(key=k.strip(), value=v.strip()))
+        return out
+
+
+# TaintBasedEvictions not-ready/unreachable taint keys
+# (pkg/controller/node + plugin/pkg/admission/defaulttolerationseconds)
+NOT_READY_TAINT = "node.alpha.kubernetes.io/notReady"
+UNREACHABLE_TAINT = "node.alpha.kubernetes.io/unreachable"
+DEFAULT_TOLERATION_SECONDS = 300
+
+
+class DefaultTolerationSeconds:
+    """plugin/pkg/admission/defaulttolerationseconds: add 300s NoExecute
+    tolerations for notReady/unreachable unless the pod already has one."""
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.kind == "Pod" and req.operation in (CREATE, UPDATE)
+
+    def admit(self, req: AdmissionRequest) -> None:
+        pod: Pod = req.obj
+        has_nr = any(t.key == NOT_READY_TAINT and
+                     t.effect in (None, TaintEffect.NO_EXECUTE)
+                     for t in pod.tolerations)
+        has_ur = any(t.key == UNREACHABLE_TAINT and
+                     t.effect in (None, TaintEffect.NO_EXECUTE)
+                     for t in pod.tolerations)
+        if not has_nr:
+            pod.tolerations = list(pod.tolerations) + [Toleration(
+                key=NOT_READY_TAINT, operator=TolerationOperator.EXISTS,
+                effect=TaintEffect.NO_EXECUTE,
+                toleration_seconds=DEFAULT_TOLERATION_SECONDS)]
+        if not has_ur:
+            pod.tolerations = list(pod.tolerations) + [Toleration(
+                key=UNREACHABLE_TAINT, operator=TolerationOperator.EXISTS,
+                effect=TaintEffect.NO_EXECUTE,
+                toleration_seconds=DEFAULT_TOLERATION_SECONDS)]
+
+
+class NodeRestriction:
+    """plugin/pkg/admission/noderestriction: a kubelet may only modify its
+    own Node object and pods bound to it."""
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.user is not None \
+            and req.user.name.startswith("system:node:") \
+            and req.kind in ("Node", "Pod")
+
+    def admit(self, req: AdmissionRequest) -> None:
+        node_name = req.user.name[len("system:node:"):]
+        if req.kind == "Node":
+            if req.operation in (UPDATE, DELETE) and req.name != node_name:
+                raise Rejected(
+                    f"node {node_name} cannot modify node {req.name}")
+        elif req.kind == "Pod" and req.operation in (UPDATE, DELETE):
+            pod = req.old_obj or req.obj
+            if pod is not None and getattr(pod, "node_name", "") \
+                    not in ("", node_name):
+                raise Rejected(
+                    f"node {node_name} cannot modify pods bound elsewhere")
+
+
+class PriorityPlugin(_StorePlugin):
+    """plugin/pkg/admission/priority (behind the PodPriority gate in 1.7):
+    resolve priorityClassName -> priority value."""
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        from kubernetes_tpu.utils import features
+
+        return features.enabled("PodPriority") and req.kind == "Pod" \
+            and req.operation == CREATE
+
+    def admit(self, req: AdmissionRequest) -> None:
+        pod: Pod = req.obj
+        if not pod.priority_class:
+            return
+        pc = self._get("PriorityClass", "", pod.priority_class)
+        if pc is None:
+            raise Rejected(
+                f"no PriorityClass with name {pod.priority_class} was found")
+        pod.priority = pc.value
+
+
+class StorageClassDefault(_StorePlugin):
+    """plugin/pkg/admission/storageclass/default: annotate PVCs without a
+    class with the default StorageClass."""
+
+    ANNOTATION = "volume.beta.kubernetes.io/storage-class"
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.kind == "PersistentVolumeClaim" and req.operation == CREATE
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self.store is None:
+            return
+        anns = getattr(req.obj, "annotations", None)
+        if anns is None or self.ANNOTATION in anns:
+            return
+        for sc in self.store.list("StorageClass")[0]:
+            if getattr(sc, "is_default", False):
+                anns[self.ANNOTATION] = sc.name
+                return
+
+
+class ResourceQuotaPlugin(_StorePlugin):
+    """plugin/pkg/admission/resourcequota: on CREATE, check the delta
+    against every matching quota's hard limits and commit the new usage
+    atomically (the reference does a quota CAS loop through the apiserver;
+    in-process the store lock gives the same atomicity)."""
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.operation == CREATE and req.kind in (
+            "Pod", "Service", "ReplicationController", "Secret", "ConfigMap",
+            "PersistentVolumeClaim", "ResourceQuota")
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self.store is None:
+            return
+        delta = usage_for(req.kind, req.obj)
+        if not delta:
+            return
+        quotas = [q for q in self.store.list("ResourceQuota")[0]
+                  if q.namespace == req.namespace
+                  and quota_scopes_match(q.scopes, req.kind, req.obj)]
+        for q in quotas:
+            constrained = [k for k in delta if k in q.hard]
+            if not constrained:
+                continue
+            over = exceeds(q.hard, q.used, delta)
+            if over:
+                raise Rejected(
+                    f"exceeded quota: {q.name}, requested: "
+                    + ",".join(f"{k}={delta[k]}" for k in over)
+                    + ", limited: "
+                    + ",".join(f"{k}={q.hard[k]}" for k in over))
+        for q in quotas:
+            for k, v in delta.items():
+                if k in q.hard:
+                    q.used[k] = q.used.get(k, 0) + v

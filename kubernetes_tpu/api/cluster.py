@@ -1,0 +1,107 @@
+"""Cluster-scoped / config API objects consumed by the apiserver chain:
+quota, limits, service accounts, secrets, configmaps, disruption budgets.
+
+References: pkg/api/types.go ResourceQuota/LimitRange/ServiceAccount/Secret/
+ConfigMap; pkg/apis/policy/types.go PodDisruptionBudget + Eviction
+(the pods/eviction subresource consumes Eviction,
+pkg/registry/core/pod/storage/eviction.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import LabelSelector
+
+
+@dataclass
+class ResourceQuota:
+    """ResourceQuota (pkg/api/types.go; enforced by the resourcequota
+    admission controller + recomputed by the quota controller). `hard` and
+    `used` are resource-name -> integer quantity (canonical units: millicores
+    for cpu, bytes for memory, counts otherwise)."""
+
+    name: str
+    namespace: str = "default"
+    hard: Dict[str, int] = field(default_factory=dict)
+    used: Dict[str, int] = field(default_factory=dict)
+    # scopes: Terminating | NotTerminating | BestEffort | NotBestEffort
+    scopes: List[str] = field(default_factory=list)
+    resource_version: int = 0
+
+
+@dataclass
+class LimitRangeItem:
+    """LimitRangeItem (type Container|Pod): min/max/default/defaultRequest
+    per resource name."""
+
+    type: str = "Container"
+    min: Dict[str, int] = field(default_factory=dict)
+    max: Dict[str, int] = field(default_factory=dict)
+    default: Dict[str, int] = field(default_factory=dict)  # default limits
+    default_request: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRange:
+    name: str
+    namespace: str = "default"
+    limits: List[LimitRangeItem] = field(default_factory=list)
+    resource_version: int = 0
+
+
+@dataclass
+class ServiceAccount:
+    name: str
+    namespace: str = "default"
+    secrets: List[str] = field(default_factory=list)  # token secret names
+    image_pull_secrets: List[str] = field(default_factory=list)
+    automount_token: bool = True
+    resource_version: int = 0
+    uid: str = ""
+
+
+@dataclass
+class Secret:
+    name: str
+    namespace: str = "default"
+    type: str = "Opaque"  # kubernetes.io/service-account-token for SA tokens
+    data: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+
+
+@dataclass
+class ConfigMap:
+    name: str
+    namespace: str = "default"
+    data: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1beta1 PDB (pkg/apis/policy/types.go): minAvailable gate
+    consumed by the eviction subresource + maintained by the disruption
+    controller."""
+
+    name: str
+    namespace: str = "default"
+    min_available: int = 0
+    selector: Optional[LabelSelector] = None
+    # status (disruption controller): currently healthy / allowed disruptions
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    disruptions_allowed: int = 0
+    expected_pods: int = 0
+    resource_version: int = 0
+
+
+@dataclass
+class Eviction:
+    """The pods/eviction subresource body."""
+
+    pod_name: str
+    namespace: str = "default"

@@ -236,6 +236,8 @@ class Toleration:
     operator: TolerationOperator = TolerationOperator.EQUAL
     value: str = ""
     effect: Optional[TaintEffect] = None  # None = all effects
+    # NoExecute grace period (v1.Toleration.TolerationSeconds; None = forever)
+    toleration_seconds: Optional[int] = None
 
     def tolerates(self, taint: Taint) -> bool:
         if self.effect is not None and self.effect != taint.effect:

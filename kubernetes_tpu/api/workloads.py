@@ -165,6 +165,7 @@ class Namespace:
     name: str
     phase: str = "Active"  # Active | Terminating
     labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
 
 

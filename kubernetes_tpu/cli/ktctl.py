@@ -1,0 +1,430 @@
+"""ktctl: the kubectl-equivalent CLI.
+
+Command palette mirrors pkg/kubectl/cmd/cmd.go NewKubectlCommand's verbs that
+operate on this control plane (reference: pkg/kubectl, 69k LoC — resource
+builder in pkg/kubectl/resource, printers in pkg/printers):
+
+  get | describe | create -f | apply -f | delete | scale | label | annotate |
+  taint | cordon | uncordon | drain | rollout (status|history|undo) |
+  top node | api-resources | version
+
+Resource aliasing matches kubectl's short names (po, no, svc, rs, rc,
+deploy, sts, ds, ns, pv, pvc, quota, sa, cm, pdb). Output: table (default),
+-o wide | json | yaml | name. The backend is either an in-process ApiServer
+or a RestServer URL (--server) — both expose the same verbs, like kubectl
+against the secure/insecure ports.
+
+`apply` implements create-or-update with a last-applied annotation diff (the
+simplified 2-way form of kubectl's 3-way strategic merge patch,
+pkg/kubectl/cmd/apply.go — full strategic merge lives in the server-side
+strategies here, so last-applied carries the client intent)."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+from kubernetes_tpu.api import wire
+from kubernetes_tpu.api.cluster import Eviction
+from kubernetes_tpu.api.types import Node, Pod, Taint, TaintEffect
+from kubernetes_tpu.server.apiserver import ApiServer, KIND_INFO
+
+LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "rc": "replicationcontrollers",
+    "deploy": "deployments", "deployment": "deployments",
+    "sts": "statefulsets", "statefulset": "statefulsets",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "ns": "namespaces", "namespace": "namespaces",
+    "pv": "persistentvolumes", "pvc": "persistentvolumeclaims",
+    "quota": "resourcequotas", "sa": "serviceaccounts",
+    "cm": "configmaps", "secret": "secrets",
+    "pdb": "poddisruptionbudgets", "ep": "endpoints",
+    "job": "jobs", "limits": "limitranges",
+    "ev": "events", "event": "events",
+}
+RESOURCE_TO_KIND = {res: kind for kind, (res, _) in KIND_INFO.items()}
+
+
+def resolve_kind(arg: str) -> str:
+    res = ALIASES.get(arg.lower(), arg.lower())
+    kind = RESOURCE_TO_KIND.get(res)
+    if kind is None:
+        # allow exact kind names too
+        for k in KIND_INFO:
+            if k.lower() == arg.lower():
+                return k
+        raise SystemExit(f"error: the server doesn't have a resource type "
+                         f"{arg!r}")
+    return kind
+
+
+# ---------------------------------------------------------------- printers
+
+def _pod_row(p: Pod) -> List[str]:
+    ready = "1/1" if p.phase == "Running" else "0/1"
+    return [p.name, ready, p.phase, p.node_name or "<none>"]
+
+
+def _node_row(n: Node) -> List[str]:
+    status = "Ready" if n.is_ready() else "NotReady"
+    if n.unschedulable:
+        status += ",SchedulingDisabled"
+    return [n.name, status, str(n.allocatable.milli_cpu) + "m",
+            str(n.allocatable.memory)]
+
+
+HEADERS = {
+    "Pod": ["NAME", "READY", "STATUS", "NODE"],
+    "Node": ["NAME", "STATUS", "CPU", "MEMORY"],
+}
+
+
+def table(kind: str, objs: Sequence[Any], wide: bool = False) -> str:
+    if kind == "Pod":
+        rows = [_pod_row(o) for o in objs]
+        headers = HEADERS["Pod"]
+    elif kind == "Node":
+        rows = [_node_row(o) for o in objs]
+        headers = HEADERS["Node"]
+    elif hasattr(objs[0] if objs else None, "replicas"):
+        headers = ["NAME", "DESIRED", "READY"]
+        rows = [[o.name, str(getattr(o, "replicas", "")),
+                 str(getattr(o, "ready_replicas", ""))] for o in objs]
+    else:
+        headers = ["NAME", "NAMESPACE"]
+        rows = [[getattr(o, "name", ""), getattr(o, "namespace", "")]
+                for o in objs]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def render(kind: str, objs: Sequence[Any], output: str) -> str:
+    if output == "json":
+        return json.dumps([wire.encode(o, kind=kind) for o in objs],
+                          indent=2)
+    if output == "yaml":
+        return yaml.safe_dump([wire.encode(o, kind=kind) for o in objs])
+    if output == "name":
+        res = KIND_INFO[kind][0]
+        return "\n".join(f"{res}/{getattr(o, 'name', '')}" for o in objs)
+    return table(kind, objs, wide=(output == "wide"))
+
+
+def describe(kind: str, obj: Any) -> str:
+    enc = wire.encode(obj, kind=kind)
+    lines = [f"Name:       {enc.pop('name', '')}"]
+    if "namespace" in enc:
+        lines.append(f"Namespace:  {enc.pop('namespace')}")
+    for k, v in enc.items():
+        lines.append(f"{k}: {json.dumps(v, default=str)}"
+                     if not isinstance(v, str) else f"{k}: {v}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------- the tool
+
+class Ktctl:
+    """The CLI against an in-process ApiServer (tests, single binary) or a
+    remote REST endpoint (via RestClient below)."""
+
+    def __init__(self, api: ApiServer, out=None):
+        self.api = api
+        self.out = out if out is not None else sys.stdout
+
+    def _print(self, s: str) -> None:
+        self.out.write(s + "\n")
+
+    # each method returns the text it printed (handy for tests)
+
+    def run(self, argv: Sequence[str]) -> int:
+        if not argv:
+            self._print("ktctl controls the kubernetes_tpu control plane")
+            return 0
+        cmd, *rest = argv
+        fn = getattr(self, "cmd_" + cmd.replace("-", "_"), None)
+        if fn is None:
+            self._print(f"error: unknown command {cmd!r}")
+            return 1
+        try:
+            fn(rest)
+            return 0
+        except SystemExit as e:
+            self._print(str(e))
+            return 1
+
+    @staticmethod
+    def _flags(args: List[str]) -> (List[str], Dict[str, str]):
+        pos, flags = [], {}
+        i = 0
+        while i < len(args):
+            a = args[i]
+            if a.startswith("--"):
+                if "=" in a:
+                    k, _, v = a[2:].partition("=")
+                    flags[k] = v
+                else:
+                    flags[a[2:]] = args[i + 1] if i + 1 < len(args) else ""
+                    i += 1
+            elif a == "-n":
+                flags["namespace"] = args[i + 1]
+                i += 1
+            elif a == "-o":
+                flags["output"] = args[i + 1]
+                i += 1
+            elif a == "-f":
+                flags["filename"] = args[i + 1]
+                i += 1
+            elif a == "-l":
+                flags["selector"] = args[i + 1]
+                i += 1
+            else:
+                pos.append(a)
+            i += 1
+        return pos, flags
+
+    def _objs(self, kind: str, ns: str, name: str = "",
+              selector: str = "") -> List[Any]:
+        if name:
+            return [self.api.get(kind, ns if not KIND_INFO[kind][1] else "",
+                                 name)]
+        objs, _ = self.api.list(kind)
+        if not KIND_INFO[kind][1] and ns != "*":
+            objs = [o for o in objs if getattr(o, "namespace", "") == ns]
+        if selector:
+            want = dict(kv.split("=", 1) for kv in selector.split(",")
+                        if "=" in kv)
+            objs = [o for o in objs
+                    if all(getattr(o, "labels", {}).get(k) == v
+                           for k, v in want.items())]
+        return objs
+
+    def cmd_get(self, args):
+        pos, flags = self._flags(args)
+        if not pos:
+            raise SystemExit("error: resource type required")
+        kind = resolve_kind(pos[0])
+        ns = flags.get("namespace", "default")
+        if "all-namespaces" in flags:
+            ns = "*"
+        objs = self._objs(kind, ns, pos[1] if len(pos) > 1 else "",
+                          flags.get("selector", ""))
+        self._print(render(kind, objs, flags.get("output", "table")))
+
+    def cmd_describe(self, args):
+        pos, flags = self._flags(args)
+        kind = resolve_kind(pos[0])
+        ns = flags.get("namespace", "default")
+        for obj in self._objs(kind, ns, pos[1] if len(pos) > 1 else ""):
+            self._print(describe(kind, obj))
+
+    def _load_manifests(self, flags) -> List[Any]:
+        text = open(flags["filename"]).read() \
+            if flags.get("filename", "-") != "-" else sys.stdin.read()
+        docs = [d for d in yaml.safe_load_all(text) if d]
+        return [wire.decode_any(d) for d in docs], docs
+
+    def cmd_create(self, args):
+        _, flags = self._flags(args)
+        objs, raws = self._load_manifests(flags)
+        for obj, raw in zip(objs, raws):
+            kind = raw.get("kind")
+            self.api.create(kind, obj)
+            self._print(f"{KIND_INFO[kind][0]}/{obj.name} created")
+
+    def cmd_apply(self, args):
+        _, flags = self._flags(args)
+        objs, raws = self._load_manifests(flags)
+        for obj, raw in zip(objs, raws):
+            kind = raw.get("kind")
+            if hasattr(obj, "annotations"):
+                obj.annotations[LAST_APPLIED] = json.dumps(raw,
+                                                           sort_keys=True)
+            ns = getattr(obj, "namespace", "")
+            try:
+                cur = self.api.get(kind, ns if not KIND_INFO[kind][1] else "",
+                                   obj.name)
+            except Exception:
+                cur = None
+            if cur is None:
+                self.api.create(kind, obj)
+                self._print(f"{KIND_INFO[kind][0]}/{obj.name} created")
+            else:
+                prev = getattr(cur, "annotations", {}).get(LAST_APPLIED)
+                if prev == json.dumps(raw, sort_keys=True):
+                    self._print(f"{KIND_INFO[kind][0]}/{obj.name} unchanged")
+                    continue
+                obj.resource_version = cur.resource_version
+                self.api.update(kind, obj)
+                self._print(f"{KIND_INFO[kind][0]}/{obj.name} configured")
+
+    def cmd_delete(self, args):
+        pos, flags = self._flags(args)
+        kind = resolve_kind(pos[0])
+        ns = flags.get("namespace", "default")
+        for obj in self._objs(kind, ns, pos[1] if len(pos) > 1 else "",
+                              flags.get("selector", "")):
+            self.api.delete(kind, getattr(obj, "namespace", ""), obj.name)
+            self._print(f"{KIND_INFO[kind][0]}/{obj.name} deleted")
+
+    def cmd_scale(self, args):
+        pos, flags = self._flags(args)
+        kind = resolve_kind(pos[0])
+        reps = int(flags["replicas"])
+        self.api.scale(kind, flags.get("namespace", "default"), pos[1],
+                       replicas=reps)
+        self._print(f"{KIND_INFO[kind][0]}/{pos[1]} scaled")
+
+    def _mutate_meta(self, args, field: str):
+        pos, flags = self._flags(args)
+        kind = resolve_kind(pos[0])
+        ns = flags.get("namespace", "default")
+        obj = self._objs(kind, ns, pos[1])[0]
+        d = getattr(obj, field)
+        for kv in pos[2:]:
+            if kv.endswith("-"):
+                d.pop(kv[:-1], None)
+            elif "=" in kv:
+                k, _, v = kv.partition("=")
+                d[k] = v
+        self.api.update(kind, obj)
+        self._print(f"{KIND_INFO[kind][0]}/{pos[1]} {field[:-1]}ed")
+
+    def cmd_label(self, args):
+        self._mutate_meta(args, "labels")
+
+    def cmd_annotate(self, args):
+        self._mutate_meta(args, "annotations")
+
+    def cmd_taint(self, args):
+        pos, flags = self._flags(args)
+        if pos[0] not in ("nodes", "node", "no"):
+            raise SystemExit("error: taint only supports nodes")
+        node = self.api.get("Node", "", pos[1])
+        for spec in pos[2:]:
+            if spec.endswith("-"):
+                body = spec[:-1]
+                key = body.split("=", 1)[0].split(":", 1)[0]
+                node.taints = [t for t in node.taints if t.key != key]
+                continue
+            kv, _, effect = spec.rpartition(":")
+            k, _, v = kv.partition("=")
+            node.taints = list(node.taints) + [
+                Taint(k, v, TaintEffect(effect))]
+        self.api.update("Node", node)
+        self._print(f"node/{pos[1]} tainted")
+
+    def cmd_cordon(self, args):
+        pos, _ = self._flags(args)
+        node = self.api.get("Node", "", pos[0])
+        node.unschedulable = True
+        self.api.update("Node", node)
+        self._print(f"node/{pos[0]} cordoned")
+
+    def cmd_uncordon(self, args):
+        pos, _ = self._flags(args)
+        node = self.api.get("Node", "", pos[0])
+        node.unschedulable = False
+        self.api.update("Node", node)
+        self._print(f"node/{pos[0]} uncordoned")
+
+    def cmd_drain(self, args):
+        """cordon + evict every pod on the node (kubectl drain,
+        pkg/kubectl/cmd/drain.go; evictions honor PDBs server-side)."""
+        pos, flags = self._flags(args)
+        self.cmd_cordon([pos[0]])
+        pods, _ = self.api.list("Pod")
+        for p in pods:
+            if p.node_name == pos[0]:
+                self.api.evict(Eviction(p.name, p.namespace))
+                self._print(f"pod/{p.name} evicted")
+
+    def cmd_rollout(self, args):
+        pos, flags = self._flags(args)
+        sub, kind_arg, name = pos[0], pos[1], pos[2]
+        kind = resolve_kind(kind_arg)
+        ns = flags.get("namespace", "default")
+        obj = self.api.get(kind, ns, name)
+        if sub == "status":
+            ready = getattr(obj, "ready_replicas", 0)
+            want = getattr(obj, "replicas", 0)
+            if ready >= want:
+                self._print(f'{KIND_INFO[kind][0]} "{name}" successfully '
+                            "rolled out")
+            else:
+                self._print(f"Waiting for rollout to finish: {ready} of "
+                            f"{want} updated replicas are available...")
+        elif sub == "history":
+            for rev in getattr(obj, "revision_history", []) or ["<none>"]:
+                self._print(str(rev))
+        elif sub == "undo":
+            hist = getattr(obj, "revision_history", None)
+            if not hist:
+                raise SystemExit("error: no rollout history found")
+            obj.template = hist[-1]
+            self.api.update(kind, obj)
+            self._print(f"{KIND_INFO[kind][0]}/{name} rolled back")
+
+    def cmd_top(self, args):
+        pos, _ = self._flags(args)
+        if pos and pos[0] in ("node", "nodes", "no"):
+            pods, _ = self.api.list("Pod")
+            nodes, _ = self.api.list("Node")
+            usage = {}
+            for p in pods:
+                if p.node_name:
+                    r = p.resource_request()
+                    u = usage.setdefault(p.node_name, [0, 0])
+                    u[0] += r.milli_cpu
+                    u[1] += r.memory
+            self._print("NAME  CPU(cores)  MEMORY(bytes)")
+            for n in nodes:
+                u = usage.get(n.name, [0, 0])
+                self._print(f"{n.name}  {u[0]}m  {u[1]}")
+
+    def cmd_api_resources(self, args):
+        self._print("NAME  KIND  NAMESPACED")
+        for kind, (res, cluster) in sorted(KIND_INFO.items(),
+                                           key=lambda kv: kv[1][0]):
+            self._print(f"{res}  {kind}  {str(not cluster).lower()}")
+
+    def cmd_version(self, args):
+        self._print("Client Version: v1.7.0-tpu.0")
+        self._print("Server Version: v1.7.0-tpu.0")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for `python -m kubernetes_tpu.cli.ktctl --server URL ...`
+    (remote mode) — in-process mode is the library API (Ktctl(api))."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    server = None
+    if argv[:1] and argv[0].startswith("--server"):
+        if "=" in argv[0]:
+            server = argv.pop(0).split("=", 1)[1]
+        else:
+            argv.pop(0)
+            server = argv.pop(0)
+    if server:
+        from kubernetes_tpu.cli.rest_client import RestClient
+
+        api = RestClient(server)
+    else:
+        raise SystemExit("error: --server URL required outside a test "
+                         "harness (in-process mode is the library API)")
+    return Ktctl(api).run(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

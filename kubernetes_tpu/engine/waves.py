@@ -83,13 +83,44 @@ def _dynamic_fits(cls: Arrays, nodes: Arrays, state: NodeState) -> jnp.ndarray:
     )
 
 
+_DYNAMIC = ("LeastRequestedPriority", "MostRequestedPriority",
+            "BalancedResourceAllocation")
+_REDUCE = ("TaintTolerationPriority", "NodeAffinityPriority")
+
+
+def precompute(cls: Arrays, nodes: Arrays,
+               priorities: Tuple[Tuple[str, int], ...]) -> Arrays:
+    """Everything state-INdependent, computed once per batch OUTSIDE the
+    wave loop (XLA cannot hoist work out of a lax.while_loop body): the
+    static predicate mask, the reduce-priority count matrices, and the
+    weighted sum of static priorities."""
+    c = cls["req"].shape[0]
+    n = nodes["alloc"].shape[0]
+    static_score = jnp.zeros((c, n), dtype=jnp.int32)
+    for name, weight in priorities:
+        if name in _DYNAMIC or name in _REDUCE \
+                or name in prio.HOST_ONLY_PRIORITIES:
+            continue
+        static_score = static_score \
+            + prio.PRIORITY_REGISTRY[name](cls, nodes, None) * weight
+    tt_cnt = jnp.einsum("ct,nt->cn", cls["intolerated_pref"],
+                        nodes["taints_pref"].astype(jnp.int8),
+                        preferred_element_type=jnp.int32) \
+        if any(nm == "TaintTolerationPriority" for nm, _ in priorities) \
+        else jnp.zeros((c, n), dtype=jnp.int32)
+    na_cnt = prio.node_affinity_counts(cls, nodes["labels"]) \
+        if any(nm == "NodeAffinityPriority" for nm, _ in priorities) \
+        else jnp.zeros((c, n), dtype=jnp.int32)
+    return {"static_fit": preds.static_fits(cls, nodes),
+            "static_score": static_score, "tt_cnt": tt_cnt, "na_cnt": na_cnt}
+
+
 def _wave_scores(cls: Arrays, nodes: Arrays, state: NodeState,
-                 fits: jnp.ndarray,
+                 pre: Arrays, fits: jnp.ndarray,
                  priorities: Tuple[Tuple[str, int], ...]) -> jnp.ndarray:
     """Weighted priority sum [C,N] against the frozen state; identical
     per-node integer formulas as the strict path (batch._step_scores)."""
-    c, n = fits.shape
-    total = jnp.zeros((c, n), dtype=jnp.int32)
+    total = pre["static_score"]
     alloc = nodes["alloc"]
     for name, weight in priorities:
         if name == "LeastRequestedPriority":
@@ -99,22 +130,18 @@ def _wave_scores(cls: Arrays, nodes: Arrays, state: NodeState,
         elif name == "BalancedResourceAllocation":
             s = prio.balanced_allocation(cls["nonzero"], state.nonzero, alloc)
         elif name == "TaintTolerationPriority":
-            cnt = jnp.einsum("ct,nt->cn", cls["intolerated_pref"],
-                             nodes["taints_pref"].astype(jnp.int8),
-                             preferred_element_type=jnp.int32)
+            cnt = pre["tt_cnt"]
             masked = jnp.where(fits, cnt, 0)
             mx = masked.max(axis=1, keepdims=True)
             s = jnp.where(mx == 0, MAX_PRIORITY,
                           (MAX_PRIORITY * (mx - cnt)) // jnp.maximum(mx, 1))
         elif name == "NodeAffinityPriority":
-            cnt = prio.node_affinity_counts(cls, nodes["labels"])
+            cnt = pre["na_cnt"]
             masked = jnp.where(fits, cnt, 0)
             mx = masked.max(axis=1, keepdims=True)
             s = jnp.where(mx > 0, (MAX_PRIORITY * cnt) // jnp.maximum(mx, 1), 0)
-        elif name in prio.HOST_ONLY_PRIORITIES:
+        else:  # static and host-only priorities are in pre["static_score"]
             continue
-        else:
-            s = prio.PRIORITY_REGISTRY[name](cls, nodes, fits)
         total = total + s * weight
     return total
 
@@ -178,17 +205,7 @@ def _dyn_at(total_cpu: jnp.ndarray, total_mem: jnp.ndarray,
             s = (prio._used_score(total_cpu, cap_cpu)
                  + prio._used_score(total_mem, cap_mem)) // 2
         elif name == "BalancedResourceAllocation":
-            f32 = jnp.float32
-            frac_c = jnp.where(cap_cpu == 0, f32(1.0),
-                               total_cpu.astype(f32)
-                               / jnp.maximum(cap_cpu, 1).astype(f32))
-            frac_m = jnp.where(cap_mem == 0, f32(1.0),
-                               total_mem.astype(f32)
-                               / jnp.maximum(cap_mem, 1).astype(f32))
-            diff = jnp.abs(frac_c - frac_m)
-            s = jnp.where((frac_c >= 1.0) | (frac_m >= 1.0), 0,
-                          ((f32(1.0) - diff) * MAX_PRIORITY
-                           ).astype(jnp.int32))
+            s = prio._balanced_score(total_cpu, total_mem, cap_cpu, cap_mem)
         else:
             continue
         out = out + s * weight
@@ -196,23 +213,24 @@ def _dyn_at(total_cpu: jnp.ndarray, total_mem: jnp.ndarray,
 
 
 def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
-               pod_class: jnp.ndarray, active: jnp.ndarray,
+               pre: Arrays, pod_class: jnp.ndarray, active: jnp.ndarray,
                counter: jnp.ndarray,
                priorities: Tuple[Tuple[str, int], ...],
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                           NodeState, jnp.ndarray]:
     """One wave (pure traceable body — jitted standalone as wave_step and
-    iterated on device by waves_loop). Returns (selected [P] (-1 = no fit),
-    accepted [P] bool, fit_count [P] int32, new state, new counter)."""
+    iterated on device by waves_loop). `pre` carries the hoisted
+    state-independent tensors (see precompute). Returns (selected [P]
+    (-1 = no fit), accepted [P] bool, fit_count [P] int32, new state,
+    new counter)."""
     P = pod_class.shape[0]
     N = nodes["alloc"].shape[0]
     iota = jnp.arange(P, dtype=jnp.int32)
     idx_n = jnp.arange(N, dtype=jnp.int32)
 
-    static_fit = preds.static_fits(cls, nodes)
-    fits = static_fit & _dynamic_fits(cls, nodes, state)  # [C,N]
+    fits = pre["static_fit"] & _dynamic_fits(cls, nodes, state)  # [C,N]
     fitcnt = fits.sum(axis=1).astype(jnp.int32)  # [C]
-    scores = _wave_scores(cls, nodes, state, fits, priorities)
+    scores = _wave_scores(cls, nodes, state, pre, fits, priorities)
     masked = jnp.where(fits, scores, jnp.int32(-1))
     best = masked.max(axis=1, keepdims=True)
     ties = (masked == best) & fits  # [C,N]
@@ -330,8 +348,12 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     return sel, accepted, fc, new_state, new_counter
 
 
-wave_step = functools.partial(jax.jit, static_argnames=("priorities",))(
-    _wave_once)
+@functools.partial(jax.jit, static_argnames=("priorities",))
+def wave_step(cls, nodes, state, pod_class, active, counter, priorities):
+    """Standalone single wave (tests/debugging); waves_loop is the fast path."""
+    pre = precompute(cls, nodes, priorities)
+    return _wave_once(cls, nodes, state, pre, pod_class, active, counter,
+                      priorities)
 
 
 @functools.partial(jax.jit, static_argnames=("priorities", "max_waves"))
@@ -349,6 +371,8 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
     still_active(P), counter, waves_used]; still_active pods exhausted
     max_waves (the host finishes them via the strict scan)."""
     P = pod_class.shape[0]
+    pre = precompute(cls, nodes, priorities)  # hoisted: while_loop bodies
+    # re-execute everything every iteration; XLA cannot hoist for us
 
     def cond(carry):
         _, active, _, _, _, w = carry
@@ -357,7 +381,7 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
     def body(carry):
         state, active, counter, fsel, ffc, w = carry
         sel, accepted, fc, state2, counter2 = _wave_once(
-            cls, nodes, state, pod_class, active, counter, priorities)
+            cls, nodes, state, pre, pod_class, active, counter, priorities)
         placed = active & accepted
         fsel = jnp.where(placed, sel, fsel)
         ffc = jnp.where(active, fc, ffc)

@@ -80,14 +80,13 @@ def most_requested(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
     return (cpu + mem) // 2
 
 
-def balanced_allocation(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
-                        alloc: jnp.ndarray) -> jnp.ndarray:
+def _balanced_score(tot_cpu: jnp.ndarray, tot_mem: jnp.ndarray,
+                    cap_cpu: jnp.ndarray, cap_mem: jnp.ndarray) -> jnp.ndarray:
     """10 - |cpuFraction - memFraction|*10, truncated; 0 when either
     fraction >= 1; fraction(cap==0) := 1
-    (balanced_resource_allocation.go:51-92,105)."""
-    tot_cpu, tot_mem = _totals(pod_nonzero, node_nonzero)
-    cap_cpu = alloc[None, :, 0]
-    cap_mem = alloc[None, :, 1]
+    (balanced_resource_allocation.go:51-92,105). Shape-generic — shared by
+    the [P,N] kernel below and the wave engine's per-row acceptance window
+    so the two stay bit-identical."""
     f32 = jnp.float32
     frac_c = jnp.where(cap_cpu == 0, f32(1.0),
                        tot_cpu.astype(f32) / jnp.maximum(cap_cpu, 1).astype(f32))
@@ -96,6 +95,14 @@ def balanced_allocation(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
     diff = jnp.abs(frac_c - frac_m)
     score = ((f32(1.0) - diff) * MAX_PRIORITY).astype(jnp.int32)  # trunc toward 0
     return jnp.where((frac_c >= 1.0) | (frac_m >= 1.0), 0, score)
+
+
+def balanced_allocation(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
+                        alloc: jnp.ndarray) -> jnp.ndarray:
+    """BalancedResourceAllocationMap [P,N] (balanced_resource_allocation.go)."""
+    tot_cpu, tot_mem = _totals(pod_nonzero, node_nonzero)
+    return _balanced_score(tot_cpu, tot_mem, alloc[None, :, 0],
+                           alloc[None, :, 1])
 
 
 def taint_toleration(intolerated_pref: jnp.ndarray, taints_pref: jnp.ndarray,

@@ -1,0 +1,9 @@
+from kubernetes_tpu.quota.evaluator import (  # noqa: F401
+    pod_usage,
+    object_count_usage,
+    usage_for,
+    quota_scopes_match,
+    add_usage,
+    sub_usage,
+    exceeds,
+)

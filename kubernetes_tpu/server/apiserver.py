@@ -1,0 +1,403 @@
+"""The full apiserver: handler chain around the apiserver-lite store.
+
+Mirror of DefaultBuildHandlerChain
+(staging/src/k8s.io/apiserver/pkg/server/config.go:469) — the filters a
+request traverses before the registry:
+
+    panic-recovery -> request-info -> [timeout] -> authentication -> audit ->
+    [impersonation] -> max-in-flight -> authorization -> admission ->
+    registry strategy -> storage
+
+plus the subresources the control plane depends on: pods/binding
+(pkg/registry/core/pod/storage/storage.go:128 BindingREST), pods/status,
+pods/eviction with PDB enforcement (pkg/registry/core/pod/storage/
+eviction.go), scale for replicated workloads, and namespace two-phase
+delete. Audit entries (apiserver/pkg/audit) land in a bounded ring.
+
+Transport note (SURVEY.md §5.8): in-process calls are the fast path, the
+HTTP facade (server/rest_http.py) exposes the same handler over REST for
+out-of-process clients — the control-plane fabric stays request/response
+exactly like the reference; the TPU fabric is the engine's device arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.admission import (
+    AdmissionChain,
+    AdmissionRequest,
+    Rejected,
+    default_plugins,
+)
+from kubernetes_tpu.api.cluster import Eviction
+from kubernetes_tpu.api.rbac import (
+    UserInfo,
+    bootstrap_cluster_role_bindings,
+    bootstrap_cluster_roles,
+)
+from kubernetes_tpu.api.types import Binding, Pod
+from kubernetes_tpu.api.workloads import pods_matching
+from kubernetes_tpu.auth.authn import Credential, Unauthenticated, UnionAuthenticator
+from kubernetes_tpu.auth.authz import (
+    ALLOW,
+    Attributes,
+    DENY,
+    Forbidden,
+    NO_OPINION,
+    NodeAuthorizer,
+    RBACAuthorizer,
+    UnionAuthorizer,
+)
+from kubernetes_tpu.server.apiserver_lite import (
+    ApiServerLite,
+    Conflict,
+    NotFound,
+)
+
+# kind -> (resource plural, cluster-scoped)
+KIND_INFO: Dict[str, Tuple[str, bool]] = {
+    "Pod": ("pods", False),
+    "Node": ("nodes", True),
+    "Service": ("services", False),
+    "Endpoints": ("endpoints", False),
+    "Namespace": ("namespaces", True),
+    "ReplicaSet": ("replicasets", False),
+    "ReplicationController": ("replicationcontrollers", False),
+    "Deployment": ("deployments", False),
+    "StatefulSet": ("statefulsets", False),
+    "DaemonSet": ("daemonsets", False),
+    "Job": ("jobs", False),
+    "CronJob": ("cronjobs", False),
+    "PersistentVolume": ("persistentvolumes", True),
+    "PersistentVolumeClaim": ("persistentvolumeclaims", False),
+    "Secret": ("secrets", False),
+    "ConfigMap": ("configmaps", False),
+    "ServiceAccount": ("serviceaccounts", False),
+    "ResourceQuota": ("resourcequotas", False),
+    "LimitRange": ("limitranges", False),
+    "PodDisruptionBudget": ("poddisruptionbudgets", False),
+    "PriorityClass": ("priorityclasses", True),
+    "StorageClass": ("storageclasses", True),
+    "Role": ("roles", False),
+    "ClusterRole": ("clusterroles", True),
+    "RoleBinding": ("rolebindings", False),
+    "ClusterRoleBinding": ("clusterrolebindings", True),
+    "Event": ("events", False),
+    "HorizontalPodAutoscaler": ("horizontalpodautoscalers", False),
+    "CustomResourceDefinition": ("customresourcedefinitions", True),
+    "APIService": ("apiservices", True),
+}
+
+
+class TooManyRequests(Exception):
+    """429 — eviction blocked by a PodDisruptionBudget, or max-in-flight."""
+
+
+class Invalid(Exception):
+    """422 — registry strategy validation failure."""
+
+
+@dataclass
+class AuditEvent:
+    """apiserver/pkg/audit event (one per request, ResponseComplete stage)."""
+
+    user: str
+    verb: str
+    resource: str
+    namespace: str
+    name: str
+    code: int
+    ts: float = 0.0
+
+
+class ApiServer:
+    """Authenticated/authorized/admitted facade over ApiServerLite.
+
+    auth=False (default) keeps the open in-process behavior benches and
+    controllers use (the reference's --insecure-port localhost path);
+    auth=True enforces the full chain, like the secure port.
+    """
+
+    def __init__(self, store: Optional[ApiServerLite] = None,
+                 authenticator: Optional[UnionAuthenticator] = None,
+                 auth: bool = False,
+                 admission: Optional[AdmissionChain] = None,
+                 max_audit: int = 10_000,
+                 now=time.time):
+        self.store = store if store is not None else ApiServerLite()
+        self.auth_enabled = auth
+        self.authenticator = authenticator
+        self.admission = admission if admission is not None else \
+            AdmissionChain(default_plugins(), store=self.store)
+        self.authorizer = UnionAuthorizer(
+            [NodeAuthorizer(self.store), RBACAuthorizer(self.store)])
+        self.audit_log: List[AuditEvent] = []
+        self._max_audit = max_audit
+        self._now = now
+        self._audit_lock = threading.Lock()
+        self._inflight = threading.Semaphore(400)  # --max-requests-inflight
+
+    # ---------------------------------------------------------------- setup
+
+    def bootstrap_rbac(self) -> None:
+        """Install the bootstrap policy (rbac/bootstrappolicy) if absent —
+        the post-start hook of the rbac rest storage provider."""
+        existing = {r.name for r in self.store.list("ClusterRole")[0]}
+        for role in bootstrap_cluster_roles():
+            if role.name not in existing:
+                self.store.create("ClusterRole", role)
+        existing_b = {b.name for b in self.store.list("ClusterRoleBinding")[0]}
+        for b in bootstrap_cluster_role_bindings():
+            if b.name not in existing_b:
+                self.store.create("ClusterRoleBinding", b)
+
+    # ------------------------------------------------------------- the chain
+
+    def _authn(self, cred: Optional[Credential]) -> UserInfo:
+        if not self.auth_enabled:
+            return UserInfo("system:admin", groups=["system:masters"])
+        if cred is None or self.authenticator is None:
+            raise Unauthenticated("no credentials provided")
+        return self.authenticator.authenticate(cred)
+
+    def _authz(self, user: UserInfo, verb: str, kind: str, namespace: str,
+               name: str, subresource: str = "") -> None:
+        if not self.auth_enabled:
+            return
+        resource, cluster_scoped = KIND_INFO.get(kind, (kind.lower() + "s",
+                                                        False))
+        if subresource:
+            resource = resource + "/" + subresource
+        attrs = Attributes(user=user, verb=verb, resource=resource,
+                           namespace="" if cluster_scoped else namespace,
+                           name=name)
+        if self.authorizer.authorize(attrs) != ALLOW:
+            raise Forbidden(
+                f'User "{user.name}" cannot {verb} {resource} '
+                f'in namespace "{namespace}"')
+
+    def _audit(self, user: UserInfo, verb: str, kind: str, namespace: str,
+               name: str, code: int) -> None:
+        resource, _ = KIND_INFO.get(kind, (kind.lower() + "s", False))
+        with self._audit_lock:
+            self.audit_log.append(AuditEvent(
+                user.name, verb, resource, namespace, name, code,
+                ts=self._now()))
+            if len(self.audit_log) > self._max_audit:
+                del self.audit_log[: len(self.audit_log) - self._max_audit]
+
+    def _run(self, cred, verb, kind, namespace, name, fn, subresource=""):
+        """panic-recovery + authn + authz + audit around fn()."""
+        with self._inflight:
+            user = self._authn(cred)
+            code = 200
+            try:
+                self._authz(user, verb, kind, namespace, name, subresource)
+                return fn(user)
+            except Unauthenticated:
+                code = 401
+                raise
+            except Forbidden:
+                code = 403
+                raise
+            except Rejected:
+                code = 403
+                raise
+            except NotFound:
+                code = 404
+                raise
+            except Conflict:
+                code = 409
+                raise
+            except TooManyRequests:
+                code = 429
+                raise
+            except Invalid:
+                code = 422
+                raise
+            finally:
+                self._audit(user, verb, kind, namespace, name, code)
+
+    # ---------------------------------------------------------------- verbs
+
+    def create(self, kind: str, obj: Any,
+               cred: Optional[Credential] = None) -> int:
+        ns = getattr(obj, "namespace", "")
+
+        def do(user: UserInfo) -> int:
+            self._validate(kind, obj, None)
+            self.admission.admit(AdmissionRequest(
+                "CREATE", kind, ns, obj.name, obj=obj, user=user))
+            return self.store.create(kind, obj)
+
+        return self._run(cred, "create", kind, ns, obj.name, do)
+
+    def get(self, kind: str, namespace: str, name: str,
+            cred: Optional[Credential] = None) -> Any:
+        return self._run(cred, "get", kind, namespace, name,
+                         lambda u: self.store.get(kind, namespace, name))
+
+    def list(self, kind: str, cred: Optional[Credential] = None):
+        return self._run(cred, "list", kind, "", "",
+                         lambda u: self.store.list(kind))
+
+    def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None,
+               cred: Optional[Credential] = None) -> int:
+        ns = getattr(obj, "namespace", "")
+
+        def do(user: UserInfo) -> int:
+            old = self._try_get(kind, ns, obj.name)
+            self._validate(kind, obj, old)
+            self.admission.admit(AdmissionRequest(
+                "UPDATE", kind, ns, obj.name, obj=obj, old_obj=old,
+                user=user))
+            return self.store.update(kind, obj, expect_rv=expect_rv)
+
+        return self._run(cred, "update", kind, ns, obj.name, do)
+
+    def delete(self, kind: str, namespace: str, name: str,
+               cred: Optional[Credential] = None) -> None:
+        def do(user: UserInfo) -> None:
+            old = self._try_get(kind, namespace, name)
+            self.admission.admit(AdmissionRequest(
+                "DELETE", kind, namespace, name, old_obj=old, user=user))
+            if kind == "Namespace":
+                # two-phase delete: mark Terminating; the namespace
+                # controller empties it then finalizes (pkg/controller/
+                # namespace + registry/core/namespace strategy)
+                ns_obj = self.store.get("Namespace", "", name)
+                if ns_obj.phase != "Terminating":
+                    ns_obj.phase = "Terminating"
+                    self.store.update("Namespace", ns_obj)
+                    return
+            self.store.delete(kind, namespace, name)
+
+        return self._run(cred, "delete", kind, namespace, name, do)
+
+    def watch_since(self, kinds, from_rv, timeout=None,
+                    cred: Optional[Credential] = None):
+        user = self._authn(cred)
+        if self.auth_enabled:
+            for k in kinds:
+                self._authz(user, "watch", k, "", "")
+        return self.store.watch_since(kinds, from_rv, timeout=timeout)
+
+    # ----------------------------------------------------------- subresources
+
+    def bind(self, binding: Binding, cred: Optional[Credential] = None) -> int:
+        def do(user: UserInfo) -> int:
+            return self.store.bind(binding)
+
+        return self._run(cred, "create", "Pod", binding.pod_namespace,
+                         binding.pod_name, do, subresource="binding")
+
+    def bind_many(self, bindings, cred: Optional[Credential] = None):
+        if bindings:
+            self._run(cred, "create", "Pod", bindings[0].pod_namespace,
+                      bindings[0].pod_name, lambda u: None,
+                      subresource="binding")
+        return self.store.bind_many(bindings)
+
+    def update_status(self, kind: str, obj: Any,
+                      cred: Optional[Credential] = None) -> int:
+        ns = getattr(obj, "namespace", "")
+        return self._run(
+            cred, "update", kind, ns, obj.name,
+            lambda u: self.store.update(kind, obj), subresource="status")
+
+    def evict(self, ev: Eviction, cred: Optional[Credential] = None) -> None:
+        """pods/eviction (eviction.go): honor PodDisruptionBudgets — refuse
+        with 429 when disruptions_allowed is exhausted."""
+
+        def do(user: UserInfo) -> None:
+            pod = self.store.get("Pod", ev.namespace, ev.pod_name)
+            for pdb in self.store.list("PodDisruptionBudget")[0]:
+                if pdb.namespace != ev.namespace or pdb.selector is None:
+                    continue
+                if not pods_matching(pdb, [pod]):
+                    continue
+                if pdb.disruptions_allowed <= 0:
+                    raise TooManyRequests(
+                        f"Cannot evict pod as it would violate the pod's "
+                        f"disruption budget {pdb.name}")
+                pdb.disruptions_allowed -= 1
+                self.store.update("PodDisruptionBudget", pdb)
+            self.store.delete("Pod", ev.namespace, ev.pod_name)
+
+        return self._run(cred, "create", "Pod", ev.namespace, ev.pod_name,
+                         do, subresource="eviction")
+
+    def scale(self, kind: str, namespace: str, name: str,
+              replicas: Optional[int] = None,
+              cred: Optional[Credential] = None) -> int:
+        """The scale subresource (registry/.../scale): get or set replicas
+        on RS/RC/Deployment/StatefulSet."""
+
+        def do(user: UserInfo) -> int:
+            obj = self.store.get(kind, namespace, name)
+            if replicas is None:
+                return obj.replicas
+            if replicas < 0:
+                raise Invalid("replicas must be >= 0")
+            obj.replicas = replicas
+            self.store.update(kind, obj)
+            return replicas
+
+        verb = "get" if replicas is None else "update"
+        return self._run(cred, verb, kind, namespace, name, do,
+                         subresource="scale")
+
+    def finalize_namespace(self, name: str,
+                           cred: Optional[Credential] = None) -> None:
+        """namespaces/finalize: the namespace controller calls this once the
+        namespace is empty; the store row is removed."""
+
+        def do(user: UserInfo) -> None:
+            self.store.delete("Namespace", "", name)
+
+        return self._run(cred, "update", "Namespace", "", name, do,
+                         subresource="finalize")
+
+    # -------------------------------------------------------------- helpers
+
+    def healthz(self) -> Dict[str, str]:
+        return {"status": "ok"}
+
+    def configz(self) -> Dict[str, Any]:
+        return {"admission": [type(p).__name__ for p in
+                              self.admission.plugins],
+                "authorization": ["Node", "RBAC"] if self.auth_enabled
+                else ["AlwaysAllow"]}
+
+    def _try_get(self, kind, ns, name):
+        try:
+            return self.store.get(kind, ns, name)
+        except NotFound:
+            return None
+
+    def _validate(self, kind: str, obj: Any, old: Any) -> None:
+        """Registry strategy validation (pkg/registry/core/*/strategy.go),
+        the load-bearing subset."""
+        name = getattr(obj, "name", "")
+        if not name:
+            raise Invalid(f"{kind}: metadata.name is required")
+        if kind == "Pod":
+            if old is not None and old.node_name and \
+                    obj.node_name != old.node_name:
+                raise Invalid("pod spec.nodeName is immutable after binding")
+            for c in obj.containers:
+                for res, v in list(c.requests.items()):
+                    if v < 0:
+                        raise Invalid(f"negative request {res}={v}")
+                for res, v in c.limits.items():
+                    if res in c.requests and c.requests[res] > v:
+                        raise Invalid(
+                            f"request {res} must be <= limit")
+        elif kind in ("ReplicaSet", "ReplicationController", "Deployment",
+                      "StatefulSet"):
+            if getattr(obj, "replicas", 0) < 0:
+                raise Invalid("spec.replicas must be >= 0")

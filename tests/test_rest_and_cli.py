@@ -1,0 +1,173 @@
+"""REST facade (server/rest_http.py) + RestClient + ktctl CLI.
+
+Harness shape mirrors the reference's cmd tests (pkg/kubectl/cmd/*_test.go
+with a fake REST backend) — here the backend is the real chain over HTTP."""
+
+import io
+import json
+
+import pytest
+import yaml
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.api.workloads import Namespace, ReplicaSet
+from kubernetes_tpu.api.types import LabelSelector
+from kubernetes_tpu.cli.ktctl import Ktctl
+from kubernetes_tpu.cli.rest_client import RestClient
+from kubernetes_tpu.server.apiserver import ApiServer
+from kubernetes_tpu.server.apiserver_lite import NotFound
+from kubernetes_tpu.server.rest_http import RestServer
+
+
+@pytest.fixture()
+def rest():
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    srv = RestServer(api)
+    srv.start()
+    yield api, RestClient(f"http://127.0.0.1:{srv.port}")
+    srv.stop()
+
+
+def test_rest_crud_roundtrip(rest):
+    api, client = rest
+    client.create("Node", make_node("n1", cpu=2000))
+    client.create("Pod", make_pod("p1", cpu=100, memory=1 << 20))
+    node = client.get("Node", "", "n1")
+    assert node.allocatable.milli_cpu == 2000
+    pods, rv = client.list("Pod")
+    assert [p.name for p in pods] == ["p1"] and rv > 0
+    p = pods[0]
+    p.labels["x"] = "y"
+    client.update("Pod", p)
+    assert client.get("Pod", "default", "p1").labels["x"] == "y"
+    client.delete("Pod", "default", "p1")
+    with pytest.raises(NotFound):
+        client.get("Pod", "default", "p1")
+
+
+def test_rest_binding_and_watch(rest):
+    api, client = rest
+    client.create("Node", make_node("n1"))
+    rv0 = client.list("Pod")[1]
+    client.create("Pod", make_pod("w"))
+    from kubernetes_tpu.api.types import Binding
+    client.bind(Binding("w", "default", "default/w", "n1"))
+    assert client.get("Pod", "default", "w").node_name == "n1"
+    evs = client.watch_since(("Pod",), rv0)
+    assert [e.type for e in evs] == ["ADDED", "MODIFIED"]
+    assert evs[-1].obj.node_name == "n1"
+
+
+def test_rest_scale_and_healthz(rest):
+    api, client = rest
+    api.store.create("ReplicaSet", ReplicaSet(
+        "rs", "default", replicas=2,
+        selector=LabelSelector(match_labels={"a": "b"})))
+    assert client.scale("ReplicaSet", "default", "rs") == 2
+    client.scale("ReplicaSet", "default", "rs", replicas=7)
+    assert api.store.get("ReplicaSet", "default", "rs").replicas == 7
+    assert client.healthz() == {"status": "ok"}
+    assert client.version()["gitVersion"].startswith("v1.7")
+
+
+def make_cli():
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    out = io.StringIO()
+    return api, Ktctl(api, out=out), out
+
+
+def test_ktctl_get_table_and_json():
+    api, cli, out = make_cli()
+    api.create("Node", make_node("n1"))
+    api.create("Pod", make_pod("a", cpu=100, memory=1 << 20))
+    api.create("Pod", make_pod("b", cpu=100, memory=1 << 20))
+    assert cli.run(["get", "pods"]) == 0
+    text = out.getvalue()
+    assert "NAME" in text and "a" in text and "b" in text
+    out.truncate(0), out.seek(0)
+    cli.run(["get", "po", "a", "-o", "json"])
+    data = json.loads(out.getvalue())
+    assert data[0]["name"] == "a"
+    out.truncate(0), out.seek(0)
+    cli.run(["get", "nodes", "-o", "name"])
+    assert out.getvalue().strip() == "nodes/n1"
+
+
+def test_ktctl_create_apply_delete(tmp_path):
+    api, cli, out = make_cli()
+    manifest = tmp_path / "rs.yaml"
+    manifest.write_text(yaml.safe_dump({
+        "kind": "ReplicaSet", "name": "web", "namespace": "default",
+        "replicas": 3,
+        "selector": {"match_labels": {"app": "web"}},
+    }))
+    assert cli.run(["create", "-f", str(manifest)]) == 0
+    assert api.store.get("ReplicaSet", "default", "web").replicas == 3
+    # apply: unchanged -> "unchanged"; edited -> "configured"
+    cli.run(["apply", "-f", str(manifest)])
+    assert "configured" in out.getvalue() or "unchanged" in out.getvalue()
+    manifest.write_text(yaml.safe_dump({
+        "kind": "ReplicaSet", "name": "web", "namespace": "default",
+        "replicas": 5,
+        "selector": {"match_labels": {"app": "web"}},
+    }))
+    cli.run(["apply", "-f", str(manifest)])
+    assert api.store.get("ReplicaSet", "default", "web").replicas == 5
+    cli.run(["delete", "rs", "web"])
+    with pytest.raises(NotFound):
+        api.store.get("ReplicaSet", "default", "web")
+
+
+def test_ktctl_accepts_k8s_pod_manifest(tmp_path):
+    api, cli, out = make_cli()
+    manifest = tmp_path / "pod.yaml"
+    manifest.write_text(yaml.safe_dump({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "nginx", "namespace": "default",
+                     "labels": {"app": "nginx"}},
+        "spec": {"containers": [{
+            "name": "c", "image": "nginx:1.13",
+            "resources": {"requests": {"cpu": "250m", "memory": "64Mi"}}}]},
+    }))
+    assert cli.run(["create", "-f", str(manifest)]) == 0
+    pod = api.store.get("Pod", "default", "nginx")
+    assert pod.containers[0].requests["cpu"] == 250
+    assert pod.containers[0].requests["memory"] == 64 << 20
+
+
+def test_ktctl_label_taint_cordon_drain():
+    api, cli, out = make_cli()
+    api.create("Node", make_node("n1"))
+    api.create("Pod", make_pod("p", node_name=""))
+    api.store.bind(__import__("kubernetes_tpu.api.types",
+                              fromlist=["Binding"]).Binding(
+        "p", "default", "default/p", "n1"))
+    cli.run(["label", "nodes", "n1", "zone=a"])
+    assert api.store.get("Node", "", "n1").labels["zone"] == "a"
+    cli.run(["taint", "nodes", "n1", "dedicated=gpu:NoSchedule"])
+    assert api.store.get("Node", "", "n1").taints[0].key == "dedicated"
+    cli.run(["taint", "nodes", "n1", "dedicated-"])
+    assert api.store.get("Node", "", "n1").taints == []
+    cli.run(["cordon", "n1"])
+    assert api.store.get("Node", "", "n1").unschedulable
+    cli.run(["drain", "n1"])
+    assert [p for p in api.store.list("Pod")[0]] == []
+    cli.run(["uncordon", "n1"])
+    assert not api.store.get("Node", "", "n1").unschedulable
+
+
+def test_ktctl_scale_top_api_resources():
+    api, cli, out = make_cli()
+    api.store.create("ReplicaSet", ReplicaSet(
+        "rs", "default", replicas=1,
+        selector=LabelSelector(match_labels={"a": "b"})))
+    cli.run(["scale", "rs", "rs", "--replicas", "4"])
+    assert api.store.get("ReplicaSet", "default", "rs").replicas == 4
+    api.create("Node", make_node("n1"))
+    cli.run(["top", "nodes"])
+    assert "n1" in out.getvalue()
+    out.truncate(0), out.seek(0)
+    cli.run(["api-resources"])
+    assert "pods" in out.getvalue() and "nodes" in out.getvalue()
