@@ -105,3 +105,23 @@ class Eviction:
 
     pod_name: str
     namespace: str = "default"
+
+
+@dataclass
+class CertificateSigningRequest:
+    """certificates.k8s.io CSR (pkg/apis/certificates/types.go): a kubelet
+    requests a client identity; csrapproving auto-approves node requests
+    from bootstrap identities, csrsigning signs approved requests. The
+    'certificate' issued is the signed identity record CertAuthenticator
+    verifies (auth/authn.py)."""
+
+    name: str
+    namespace: str = ""  # cluster-scoped
+    requestor: str = ""  # authenticated user who posted the CSR
+    groups: List[str] = field(default_factory=list)
+    cn: str = ""  # requested common name (system:node:<name>)
+    orgs: List[str] = field(default_factory=list)  # requested groups
+    approved: bool = False
+    denied: bool = False
+    certificate: Optional[dict] = None  # signed record once issued
+    resource_version: int = 0

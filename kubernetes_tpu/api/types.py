@@ -305,6 +305,8 @@ class PersistentVolume:
     name: str
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
+    capacity: int = 0  # bytes (spec.capacity.storage; PV binder matching)
+    access_modes: List[str] = field(default_factory=list)
     source: Volume = field(default_factory=Volume)
     # RequiredDuringScheduling node-selector terms; unlike pod node affinity
     # these are ANDed (util.go:202-214 loops ALL terms, each must match)
@@ -320,6 +322,8 @@ class PersistentVolumeClaim:
     name: str
     namespace: str = "default"
     volume_name: str = ""  # bound PV name; empty = unbound
+    capacity: int = 0  # requested bytes (spec.resources.requests.storage)
+    access_modes: List[str] = field(default_factory=list)
     resource_version: int = 0
 
 
@@ -453,6 +457,7 @@ class Node:
     allowed_pod_number: int = 110
     taints: List[Taint] = field(default_factory=list)
     unschedulable: bool = False
+    pod_cidr: str = ""  # spec.podCIDR (route controller, kubenet)
     conditions: List[NodeCondition] = field(default_factory=list)
     images: List[ContainerImage] = field(default_factory=list)
     # LastHeartbeatTime of the Ready condition (v1.NodeCondition) — written by

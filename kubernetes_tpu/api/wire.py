@@ -48,6 +48,8 @@ KIND_REGISTRY: Dict[str, Type] = {
     "Secret": cluster_mod.Secret,
     "ConfigMap": cluster_mod.ConfigMap,
     "PodDisruptionBudget": cluster_mod.PodDisruptionBudget,
+    "CertificateSigningRequest": cluster_mod.CertificateSigningRequest,
+    "HorizontalPodAutoscaler": wl.HorizontalPodAutoscaler,
     "Role": rbac_mod.Role,
     "ClusterRole": rbac_mod.ClusterRole,
     "RoleBinding": rbac_mod.RoleBinding,

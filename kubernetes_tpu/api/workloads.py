@@ -119,6 +119,53 @@ class Job:
 
 
 @dataclass
+class CronJob:
+    """batch/v2alpha1 CronJob (pkg/controller/cronjob): spawn Jobs on a cron
+    schedule. Schedule syntax supported: '@every <seconds>s' and the 5-field
+    subset 'M H * * *' / '*/N * * * *' (the cronjob controller's needs)."""
+
+    name: str
+    namespace: str = "default"
+    schedule: str = "@every 60s"
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    job_template: Job = field(default_factory=lambda: Job(name=""))
+    successful_jobs_history_limit: int = 3
+    failed_jobs_history_limit: int = 1
+    # status
+    last_schedule_time: float = 0.0
+    active_jobs: List[str] = field(default_factory=list)
+    resource_version: int = 0
+
+    def key(self) -> str:
+        return self.namespace + "/" + self.name
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    """autoscaling/v1 HPA (pkg/controller/podautoscaler): scale a target
+    workload by the ratio of observed to target CPU utilization —
+    desired = ceil(current * observed/target), bounded to [min,max], with
+    the reference's 10% tolerance dead-band (horizontal.go)."""
+
+    name: str
+    namespace: str = "default"
+    target_kind: str = "ReplicaSet"
+    target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_cpu_utilization: int = 80  # percent of requests
+    # status
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization: int = 0
+    resource_version: int = 0
+
+    def key(self) -> str:
+        return self.namespace + "/" + self.name
+
+
+@dataclass
 class DaemonSet:
     """extensions DaemonSet (pkg/controller/daemon): one pod per eligible
     node; eligibility mirrors the scheduler's GeneralPredicates-lite check
@@ -187,6 +234,8 @@ class Service:
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)
     cluster_ip: str = ""
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer
+    load_balancer_ip: str = ""  # status.loadBalancer ingress (service ctrl)
     resource_version: int = 0
 
     def key(self) -> str:

@@ -165,8 +165,12 @@ class Ktctl:
             self._print(str(e))
             return 1
 
-    @staticmethod
-    def _flags(args: List[str]) -> (List[str], Dict[str, str]):
+    # flags that never take a value (boolean presence flags)
+    BOOL_FLAGS = frozenset({"all-namespaces", "watch", "wide", "force",
+                            "ignore-daemonsets"})
+
+    @classmethod
+    def _flags(cls, args: List[str]) -> (List[str], Dict[str, str]):
         pos, flags = [], {}
         i = 0
         while i < len(args):
@@ -175,8 +179,11 @@ class Ktctl:
                 if "=" in a:
                     k, _, v = a[2:].partition("=")
                     flags[k] = v
+                elif a[2:] in cls.BOOL_FLAGS or i + 1 >= len(args) \
+                        or args[i + 1].startswith("-"):
+                    flags[a[2:]] = ""
                 else:
-                    flags[a[2:]] = args[i + 1] if i + 1 < len(args) else ""
+                    flags[a[2:]] = args[i + 1]
                     i += 1
             elif a == "-n":
                 flags["namespace"] = args[i + 1]

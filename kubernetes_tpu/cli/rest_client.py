@@ -81,8 +81,10 @@ class RestClient:
     def update(self, kind: str, obj: Any,
                expect_rv: Optional[int] = None) -> int:
         ns = getattr(obj, "namespace", "")
-        out = self._do("PUT", self._url(kind, ns, obj.name),
-                       wire.encode(obj, kind=kind))
+        url = self._url(kind, ns, obj.name)
+        if expect_rv is not None:
+            url += f"?resourceVersion={expect_rv}"  # CAS precondition
+        out = self._do("PUT", url, wire.encode(obj, kind=kind))
         return out.get("resourceVersion", 0)
 
     def update_status(self, kind: str, obj: Any) -> int:

@@ -1,0 +1,8 @@
+from kubernetes_tpu.cloud.provider import (  # noqa: F401
+    CloudProvider,
+    FakeCloud,
+    GCELikeCloud,
+    AWSLikeCloud,
+    get_provider,
+    register_provider,
+)

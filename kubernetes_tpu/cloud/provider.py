@@ -1,0 +1,215 @@
+"""Cloud provider interface + providers.
+
+Mirror of pkg/cloudprovider/cloud.go's Interface: Instances / Zones /
+LoadBalancer / Routes (the slices the service and route controllers consume)
+with the provider registry of pkg/cloudprovider/plugins.go. The reference
+ships 9 providers (aws, azure, cloudstack, gce, openstack, ovirt, photon,
+rackspace, vsphere) whose value is API-client plumbing against real clouds;
+here the contract is carried by FakeCloud (the reference's
+pkg/cloudprovider/providers/fake used by every controller test) plus two
+named providers exercising provider-specific behavior the controllers can
+observe (zone layout, LB naming, route semantics)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class LoadBalancerStatus:
+    ingress_ip: str = ""
+
+
+@dataclass
+class Route:
+    name: str
+    target_node: str
+    destination_cidr: str
+
+
+class CloudProvider:
+    """cloudprovider.Interface: nil-able sub-interfaces are modeled as
+    has_*() capability flags (Interface() (T, bool) in Go)."""
+
+    provider_name = "abstract"
+
+    # Instances
+    def has_instances(self) -> bool:
+        return False
+
+    def node_addresses(self, node_name: str) -> List[str]:
+        raise NotImplementedError
+
+    def instance_exists(self, node_name: str) -> bool:
+        raise NotImplementedError
+
+    # Zones
+    def has_zones(self) -> bool:
+        return False
+
+    def zone_for(self, node_name: str) -> Tuple[str, str]:  # (zone, region)
+        raise NotImplementedError
+
+    # LoadBalancer
+    def has_load_balancer(self) -> bool:
+        return False
+
+    def ensure_load_balancer(self, service_key: str,
+                             node_names: List[str]) -> LoadBalancerStatus:
+        raise NotImplementedError
+
+    def update_load_balancer(self, service_key: str,
+                             node_names: List[str]) -> None:
+        raise NotImplementedError
+
+    def ensure_load_balancer_deleted(self, service_key: str) -> None:
+        raise NotImplementedError
+
+    # Routes
+    def has_routes(self) -> bool:
+        return False
+
+    def list_routes(self) -> List[Route]:
+        raise NotImplementedError
+
+    def create_route(self, route: Route) -> None:
+        raise NotImplementedError
+
+    def delete_route(self, name: str) -> None:
+        raise NotImplementedError
+
+
+class FakeCloud(CloudProvider):
+    """pkg/cloudprovider/providers/fake: records calls, serves canned data."""
+
+    provider_name = "fake"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.instances: Dict[str, List[str]] = {}
+        self.zones: Dict[str, Tuple[str, str]] = {}
+        self.balancers: Dict[str, LoadBalancerStatus] = {}
+        self.balancer_nodes: Dict[str, List[str]] = {}
+        self.routes: Dict[str, Route] = {}
+        self.calls: List[str] = []
+        self._next_ip = 1
+
+    # Instances
+    def has_instances(self) -> bool:
+        return True
+
+    def add_instance(self, name: str, addresses: Optional[List[str]] = None,
+                     zone: str = "zone-a", region: str = "region-1") -> None:
+        self.instances[name] = addresses or [f"192.168.0.{len(self.instances)+1}"]
+        self.zones[name] = (zone, region)
+
+    def node_addresses(self, node_name: str) -> List[str]:
+        self.calls.append("node-addresses")
+        return self.instances.get(node_name, [])
+
+    def instance_exists(self, node_name: str) -> bool:
+        self.calls.append("instance-exists")
+        return node_name in self.instances
+
+    # Zones
+    def has_zones(self) -> bool:
+        return True
+
+    def zone_for(self, node_name: str) -> Tuple[str, str]:
+        return self.zones.get(node_name, ("zone-a", "region-1"))
+
+    # LoadBalancer
+    def has_load_balancer(self) -> bool:
+        return True
+
+    def ensure_load_balancer(self, service_key, node_names):
+        with self._lock:
+            self.calls.append("ensure-lb")
+            st = self.balancers.get(service_key)
+            if st is None:
+                st = LoadBalancerStatus(f"172.24.0.{self._next_ip}")
+                self._next_ip += 1
+                self.balancers[service_key] = st
+            self.balancer_nodes[service_key] = sorted(node_names)
+            return st
+
+    def update_load_balancer(self, service_key, node_names):
+        with self._lock:
+            self.calls.append("update-lb")
+            self.balancer_nodes[service_key] = sorted(node_names)
+
+    def ensure_load_balancer_deleted(self, service_key):
+        with self._lock:
+            self.calls.append("delete-lb")
+            self.balancers.pop(service_key, None)
+            self.balancer_nodes.pop(service_key, None)
+
+    # Routes
+    def has_routes(self) -> bool:
+        return True
+
+    def list_routes(self):
+        return list(self.routes.values())
+
+    def create_route(self, route: Route) -> None:
+        self.calls.append("create-route")
+        self.routes[route.name] = route
+
+    def delete_route(self, name: str) -> None:
+        self.calls.append("delete-route")
+        self.routes.pop(name, None)
+
+
+class GCELikeCloud(FakeCloud):
+    """GCE-shaped behavior (providers/gce): per-zone instance groups, LB IPs
+    from a regional pool, route names prefixed by cluster."""
+
+    provider_name = "gce-like"
+
+    def __init__(self, cluster: str = "ktpu"):
+        super().__init__()
+        self.cluster = cluster
+
+    def ensure_load_balancer(self, service_key, node_names):
+        st = super().ensure_load_balancer(service_key, node_names)
+        st.ingress_ip = "35.0.0." + st.ingress_ip.rsplit(".", 1)[1]
+        return st
+
+    def create_route(self, route: Route) -> None:
+        route = Route(f"{self.cluster}-{route.name}", route.target_node,
+                      route.destination_cidr)
+        super().create_route(route)
+
+
+class AWSLikeCloud(FakeCloud):
+    """AWS-shaped behavior (providers/aws): hostname-style LB ingress."""
+
+    provider_name = "aws-like"
+
+    def ensure_load_balancer(self, service_key, node_names):
+        st = super().ensure_load_balancer(service_key, node_names)
+        slug = service_key.replace("/", "-")
+        st.ingress_ip = f"{slug}.elb.region-1.example.amazonaws.com"
+        return st
+
+
+_REGISTRY: Dict[str, Callable[[], CloudProvider]] = {
+    "fake": FakeCloud,
+    "gce-like": GCELikeCloud,
+    "aws-like": AWSLikeCloud,
+}
+
+
+def register_provider(name: str, factory: Callable[[], CloudProvider]) -> None:
+    """cloudprovider.RegisterCloudProvider (plugins.go)."""
+    _REGISTRY[name] = factory
+
+
+def get_provider(name: str) -> CloudProvider:
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise KeyError(f"unknown cloud provider {name!r}; registered: "
+                       f"{sorted(_REGISTRY)}") from None

@@ -1,0 +1,197 @@
+"""Cloud-facing controllers: service load balancers, routes, PV binding,
+attach/detach.
+
+References:
+- pkg/controller/service/servicecontroller.go: type=LoadBalancer services
+  get an LB ensured via the cloud provider; node-set changes update members;
+  deletes tear the LB down.
+- pkg/controller/route/routecontroller.go: one cloud route per node's
+  podCIDR; stale routes removed.
+- pkg/controller/volume/persistentvolume/pv_controller.go: bind pending
+  PVCs to the smallest matching available PV (capacity + access modes),
+  two-way binding annotations.
+- pkg/controller/volume/attachdetach/attach_detach_controller.go: desired
+  state = volumes of scheduled pods; attach missing, detach orphaned —
+  recorded per node (the reference mutates node.status.volumesAttached).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from kubernetes_tpu.api.types import VolumeKind
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.cloud import CloudProvider
+from kubernetes_tpu.cloud.provider import Route
+from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite, NotFound
+
+ATTACHED_ANNOTATION = "volumes.kubernetes.io/attached"
+# volume kinds that require attach before mount (the attachable plugins:
+# EBS/GCE-PD/AzureDisk/Cinder... — pkg/volume/*/attacher.go)
+ATTACHABLE = {VolumeKind.AWS_EBS, VolumeKind.GCE_PD, VolumeKind.AZURE_DISK}
+
+
+class ServiceLBController(Controller):
+    name = "service-controller"
+
+    def __init__(self, api: ApiServerLite, factory: SharedInformerFactory,
+                 cloud: CloudProvider, record_events: bool = True):
+        super().__init__(api, record_events=record_events)
+        self.cloud = cloud
+        self.node_informer = factory.informer("Node")
+        factory.informer("Service").add_event_handler(
+            on_add=lambda o: self.enqueue(o.key()),
+            on_update=lambda o, n: self.enqueue(n.key()),
+            on_delete=lambda o: self.enqueue(o.key()))
+        self.node_informer.add_event_handler(
+            on_add=lambda o: self._all_lbs(),
+            on_delete=lambda o: self._all_lbs())
+
+    def _all_lbs(self) -> None:
+        for svc in self.api.list("Service")[0]:
+            if svc.type == "LoadBalancer":
+                self.enqueue(svc.key())
+
+    def sync(self, key: str) -> None:
+        if not self.cloud.has_load_balancer():
+            return
+        namespace, name = key.split("/", 1)
+        try:
+            svc = self.api.get("Service", namespace, name)
+        except NotFound:
+            self.cloud.ensure_load_balancer_deleted(key)
+            return
+        if svc.type != "LoadBalancer":
+            if svc.load_balancer_ip:
+                self.cloud.ensure_load_balancer_deleted(key)
+                svc.load_balancer_ip = ""
+                self.api.update("Service", svc, expect_rv=svc.resource_version)
+            return
+        nodes = [n.name for n in self.node_informer.store.list()
+                 if n.is_ready() and not n.unschedulable]
+        status = self.cloud.ensure_load_balancer(key, nodes)
+        if svc.load_balancer_ip != status.ingress_ip:
+            svc.load_balancer_ip = status.ingress_ip
+            self.api.update("Service", svc, expect_rv=svc.resource_version)
+            self.event("Service", key, "Normal", "EnsuredLoadBalancer",
+                       f"Ensured load balancer {status.ingress_ip}")
+
+
+class RouteController(Controller):
+    name = "route-controller"
+
+    def __init__(self, api: ApiServerLite, factory: SharedInformerFactory,
+                 cloud: CloudProvider, record_events: bool = True):
+        super().__init__(api, record_events=record_events)
+        self.cloud = cloud
+        self.node_informer = factory.informer("Node")
+        self.node_informer.add_event_handler(
+            on_add=lambda o: self.enqueue("reconcile"),
+            on_update=lambda o, n: self.enqueue("reconcile"),
+            on_delete=lambda o: self.enqueue("reconcile"))
+
+    def sync(self, key: str) -> None:
+        if not self.cloud.has_routes():
+            return
+        want: Dict[str, Tuple[str, str]] = {}
+        for n in self.node_informer.store.list():
+            if n.pod_cidr:
+                want[n.name] = (n.name, n.pod_cidr)
+        have = {r.target_node: r for r in self.cloud.list_routes()}
+        for node_name, (target, cidr) in want.items():
+            cur = have.get(node_name)
+            if cur is None or cur.destination_cidr != cidr:
+                self.cloud.create_route(Route(node_name, target, cidr))
+        for node_name, r in have.items():
+            if node_name not in want:
+                self.cloud.delete_route(r.name)
+
+
+class PersistentVolumeBinder(Controller):
+    """Bind unbound PVCs to available PVs: smallest PV whose capacity covers
+    the claim (pv_controller.go findBestMatchForClaim ordering)."""
+
+    name = "persistentvolume-binder"
+
+    def __init__(self, api: ApiServerLite, factory: SharedInformerFactory,
+                 record_events: bool = True):
+        super().__init__(api, record_events=record_events)
+        factory.informer("PersistentVolumeClaim").add_event_handler(
+            on_add=lambda o: self.enqueue(o.namespace + "/" + o.name),
+            on_update=lambda o, n: self.enqueue(n.namespace + "/" + n.name))
+        factory.informer("PersistentVolume").add_event_handler(
+            on_add=lambda o: self._requeue_pending(),
+            on_update=lambda o, n: self._requeue_pending())
+
+    def _requeue_pending(self) -> None:
+        for pvc in self.api.list("PersistentVolumeClaim")[0]:
+            if not pvc.volume_name:
+                self.enqueue(pvc.namespace + "/" + pvc.name)
+
+    def sync(self, key: str) -> None:
+        namespace, name = key.split("/", 1)
+        try:
+            pvc = self.api.get("PersistentVolumeClaim", namespace, name)
+        except NotFound:
+            return
+        if pvc.volume_name:
+            return
+        bound: Set[str] = {c.volume_name
+                           for c in self.api.list("PersistentVolumeClaim")[0]
+                           if c.volume_name}
+        request = pvc.capacity
+        want_modes = set(pvc.access_modes)
+        candidates = []
+        for pv in self.api.list("PersistentVolume")[0]:
+            if pv.name in bound:
+                continue
+            # access modes: the PV must offer every mode the claim asks for
+            # (pv_controller checkAccessModes)
+            if want_modes and not want_modes.issubset(set(pv.access_modes)):
+                continue
+            if pv.capacity >= request:
+                candidates.append((pv.capacity, pv.name))
+        if not candidates:
+            return
+        candidates.sort()
+        pvc.volume_name = candidates[0][1]
+        self.api.update("PersistentVolumeClaim", pvc,
+                        expect_rv=pvc.resource_version)
+        self.event("PersistentVolumeClaim", key, "Normal", "Bound",
+                   f"bound to {pvc.volume_name}")
+
+
+class AttachDetachController(Controller):
+    """Reconcile attachable volumes to nodes hosting their pods; the
+    attachment record is a node annotation (the reference writes
+    node.status.volumesAttached)."""
+
+    name = "attachdetach-controller"
+
+    def __init__(self, api: ApiServerLite, factory: SharedInformerFactory,
+                 record_events: bool = True):
+        super().__init__(api, record_events=record_events)
+        self.pod_informer = factory.informer("Pod")
+        self.pod_informer.add_event_handler(
+            on_add=lambda o: o.node_name and self.enqueue(o.node_name),
+            on_update=lambda o, n: n.node_name and self.enqueue(n.node_name),
+            on_delete=lambda o: o.node_name and self.enqueue(o.node_name))
+
+    def sync(self, key: str) -> None:
+        try:
+            node = self.api.get("Node", "", key)
+        except NotFound:
+            return
+        want: Set[str] = set()
+        for p in self.pod_informer.store.list():
+            if p.node_name != key or p.deleted:
+                continue
+            for v in p.volumes:
+                if VolumeKind(v.kind) in ATTACHABLE and v.volume_id:
+                    want.add(str(VolumeKind(v.kind).value) + ":" + v.volume_id)
+        current = set(filter(None, node.annotations.get(
+            ATTACHED_ANNOTATION, "").split(",")))
+        if want != current:
+            node.annotations[ATTACHED_ANNOTATION] = ",".join(sorted(want))
+            self.api.update("Node", node, expect_rv=node.resource_version)

@@ -15,7 +15,22 @@ from typing import Dict, List, Optional
 
 from kubernetes_tpu.client.informer import SharedInformerFactory
 from kubernetes_tpu.client.leaderelection import LeaderElector, LeaseLock
+from kubernetes_tpu.controllers.autoscale import (
+    CronJobController,
+    DisruptionController,
+    HorizontalPodAutoscalerController,
+)
 from kubernetes_tpu.controllers.base import Controller
+from kubernetes_tpu.controllers.certificates import (
+    CSRApprovingController,
+    CSRSigningController,
+)
+from kubernetes_tpu.controllers.cloudctrl import (
+    AttachDetachController,
+    PersistentVolumeBinder,
+    RouteController,
+    ServiceLBController,
+)
 from kubernetes_tpu.controllers.daemonset import DaemonSetController
 from kubernetes_tpu.controllers.deployment import DeploymentController
 from kubernetes_tpu.controllers.endpoint import EndpointController
@@ -23,16 +38,33 @@ from kubernetes_tpu.controllers.gc import GarbageCollector, PodGCController
 from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.namespace import NamespaceController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.quota_sa import (
+    BootstrapSignerController,
+    ResourceQuotaController,
+    ServiceAccountController,
+    TokenCleanerController,
+    TTLController,
+)
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 from kubernetes_tpu.controllers.statefulset import StatefulSetController
 from kubernetes_tpu.server.apiserver_lite import ApiServerLite
 
 
 class ControllerManager:
+    """The initializer map of controllermanager.go:313-339, one entry per
+    reference controller (cloud-facing ones take the provider like
+    --cloud-provider)."""
+
     def __init__(self, api: ApiServerLite, record_events: bool = True,
-                 leader_elect: bool = False, identity: str = "cm-0"):
+                 leader_elect: bool = False, identity: str = "cm-0",
+                 cloud=None, token_issuer=None, ca=None):
+        from kubernetes_tpu.auth.authn import CertAuthenticator
+        from kubernetes_tpu.cloud import FakeCloud
+
         self.api = api
         self.factory = SharedInformerFactory(api)
+        self.cloud = cloud if cloud is not None else FakeCloud()
+        ca = ca if ca is not None else CertAuthenticator(b"cluster-ca-key")
         kw = dict(record_events=record_events)
         self.controllers: Dict[str, Controller] = {
             "replicaset": ReplicaSetController(api, self.factory, "ReplicaSet", **kw),
@@ -40,6 +72,7 @@ class ControllerManager:
                 api, self.factory, "ReplicationController", **kw),
             "deployment": DeploymentController(api, self.factory, **kw),
             "job": JobController(api, self.factory, **kw),
+            "cronjob": CronJobController(api, self.factory, **kw),
             "daemonset": DaemonSetController(api, self.factory, **kw),
             "statefulset": StatefulSetController(api, self.factory, **kw),
             "endpoint": EndpointController(api, self.factory, **kw),
@@ -47,6 +80,22 @@ class ControllerManager:
             "garbagecollector": GarbageCollector(api, self.factory),
             "podgc": PodGCController(api, self.factory),
             "nodelifecycle": NodeLifecycleController(api, self.factory, **kw),
+            "resourcequota": ResourceQuotaController(api, self.factory, **kw),
+            "serviceaccount": ServiceAccountController(
+                api, self.factory, token_issuer=token_issuer, **kw),
+            "ttl": TTLController(api, self.factory, **kw),
+            "bootstrapsigner": BootstrapSignerController(api, self.factory, **kw),
+            "tokencleaner": TokenCleanerController(api, self.factory, **kw),
+            "horizontalpodautoscaling": HorizontalPodAutoscalerController(
+                api, self.factory, **kw),
+            "disruption": DisruptionController(api, self.factory, **kw),
+            "service": ServiceLBController(api, self.factory, self.cloud, **kw),
+            "route": RouteController(api, self.factory, self.cloud, **kw),
+            "persistentvolume-binder": PersistentVolumeBinder(
+                api, self.factory, **kw),
+            "attachdetach": AttachDetachController(api, self.factory, **kw),
+            "csrapproving": CSRApprovingController(api, self.factory, **kw),
+            "csrsigning": CSRSigningController(api, self.factory, ca, **kw),
         }
         self.monitor_period = 5.0  # --node-monitor-period
         self.gc_resync_period = 60.0  # GC full-orphan-scan cadence
@@ -110,10 +159,13 @@ class ControllerManager:
             last_gc = time.monotonic()
             while not self._ticker_stop.wait(self.monitor_period):
                 guarded(self.controllers["nodelifecycle"].monitor_tick)
+                guarded(self.controllers["cronjob"].tick)
+                guarded(self.controllers["horizontalpodautoscaling"].resync_all)
                 if time.monotonic() - last_gc >= self.gc_resync_period:
                     last_gc = time.monotonic()
                     guarded(self.controllers["garbagecollector"].resync)
                     guarded(self.controllers["podgc"].resync)
+                    guarded(self.controllers["resourcequota"].resync_all)
 
         t = threading.Thread(target=tick_loop, daemon=True, name="cm-ticker")
         t.start()

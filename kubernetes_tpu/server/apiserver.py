@@ -88,6 +88,7 @@ KIND_INFO: Dict[str, Tuple[str, bool]] = {
     "ClusterRoleBinding": ("clusterrolebindings", True),
     "Event": ("events", False),
     "HorizontalPodAutoscaler": ("horizontalpodautoscalers", False),
+    "CertificateSigningRequest": ("certificatesigningrequests", True),
     "CustomResourceDefinition": ("customresourcedefinitions", True),
     "APIService": ("apiservices", True),
 }
@@ -229,9 +230,12 @@ class ApiServer:
         ns = getattr(obj, "namespace", "")
 
         def do(user: UserInfo) -> int:
-            self._validate(kind, obj, None)
+            # admission (mutating) precedes registry strategy validation,
+            # matching the chain order in the module doc — so defaults
+            # applied by plugins are themselves validated
             self.admission.admit(AdmissionRequest(
                 "CREATE", kind, ns, obj.name, obj=obj, user=user))
+            self._validate(kind, obj, None)
             return self.store.create(kind, obj)
 
         return self._run(cred, "create", kind, ns, obj.name, do)
@@ -241,9 +245,20 @@ class ApiServer:
         return self._run(cred, "get", kind, namespace, name,
                          lambda u: self.store.get(kind, namespace, name))
 
-    def list(self, kind: str, cred: Optional[Credential] = None):
-        return self._run(cred, "list", kind, "", "",
-                         lambda u: self.store.list(kind))
+    def list(self, kind: str, cred: Optional[Credential] = None,
+             namespace: str = ""):
+        """namespace="" = cluster-wide list (needs cluster-wide authority);
+        a namespace scopes both the RBAC check and the result set, like the
+        namespaced list endpoints."""
+
+        def do(user: UserInfo):
+            objs, rv = self.store.list(kind)
+            if namespace:
+                objs = [o for o in objs
+                        if getattr(o, "namespace", "") == namespace]
+            return objs, rv
+
+        return self._run(cred, "list", kind, namespace, "", do)
 
     def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None,
                cred: Optional[Credential] = None) -> int:
@@ -251,10 +266,10 @@ class ApiServer:
 
         def do(user: UserInfo) -> int:
             old = self._try_get(kind, ns, obj.name)
-            self._validate(kind, obj, old)
             self.admission.admit(AdmissionRequest(
                 "UPDATE", kind, ns, obj.name, obj=obj, old_obj=old,
                 user=user))
+            self._validate(kind, obj, old)
             return self.store.update(kind, obj, expect_rv=expect_rv)
 
         return self._run(cred, "update", kind, ns, obj.name, do)
@@ -296,10 +311,25 @@ class ApiServer:
                          binding.pod_name, do, subresource="binding")
 
     def bind_many(self, bindings, cred: Optional[Credential] = None):
-        if bindings:
-            self._run(cred, "create", "Pod", bindings[0].pod_namespace,
-                      bindings[0].pod_name, lambda u: None,
-                      subresource="binding")
+        """Batched bindings with per-binding authorization (one RBAC check
+        per distinct namespace — bindings in a namespace the caller cannot
+        create pods/binding in are rejected without touching the store) and
+        a single aggregated audit entry for the batch."""
+        if not bindings:
+            return []
+        user = self._authn(cred)
+        if self.auth_enabled:
+            try:
+                for ns in {b.pod_namespace for b in bindings}:
+                    self._authz(user, "create", "Pod", ns, "",
+                                subresource="binding")
+            except Forbidden:
+                self._audit(user, "create", "Pod",
+                            bindings[0].pod_namespace,
+                            f"<batch of {len(bindings)} bindings>", 403)
+                raise
+        self._audit(user, "create", "Pod", bindings[0].pod_namespace,
+                    f"<batch of {len(bindings)} bindings>", 200)
         return self.store.bind_many(bindings)
 
     def update_status(self, kind: str, obj: Any,

@@ -185,14 +185,16 @@ class RestServer:
                         reps = int(self._body().get("replicas", 0))
                         api.scale(kind, ns, name, replicas=reps, cred=cred)
                         return self._send(200, {"replicas": reps})
+                if sub:
+                    # unknown subresource, or a known one with the wrong
+                    # method — never fall through to the plain-object verbs
+                    # (DELETE .../eviction must not bypass PDB enforcement)
+                    raise NotFound(f"{method} {self.path}")
                 if method == "GET" and name:
                     obj = api.get(kind, ns, name, cred=cred)
                     return self._send(200, wire.encode(obj, kind=kind))
                 if method == "GET":
-                    objs, rv = api.list(kind, cred=cred)
-                    if ns:
-                        objs = [o for o in objs
-                                if getattr(o, "namespace", "") == ns]
+                    objs, rv = api.list(kind, cred=cred, namespace=ns)
                     sel = q.get("labelSelector", [""])[0]
                     if sel:
                         want = dict(kv.split("=", 1)
@@ -211,7 +213,9 @@ class RestServer:
                     return self._send(201, {"resourceVersion": rv})
                 if method == "PUT" and name:
                     obj = wire.decode_any(self._body(), kind=kind)
-                    rv = api.update(kind, obj, cred=cred)
+                    expect = q.get("resourceVersion", [None])[0]
+                    rv = api.update(kind, obj, cred=cred,
+                                    expect_rv=int(expect) if expect else None)
                     return self._send(200, {"resourceVersion": rv})
                 if method == "DELETE" and name:
                     api.delete(kind, ns, name, cred=cred)

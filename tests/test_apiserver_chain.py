@@ -292,3 +292,34 @@ def test_audit_log_records_denials():
     assert ev.user == "nobody" and ev.verb == "create" and ev.code == 403
     assert api.healthz() == {"status": "ok"}
     assert "admission" in api.configz()
+
+
+def test_namespaced_list_with_namespaced_rbac():
+    api = make_server(auth=True, tokens={
+        "dev": UserInfo("dev-user"),
+        "admin": UserInfo("root", groups=["system:masters"])})
+    api.store.create("Namespace", Namespace("team-a"))
+    api.store.create("Role", Role("reader", "team-a", rules=[
+        PolicyRule(verbs=["list", "get"], resources=["pods"])]))
+    api.store.create("RoleBinding", RoleBinding(
+        "readers", "team-a", subjects=[Subject("User", "dev-user")],
+        role_ref=RoleRef("Role", "reader")))
+    api.create("Pod", make_pod("p1", namespace="team-a"),
+               cred=Credential(token="admin"))
+    api.create("Pod", make_pod("p2"), cred=Credential(token="admin"))
+    objs, _ = api.list("Pod", cred=Credential(token="dev"),
+                       namespace="team-a")
+    assert [p.name for p in objs] == ["p1"]
+    with pytest.raises(Forbidden):  # cluster-wide list still forbidden
+        api.list("Pod", cred=Credential(token="dev"))
+
+
+def test_admission_defaults_are_validated():
+    api = make_server()
+    api.store.create("LimitRange", LimitRange("lims", "default", limits=[
+        LimitRangeItem(type="Container", default_request={"cpu": 500})]))
+    bad = make_pod("defaulted-over-limit")
+    bad.containers[0].requests.clear()
+    bad.containers[0].limits["cpu"] = 100  # default request 500 > limit 100
+    with pytest.raises(Invalid):
+        api.create("Pod", bad)

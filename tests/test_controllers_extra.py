@@ -1,0 +1,307 @@
+"""The second controller wave: quota/serviceaccount/ttl/bootstrap, HPA/PDB/
+cronjob, cloud-facing (service LB, routes, PV binder, attach/detach), CSR.
+
+Deterministic pump mode like tests/test_controllers.py; behavioral shape per
+the reference's per-controller unit tests (resource_quota_controller_test.go,
+serviceaccounts_controller_test.go, horizontal_test.go, disruption_test.go,
+cronjob_controller_test.go, servicecontroller_test.go,
+routecontroller_test.go, pv_controller_test.go, ttl_controller_test.go)."""
+
+import dataclasses
+
+from kubernetes_tpu.api.cluster import (
+    CertificateSigningRequest,
+    ResourceQuota,
+    Secret,
+)
+from kubernetes_tpu.api.types import (
+    LabelSelector,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Volume,
+    VolumeKind,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.api.workloads import (
+    CronJob,
+    HorizontalPodAutoscaler,
+    Job,
+    Namespace,
+    ReplicaSet,
+    Service,
+)
+from kubernetes_tpu.api.cluster import PodDisruptionBudget
+from kubernetes_tpu.auth.authn import CertAuthenticator, Credential
+from kubernetes_tpu.cloud import AWSLikeCloud, FakeCloud, GCELikeCloud, get_provider
+from kubernetes_tpu.controllers.autoscale import StaticMetricsClient, parse_schedule
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+Mi = 1024 * 1024
+
+
+def mk_manager(**kw):
+    api = ApiServerLite()
+    cm = ControllerManager(api, record_events=False, **kw)
+    return api, cm
+
+
+def mk_template(labels):
+    return dataclasses.replace(make_pod("", labels=dict(labels), cpu=100),
+                               name="")
+
+
+# -------------------------------------------------------------------- quota
+
+def test_resource_quota_recomputed_from_live_objects():
+    api, cm = mk_manager()
+    api.create("ResourceQuota", ResourceQuota(
+        "q", "default", hard={"pods": 10, "requests.cpu": 10_000}))
+    api.create("Pod", make_pod("a", cpu=300, memory=Mi))
+    api.create("Pod", make_pod("b", cpu=200, memory=Mi))
+    cm.pump_until_stable()
+    q = api.get("ResourceQuota", "default", "q")
+    assert q.used == {"pods": 2, "requests.cpu": 500}
+    api.delete("Pod", "default", "a")
+    cm.pump_until_stable()
+    q = api.get("ResourceQuota", "default", "q")
+    assert q.used == {"pods": 1, "requests.cpu": 200}
+
+
+# ----------------------------------------------------------- serviceaccount
+
+def test_default_service_account_and_token_created():
+    api, cm = mk_manager()
+    api.create("Namespace", Namespace("team-a"))
+    cm.pump_until_stable()
+    sa = api.get("ServiceAccount", "team-a", "default")
+    assert "default-token" in sa.secrets
+    secret = api.get("Secret", "team-a", "default-token")
+    assert secret.type == "kubernetes.io/service-account-token"
+    assert secret.data["token"]
+
+
+# ---------------------------------------------------------------------- ttl
+
+def test_ttl_annotation_follows_cluster_size():
+    api, cm = mk_manager()
+    for i in range(3):
+        api.create("Node", make_node(f"n{i}"))
+    cm.pump_until_stable()
+    n = api.get("Node", "", "n0")
+    assert n.annotations["node.alpha.kubernetes.io/ttl"] == "0"
+    ttl = cm.controllers["ttl"]
+    assert ttl.desired_ttl(400) == 15
+    assert ttl.desired_ttl(1500) == 60
+    assert ttl.desired_ttl(9999) == 300
+
+
+# ---------------------------------------------------------------- bootstrap
+
+def test_bootstrap_signer_and_token_cleaner():
+    clock = [100.0]
+    api = ApiServerLite()
+    cm = ControllerManager(api, record_events=False)
+    cm.controllers["tokencleaner"]._now = lambda: clock[0]
+    from kubernetes_tpu.api.cluster import ConfigMap
+
+    api.create("ConfigMap", ConfigMap("cluster-info", namespace="kube-public",
+                                      data={"kubeconfig": "clusters: []"}))
+    api.create("Secret", Secret(
+        "bootstrap-token-abc123", namespace="kube-system",
+        type="bootstrap.kubernetes.io/token",
+        data={"token-id": "abc123", "token-secret": "s3cret",
+              "expiration": "200"}))
+    cm.pump_until_stable()
+    cm.controllers["bootstrapsigner"].enqueue("sign")
+    cm.pump_until_stable()
+    cmap = api.get("ConfigMap", "kube-public", "cluster-info")
+    assert "jws-kubeconfig-abc123" in cmap.data
+    # expiry passes -> token cleaned
+    clock[0] = 300.0
+    cm.controllers["tokencleaner"].enqueue("kube-system/bootstrap-token-abc123")
+    cm.pump_until_stable()
+    assert all(s.name != "bootstrap-token-abc123"
+               for s in api.list("Secret")[0])
+
+
+# ---------------------------------------------------------------------- hpa
+
+def test_hpa_scales_on_cpu_utilization():
+    api, cm = mk_manager()
+    metrics = StaticMetricsClient()
+    cm.controllers["horizontalpodautoscaling"].metrics = metrics
+    api.create("ReplicaSet", ReplicaSet(
+        "web", replicas=2, selector=LabelSelector(match_labels={"app": "w"}),
+        template=mk_template({"app": "w"})))
+    cm.pump_until_stable()
+    metrics.default = 200  # 200m used vs 100m requested = 200%
+    api.create("HorizontalPodAutoscaler", HorizontalPodAutoscaler(
+        "web-hpa", target_kind="ReplicaSet", target_name="web",
+        min_replicas=1, max_replicas=10, target_cpu_utilization=100))
+    cm.pump_until_stable()
+    # scaled 2 -> 4 once; the upscale-forbidden window (horizontal.go)
+    # prevents re-scaling against not-yet-converged metrics
+    assert api.get("ReplicaSet", "default", "web").replicas == 4
+    hpa = api.get("HorizontalPodAutoscaler", "default", "web-hpa")
+    assert hpa.current_cpu_utilization == 200
+    # inside tolerance after the window -> no change either
+    hpa_ctrl = cm.controllers["horizontalpodautoscaling"]
+    hpa_ctrl._last_scale.clear()  # simulate the window elapsing
+    metrics.default = 105
+    hpa_ctrl.resync_all()
+    cm.pump_until_stable()
+    assert api.get("ReplicaSet", "default", "web").replicas == 4
+
+
+# --------------------------------------------------------------- disruption
+
+def test_disruption_controller_maintains_pdb_status():
+    api, cm = mk_manager()
+    for i in range(3):
+        p = make_pod(f"w{i}", labels={"app": "w"}, node_name="n1")
+        p.phase = "Running"
+        api.create("Pod", p)
+    api.create("PodDisruptionBudget", PodDisruptionBudget(
+        "pdb", min_available=2,
+        selector=LabelSelector(match_labels={"app": "w"})))
+    cm.pump_until_stable()
+    pdb = api.get("PodDisruptionBudget", "default", "pdb")
+    assert pdb.current_healthy == 3 and pdb.disruptions_allowed == 1
+    api.delete("Pod", "default", "w0")
+    cm.pump_until_stable()
+    pdb = api.get("PodDisruptionBudget", "default", "pdb")
+    assert pdb.current_healthy == 2 and pdb.disruptions_allowed == 0
+
+
+# ------------------------------------------------------------------ cronjob
+
+def test_parse_schedule_forms():
+    assert parse_schedule("@every 90s") == 90
+    assert parse_schedule("@every 5m") == 300
+    assert parse_schedule("*/10 * * * *") == 600
+    assert parse_schedule("0 3 * * *") == 86400
+
+
+def test_cronjob_spawns_and_respects_forbid():
+    clock = [1000.0]
+    api = ApiServerLite()
+    cm = ControllerManager(api, record_events=False)
+    cj_ctrl = cm.controllers["cronjob"]
+    cj_ctrl._now = lambda: clock[0]
+    api.create("CronJob", CronJob(
+        "tick", schedule="@every 60s", concurrency_policy="Forbid",
+        job_template=Job(name="", template=mk_template({"cron": "tick"}))))
+    cj_ctrl.tick()
+    cm.pump_until_stable()
+    jobs = [j for j in api.list("Job")[0]]
+    assert len(jobs) == 1
+    # next window with the first job still active + Forbid -> no new job
+    clock[0] += 61
+    cj_ctrl.tick()
+    cm.pump_until_stable()
+    assert len(api.list("Job")[0]) == 1
+    # job completes -> next window fires
+    j = api.list("Job")[0][0]
+    j.complete = True
+    api.update("Job", j)
+    clock[0] += 61
+    cj_ctrl.tick()
+    cm.pump_until_stable()
+    assert len(api.list("Job")[0]) == 2
+
+
+# ----------------------------------------------------------------- cloud LB
+
+def test_service_lb_lifecycle_and_providers():
+    api, cm = mk_manager()
+    cloud = cm.cloud
+    api.create("Node", make_node("n1"))
+    api.create("Service", Service("web", type="LoadBalancer",
+                                  selector={"app": "w"}))
+    cm.pump_until_stable()
+    svc = api.get("Service", "default", "web")
+    assert svc.load_balancer_ip.startswith("172.24.")
+    assert cloud.balancer_nodes["default/web"] == ["n1"]
+    api.delete("Service", "default", "web")
+    cm.pump_until_stable()
+    assert "default/web" not in cloud.balancers
+    # provider registry + provider-specific surface
+    assert isinstance(get_provider("gce-like"), GCELikeCloud)
+    aws = AWSLikeCloud()
+    st = aws.ensure_load_balancer("default/x", ["n1"])
+    assert "elb" in st.ingress_ip
+
+
+def test_route_controller_syncs_pod_cidrs():
+    api, cm = mk_manager()
+    n = make_node("n1")
+    n.pod_cidr = "10.244.1.0/24"
+    api.create("Node", n)
+    cm.pump_until_stable()
+    routes = cm.cloud.list_routes()
+    assert len(routes) == 1 and routes[0].destination_cidr == "10.244.1.0/24"
+    api.delete("Node", "", "n1")
+    cm.pump_until_stable()
+    assert cm.cloud.list_routes() == []
+
+
+# ---------------------------------------------------------------- pv binder
+
+def test_pv_binder_picks_smallest_fitting_volume():
+    api, cm = mk_manager()
+    api.create("PersistentVolume", PersistentVolume("big", capacity=100 * Mi))
+    api.create("PersistentVolume", PersistentVolume("small", capacity=10 * Mi))
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "claim", capacity=5 * Mi))
+    cm.pump_until_stable()
+    pvc = api.get("PersistentVolumeClaim", "default", "claim")
+    assert pvc.volume_name == "small"
+    # second claim too big for the remaining small slots -> big
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "claim2", capacity=50 * Mi))
+    cm.pump_until_stable()
+    assert api.get("PersistentVolumeClaim", "default",
+                   "claim2").volume_name == "big"
+
+
+def test_attach_detach_records_attachable_volumes():
+    api, cm = mk_manager()
+    api.create("Node", make_node("n1"))
+    pod = make_pod("db", node_name="n1", volumes=[
+        Volume(name="data", kind=VolumeKind.AWS_EBS, volume_id="vol-1")])
+    api.create("Pod", pod)
+    cm.pump_until_stable()
+    node = api.get("Node", "", "n1")
+    att = node.annotations["volumes.kubernetes.io/attached"]
+    assert "vol-1" in att
+    api.delete("Pod", "default", "db")
+    cm.pump_until_stable()
+    node = api.get("Node", "", "n1")
+    assert node.annotations["volumes.kubernetes.io/attached"] == ""
+
+
+# --------------------------------------------------------------------- csr
+
+def test_csr_auto_approved_and_signed_for_kubelet_bootstrap():
+    ca = CertAuthenticator(b"test-ca")
+    api = ApiServerLite()
+    cm = ControllerManager(api, record_events=False, ca=ca)
+    api.create("CertificateSigningRequest", CertificateSigningRequest(
+        "node-csr-1", requestor="system:bootstrap:abc123",
+        groups=["system:bootstrappers"], cn="system:node:n1",
+        orgs=["system:nodes"]))
+    cm.pump_until_stable()
+    csr = api.get("CertificateSigningRequest", "", "node-csr-1")
+    assert csr.approved and csr.certificate is not None
+    # the issued record authenticates as the node identity
+    user = ca.authenticate(Credential(cert=csr.certificate))
+    assert user.name == "system:node:n1" and "system:nodes" in user.groups
+    # a CSR for someone else's identity is NOT auto-approved
+    api.create("CertificateSigningRequest", CertificateSigningRequest(
+        "evil", requestor="system:bootstrap:abc123",
+        groups=["system:bootstrappers"], cn="system:admin",
+        orgs=["system:masters"]))
+    cm.pump_until_stable()
+    assert not api.get("CertificateSigningRequest", "", "evil").approved

@@ -171,3 +171,47 @@ def test_ktctl_scale_top_api_resources():
     out.truncate(0), out.seek(0)
     cli.run(["api-resources"])
     assert "pods" in out.getvalue() and "nodes" in out.getvalue()
+
+
+def test_rest_subresource_wrong_method_does_not_fall_through(rest):
+    api, client = rest
+    from kubernetes_tpu.api.cluster import PodDisruptionBudget
+    from kubernetes_tpu.api.types import LabelSelector
+    client.create("Pod", make_pod("guarded", labels={"app": "g"}))
+    api.store.create("PodDisruptionBudget", PodDisruptionBudget(
+        "pdb", "default", min_available=1,
+        selector=LabelSelector(match_labels={"app": "g"}),
+        disruptions_allowed=0))
+    import urllib.request
+    req = urllib.request.Request(
+        client.base + "/api/v1/namespaces/default/pods/guarded/eviction",
+        method="DELETE")
+    import urllib.error
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("DELETE on eviction subresource succeeded")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    assert api.store.get("Pod", "default", "guarded")  # still there
+
+
+def test_rest_update_cas_precondition(rest):
+    api, client = rest
+    client.create("Pod", make_pod("p", labels={"v": "1"}))
+    cur = client.get("Pod", "default", "p")
+    stale_rv = cur.resource_version
+    cur.labels["v"] = "2"
+    client.update("Pod", cur)  # bumps rv server-side
+    cur.labels["v"] = "3"
+    import pytest as _pytest
+    from kubernetes_tpu.server.apiserver_lite import Conflict
+    with _pytest.raises(Conflict):
+        client.update("Pod", cur, expect_rv=stale_rv)
+
+
+def test_ktctl_bool_flag_then_output_flag():
+    api, cli, out = make_cli()
+    api.create("Pod", make_pod("a", cpu=10, memory=1 << 20))
+    assert cli.run(["get", "pods", "--all-namespaces", "-o", "json"]) == 0
+    data = json.loads(out.getvalue())
+    assert data[0]["name"] == "a"
