@@ -35,6 +35,9 @@ class AdmissionRequest:
     old_obj: object = None
     user: Optional[UserInfo] = None
     subresource: str = ""
+    # side effects plugins committed during admit (e.g. quota usage CAS) as
+    # undo callables, run by rollback() if the request fails downstream
+    undo: List = field(default_factory=list)
 
 
 class AdmissionChain:
@@ -47,7 +50,17 @@ class AdmissionChain:
     def admit(self, req: AdmissionRequest) -> None:
         for p in self.plugins:
             if p.handles(req):
-                p.admit(req)
+                try:
+                    p.admit(req)
+                except Exception:
+                    self.rollback(req)
+                    raise
+
+    def rollback(self, req: AdmissionRequest) -> None:
+        """Undo plugin side effects after a downstream failure (registry
+        validation / storage), newest first."""
+        while req.undo:
+            req.undo.pop()()
 
 
 def default_plugins():

@@ -7,6 +7,7 @@ use informers/listers — same data, same freshness model in-process).
 
 from __future__ import annotations
 
+import copy
 from typing import Dict, Optional
 
 from kubernetes_tpu.admission.chain import (
@@ -252,7 +253,11 @@ class DefaultTolerationSeconds:
 
 class NodeRestriction:
     """plugin/pkg/admission/noderestriction: a kubelet may only modify its
-    own Node object and pods bound to it."""
+    own Node object and pods bound to it, and may only CREATE mirror-style
+    pods bound to itself that reference no secrets/configmaps/PVCs — else a
+    compromised kubelet could mint a pod referencing any secret and ride
+    the node authorizer's reachability grant to read it
+    (admission.go:112-141 in the reference)."""
 
     def handles(self, req: AdmissionRequest) -> bool:
         return req.user is not None \
@@ -260,11 +265,24 @@ class NodeRestriction:
             and req.kind in ("Node", "Pod")
 
     def admit(self, req: AdmissionRequest) -> None:
+        from kubernetes_tpu.api.types import VolumeKind
+
         node_name = req.user.name[len("system:node:"):]
         if req.kind == "Node":
             if req.operation in (UPDATE, DELETE) and req.name != node_name:
                 raise Rejected(
                     f"node {node_name} cannot modify node {req.name}")
+        elif req.kind == "Pod" and req.operation == CREATE:
+            pod = req.obj
+            if getattr(pod, "node_name", "") != node_name:
+                raise Rejected(
+                    f"node {node_name} can only create pods bound to itself")
+            for vol in getattr(pod, "volumes", None) or []:
+                if vol.kind in (VolumeKind.SECRET, VolumeKind.CONFIG_MAP,
+                                VolumeKind.PVC):
+                    raise Rejected(
+                        f"node {node_name} cannot create pods that reference "
+                        f"{vol.kind.value} volumes")
         elif req.kind == "Pod" and req.operation in (UPDATE, DELETE):
             pod = req.old_obj or req.obj
             if pod is not None and getattr(pod, "node_name", "") \
@@ -318,8 +336,13 @@ class StorageClassDefault(_StorePlugin):
 class ResourceQuotaPlugin(_StorePlugin):
     """plugin/pkg/admission/resourcequota: on CREATE, check the delta
     against every matching quota's hard limits and commit the new usage
-    atomically (the reference does a quota CAS loop through the apiserver;
-    in-process the store lock gives the same atomicity)."""
+    through the apiserver's guarded update (the reference's quota CAS loop —
+    resource_access.go UpdateQuotaStatus), so a watch event + rv bump is
+    emitted for every usage change. Committed increments are recorded on the
+    request (req.undo) and rolled back by the chain if registry validation
+    or the store create fails afterwards — no leaked usage until resync."""
+
+    _CAS_RETRIES = 5
 
     def handles(self, req: AdmissionRequest) -> bool:
         return req.operation == CREATE and req.kind in (
@@ -332,21 +355,59 @@ class ResourceQuotaPlugin(_StorePlugin):
         delta = usage_for(req.kind, req.obj)
         if not delta:
             return
-        quotas = [q for q in self.store.list("ResourceQuota")[0]
-                  if q.namespace == req.namespace
-                  and quota_scopes_match(q.scopes, req.kind, req.obj)]
-        for q in quotas:
-            constrained = [k for k in delta if k in q.hard]
-            if not constrained:
+        from kubernetes_tpu.server.apiserver_lite import Conflict
+        for _ in range(self._CAS_RETRIES):
+            quotas = [q for q in self.store.list("ResourceQuota")[0]
+                      if q.namespace == req.namespace
+                      and quota_scopes_match(q.scopes, req.kind, req.obj)]
+            affected = []
+            for q in quotas:
+                constrained = [k for k in delta if k in q.hard]
+                if not constrained:
+                    continue
+                over = exceeds(q.hard, q.used, delta)
+                if over:
+                    raise Rejected(
+                        f"exceeded quota: {q.name}, requested: "
+                        + ",".join(f"{k}={delta[k]}" for k in over)
+                        + ", limited: "
+                        + ",".join(f"{k}={q.hard[k]}" for k in over))
+                affected.append(q)
+            try:
+                for q in affected:
+                    nq = copy.deepcopy(q)
+                    for k, v in delta.items():
+                        if k in nq.hard:
+                            nq.used[k] = nq.used.get(k, 0) + v
+                    self.store.update("ResourceQuota", nq,
+                                      expect_rv=q.resource_version)
+                    req.undo.append(
+                        lambda name=q.name, d=dict(delta):
+                        self._decrement(name, req.namespace, d))
+                return
+            except Conflict:
+                # another writer moved a quota between list and update:
+                # roll back what this attempt committed and re-check
+                while req.undo:
+                    req.undo.pop()()
                 continue
-            over = exceeds(q.hard, q.used, delta)
-            if over:
-                raise Rejected(
-                    f"exceeded quota: {q.name}, requested: "
-                    + ",".join(f"{k}={delta[k]}" for k in over)
-                    + ", limited: "
-                    + ",".join(f"{k}={q.hard[k]}" for k in over))
-        for q in quotas:
+        raise Rejected("quota update conflict: too many retries")
+
+    def _decrement(self, name: str, namespace: str,
+                   delta: Dict[str, int]) -> None:
+        from kubernetes_tpu.server.apiserver_lite import Conflict, NotFound
+        for _ in range(self._CAS_RETRIES):
+            try:
+                cur = self.store.get("ResourceQuota", namespace, name)
+            except NotFound:
+                return
+            nq = copy.deepcopy(cur)
             for k, v in delta.items():
-                if k in q.hard:
-                    q.used[k] = q.used.get(k, 0) + v
+                if k in nq.used:
+                    nq.used[k] = max(0, nq.used[k] - v)
+            try:
+                self.store.update("ResourceQuota", nq,
+                                  expect_rv=cur.resource_version)
+                return
+            except Conflict:
+                continue

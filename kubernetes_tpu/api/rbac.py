@@ -163,9 +163,13 @@ def bootstrap_cluster_roles() -> List[ClusterRole]:
         ]),
         ClusterRole("system:node", rules=[
             rule(verbs=["get", "list", "watch"], api_groups=[""],
-                 resources=["pods", "services", "endpoints", "nodes",
-                            "configmaps", "secrets",
-                            "persistentvolumeclaims", "persistentvolumes"]),
+                 resources=["pods", "services", "endpoints", "nodes"]),
+            # secrets/configmaps/PV/PVC are deliberately ABSENT: access is
+            # granted per-object by the NodeAuthorizer's reachability check
+            # (get of objects referenced by pods bound to the node) — an
+            # RBAC grant here would bypass that scoping via union semantics
+            # (the reference drops these from the role when Node
+            # authorization is enabled)
             rule(verbs=["create", "update", "patch", "delete"],
                  api_groups=[""],
                  resources=["nodes", "nodes/status", "pods", "pods/status",

@@ -205,6 +205,14 @@ def decode_volume(v: Dict[str, Any]) -> Volume:
         return Volume(name=name, kind=VolumeKind.PVC,
                       volume_id=s.get("claimName", ""),
                       read_only=bool(s.get("readOnly", False)))
+    if "secret" in v:
+        s = v["secret"] or {}
+        return Volume(name=name, kind=VolumeKind.SECRET,
+                      volume_id=s.get("secretName", ""))
+    if "configMap" in v:
+        s = v["configMap"] or {}
+        return Volume(name=name, kind=VolumeKind.CONFIG_MAP,
+                      volume_id=s.get("name", ""))
     return Volume(name=name, kind=VolumeKind.OTHER)
 
 
@@ -228,6 +236,10 @@ def encode_volume(v: Volume) -> Dict[str, Any]:
     elif kind == VolumeKind.PVC:
         out["persistentVolumeClaim"] = {"claimName": v.volume_id,
                                         "readOnly": v.read_only}
+    elif kind == VolumeKind.SECRET:
+        out["secret"] = {"secretName": v.volume_id}
+    elif kind == VolumeKind.CONFIG_MAP:
+        out["configMap"] = {"name": v.volume_id}
     return out
 
 

@@ -268,6 +268,11 @@ class VolumeKind(str, enum.Enum):
     ISCSI = "ISCSI"
     AZURE_DISK = "AzureDisk"
     PVC = "PersistentVolumeClaim"
+    # scheduling-inert but authz-relevant: the node authorizer only grants a
+    # kubelet access to secrets/configmaps referenced by pods bound to it
+    # (plugin/pkg/auth/authorizer/node/node_authorizer.go)
+    SECRET = "Secret"
+    CONFIG_MAP = "ConfigMap"
     OTHER = "Other"
 
 

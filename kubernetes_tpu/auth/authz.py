@@ -122,13 +122,22 @@ class RBACAuthorizer:
 
 class NodeAuthorizer:
     """Kubelet identity system:node:<name> limited to its own objects
-    (node_authorizer.go): its Node + status, pods bound to it, and the
-    secrets/configmaps/volumes those pods reference (here: PV/PVC reads)."""
+    (plugin/pkg/auth/authorizer/node/node_authorizer.go): its Node + status,
+    pods bound to it, and — for secrets/configmaps/PVCs/PVs — only GET of a
+    NAMED object reachable from a pod bound to this node (the reference walks
+    its graph of pod->secret/configmap/pvc->pv edges; here we walk the
+    store's pod objects directly). Everything out of scope is NO_OPINION, not
+    DENY, so a node identity that also holds other role bindings still gets
+    RBAC's verdict (union semantics, node_authorizer.go:77-103)."""
 
     READ_VERBS = ("get", "list", "watch")
 
     def __init__(self, store):
         self._store = store
+        # per-node reference-set cache keyed by store rv — the poor man's
+        # node/graph.go: rebuilt only when the store has moved, so repeated
+        # secret gets by the same kubelet don't rescan the pod table
+        self._ref_cache: Dict[str, Tuple[int, set]] = {}
 
     def authorize(self, attrs: Attributes) -> str:
         user = attrs.user
@@ -140,22 +149,74 @@ class NodeAuthorizer:
         if res in ("nodes", "nodes/status"):
             if attrs.name in ("", node_name):
                 return ALLOW
-            return DENY  # another node's object
+            return NO_OPINION  # another node's object: leave it to RBAC
         if res in ("pods", "pods/status"):
-            if attrs.verb in self.READ_VERBS or not attrs.name:
+            if attrs.verb in self.READ_VERBS:
                 return ALLOW
+            if not attrs.name:
+                return NO_OPINION  # writes need a named pod
             pod = self._get("Pod", attrs.namespace, attrs.name)
             if pod is not None and getattr(pod, "node_name", "") == node_name:
                 return ALLOW
-            return DENY
-        if res in ("services", "endpoints", "persistentvolumes",
-                   "persistentvolumeclaims", "configmaps", "secrets"):
+            return NO_OPINION
+        if res in ("secrets", "configmaps",
+                   "persistentvolumeclaims", "persistentvolumes"):
+            # only get-by-name, and only when a pod bound to this node
+            # references the object (node_authorizer.go authorizeGet)
+            if attrs.verb != "get" or not attrs.name:
+                return NO_OPINION
+            if self._reachable(res, attrs.namespace, attrs.name, node_name):
+                return ALLOW
+            return NO_OPINION
+        if res in ("services", "endpoints"):
             if attrs.verb in self.READ_VERBS:
                 return ALLOW
-            return DENY
+            return NO_OPINION
         if res == "events":
-            return ALLOW
+            if attrs.verb in ("create", "update", "patch"):
+                return ALLOW
+            return NO_OPINION
         return NO_OPINION
+
+    def _reachable(self, res: str, ns: str, name: str, node: str) -> bool:
+        """Is the named object referenced by any pod bound to `node`?
+        (the graph edges of node/graph.go, walked into a cached per-node
+        reference set, invalidated whenever the store rv moves)"""
+        return (res, ns, name) in self._refs(node)
+
+    def _refs(self, node: str) -> set:
+        from kubernetes_tpu.api.types import VolumeKind
+        try:
+            pods, rv = self._store.list("Pod")
+        except Exception:
+            return set()
+        cached = self._ref_cache.get(node)
+        if cached is not None and cached[0] == rv:
+            return cached[1]
+        kind_res = {VolumeKind.SECRET: "secrets",
+                    VolumeKind.CONFIG_MAP: "configmaps",
+                    VolumeKind.PVC: "persistentvolumeclaims"}
+        refs: set = set()
+        for pod in pods:
+            if getattr(pod, "node_name", "") != node:
+                continue
+            pod_ns = getattr(pod, "namespace", "")
+            for vol in getattr(pod, "volumes", None) or []:
+                res = kind_res.get(vol.kind)
+                if res is None:
+                    continue
+                refs.add((res, pod_ns, vol.volume_id))
+                if vol.kind == VolumeKind.PVC:
+                    pvc = self._get("PersistentVolumeClaim", pod_ns,
+                                    vol.volume_id)
+                    if pvc is not None and getattr(pvc, "volume_name", ""):
+                        refs.add(("persistentvolumes", "", pvc.volume_name))
+        # prune entries from older store revisions so the cache tracks only
+        # the live rv (bounded by the node count)
+        self._ref_cache = {n: v for n, v in self._ref_cache.items()
+                           if v[0] == rv}
+        self._ref_cache[node] = (rv, refs)
+        return refs
 
     def _get(self, kind, ns, name):
         try:

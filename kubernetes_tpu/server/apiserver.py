@@ -230,13 +230,28 @@ class ApiServer:
         ns = getattr(obj, "namespace", "")
 
         def do(user: UserInfo) -> int:
+            if self.auth_enabled and kind == "CertificateSigningRequest":
+                # registry strategy PrepareForCreate: requestor identity is
+                # stamped from the authenticated user, never client-supplied
+                # (pkg/registry/certificates/certificates/strategy.go) —
+                # else any CSR creator could claim system:bootstrappers and
+                # mint auto-approved node certs
+                obj.requestor = user.name
+                obj.groups = list(user.groups)
             # admission (mutating) precedes registry strategy validation,
             # matching the chain order in the module doc — so defaults
             # applied by plugins are themselves validated
-            self.admission.admit(AdmissionRequest(
-                "CREATE", kind, ns, obj.name, obj=obj, user=user))
-            self._validate(kind, obj, None)
-            return self.store.create(kind, obj)
+            req = AdmissionRequest(
+                "CREATE", kind, ns, obj.name, obj=obj, user=user)
+            self.admission.admit(req)
+            try:
+                self._validate(kind, obj, None)
+                return self.store.create(kind, obj)
+            except Exception:
+                # undo admission side effects (quota usage CAS) so a failed
+                # create doesn't leak usage until the controller resync
+                self.admission.rollback(req)
+                raise
 
         return self._run(cred, "create", kind, ns, obj.name, do)
 
@@ -266,6 +281,23 @@ class ApiServer:
 
         def do(user: UserInfo) -> int:
             old = self._try_get(kind, ns, obj.name)
+            if kind == "CertificateSigningRequest" and old is not None:
+                # ValidateUpdate (certificates/strategy.go): the request
+                # identity and spec are immutable after create — else an
+                # updater could restore groups=[system:bootstrappers] and
+                # re-open the escalation the create-time stamp closed
+                if obj.requestor != old.requestor \
+                        or list(obj.groups) != list(old.groups) \
+                        or obj.cn != old.cn or list(obj.orgs) != list(old.orgs):
+                    raise Invalid(
+                        "CertificateSigningRequest spec and requestor "
+                        "identity are immutable after creation")
+                if self.auth_enabled and (obj.approved != old.approved
+                                          or obj.denied != old.denied):
+                    # approval flips require the approval subresource
+                    # permission (certificates/approval gating)
+                    self._authz(user, "update", kind, ns, obj.name,
+                                subresource="approval")
             self.admission.admit(AdmissionRequest(
                 "UPDATE", kind, ns, obj.name, obj=obj, old_obj=old,
                 user=user))
@@ -335,28 +367,57 @@ class ApiServer:
     def update_status(self, kind: str, obj: Any,
                       cred: Optional[Credential] = None) -> int:
         ns = getattr(obj, "namespace", "")
-        return self._run(
-            cred, "update", kind, ns, obj.name,
-            lambda u: self.store.update(kind, obj), subresource="status")
+
+        def do(user: UserInfo) -> int:
+            # status writes run the admission chain too (the reference's
+            # subresource REST goes through the same handler chain) — this
+            # is what lets NodeRestriction block cross-node pod status writes
+            old = self._try_get(kind, ns, obj.name)
+            self.admission.admit(AdmissionRequest(
+                "UPDATE", kind, ns, obj.name, obj=obj, old_obj=old,
+                user=user, subresource="status"))
+            return self.store.update(kind, obj)
+
+        return self._run(cred, "update", kind, ns, obj.name, do,
+                         subresource="status")
 
     def evict(self, ev: Eviction, cred: Optional[Credential] = None) -> None:
         """pods/eviction (eviction.go): honor PodDisruptionBudgets — refuse
         with 429 when disruptions_allowed is exhausted."""
 
         def do(user: UserInfo) -> None:
-            pod = self.store.get("Pod", ev.namespace, ev.pod_name)
-            for pdb in self.store.list("PodDisruptionBudget")[0]:
-                if pdb.namespace != ev.namespace or pdb.selector is None:
-                    continue
-                if not pods_matching(pdb, [pod]):
-                    continue
-                if pdb.disruptions_allowed <= 0:
-                    raise TooManyRequests(
-                        f"Cannot evict pod as it would violate the pod's "
-                        f"disruption budget {pdb.name}")
-                pdb.disruptions_allowed -= 1
-                self.store.update("PodDisruptionBudget", pdb)
-            self.store.delete("Pod", ev.namespace, ev.pod_name)
+            import copy as _copy
+            for _ in range(10):  # CAS retry (eviction.go retries on Conflict)
+                pod = self.store.get("Pod", ev.namespace, ev.pod_name)
+                matching = [
+                    pdb for pdb in self.store.list("PodDisruptionBudget")[0]
+                    if pdb.namespace == ev.namespace
+                    and pdb.selector is not None
+                    and pods_matching(pdb, [pod])]
+                if len(matching) > 1:
+                    # eviction.go: "only one PodDisruptionBudget is allowed"
+                    raise Invalid(
+                        "This pod has more than one PodDisruptionBudget, "
+                        "which the Eviction subresource does not support")
+                if matching:
+                    pdb = matching[0]
+                    if pdb.disruptions_allowed <= 0:
+                        raise TooManyRequests(
+                            f"Cannot evict pod as it would violate the pod's "
+                            f"disruption budget {pdb.name}")
+                    npdb = _copy.deepcopy(pdb)
+                    npdb.disruptions_allowed -= 1
+                    try:
+                        # guarded status update so concurrent evictions
+                        # cannot overspend the budget (eviction.go
+                        # checkAndDecrement via UpdateStatus + rv)
+                        self.store.update("PodDisruptionBudget", npdb,
+                                          expect_rv=pdb.resource_version)
+                    except Conflict:
+                        continue
+                self.store.delete("Pod", ev.namespace, ev.pod_name)
+                return
+            raise Conflict("eviction: PodDisruptionBudget update conflicts")
 
         return self._run(cred, "create", "Pod", ev.namespace, ev.pod_name,
                          do, subresource="eviction")

@@ -8,3 +8,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize may have already registered a TPU PJRT plugin and
+# prepended its platform to jax_platforms (overriding the env var). Backends
+# are not initialized yet at conftest-import time, so force the config back.
+import jax
+
+if jax.config.jax_platforms != "cpu":
+    jax.config.update("jax_platforms", "cpu")

@@ -139,7 +139,7 @@ def test_node_authorizer_scopes_to_own_node():
     api.store.create("Node", make_node("n2"))
     n1 = api.get("Node", "", "n1", cred=kubelet)
     api.update("Node", n1, cred=kubelet)
-    with pytest.raises(Forbidden):
+    with pytest.raises(Rejected):
         n2 = api.store.get("Node", "", "n2")
         api.update("Node", n2, cred=kubelet)
     # pod bound to n1 is updatable; pod bound to n2 is not
@@ -147,7 +147,9 @@ def test_node_authorizer_scopes_to_own_node():
     api.store.create("Pod", make_pod("theirs", node_name="n2"))
     p = api.get("Pod", "default", "mine", cred=kubelet)
     api.update_status("Pod", p, cred=kubelet)
-    with pytest.raises(Forbidden):
+    # cross-node pod writes are blocked by NodeRestriction admission (the
+    # node authorizer only has NO_OPINION there, like the reference)
+    with pytest.raises(Rejected):
         q = api.store.get("Pod", "default", "theirs")
         api.update_status("Pod", q, cred=kubelet)
 
@@ -323,3 +325,148 @@ def test_admission_defaults_are_validated():
     bad.containers[0].limits["cpu"] = 100  # default request 500 > limit 100
     with pytest.raises(Invalid):
         api.create("Pod", bad)
+
+
+# ---------------------------------------------- round-2 security hardening
+
+def test_node_authorizer_secret_reachability():
+    """node_authorizer.go: a kubelet may only GET a named secret/configmap
+    referenced by a pod bound to it — never list/watch, never other nodes'
+    secrets (ADVICE r1: list-all-secrets broke node isolation)."""
+    from kubernetes_tpu.api.cluster import Secret
+    from kubernetes_tpu.api.types import Volume, VolumeKind
+
+    api = make_server(auth=True)
+    ca = CertAuthenticator(b"ca-key")
+    kubelet = Credential(cert=ca.sign("system:node:n1", ["system:nodes"]))
+    api.store.create("Node", make_node("n1"))
+    api.store.create("Secret", Secret("mine"))
+    api.store.create("Secret", Secret("not-mine"))
+    api.store.create("Pod", make_pod(
+        "p", node_name="n1",
+        volumes=[Volume(name="v", kind=VolumeKind.SECRET, volume_id="mine")]))
+    # referenced by a pod on n1 -> get allowed
+    assert api.get("Secret", "default", "mine", cred=kubelet).name == "mine"
+    # unreferenced secret -> forbidden
+    with pytest.raises(Forbidden):
+        api.get("Secret", "default", "not-mine", cred=kubelet)
+    # list/watch of all secrets -> forbidden (bootstrap role grants get only)
+    with pytest.raises(Forbidden):
+        api.list("Secret", cred=kubelet)
+
+
+def test_csr_requestor_stamped_from_authenticated_user():
+    """ADVICE r1: client-supplied requestor/groups must be overwritten from
+    the authenticated identity (strategy.PrepareForCreate), else any CSR
+    creator escalates to an auto-approved node cert."""
+    from kubernetes_tpu.api.cluster import CertificateSigningRequest
+
+    api = make_server(auth=True,
+                      tokens={"evil": UserInfo("mallory", groups=["devs"])})
+    api.store.create("Role", Role(
+        "csr-creator", "", rules=[PolicyRule(
+            verbs=["create"], api_groups=["*"],
+            resources=["certificatesigningrequests"])]))
+    # cluster-scoped resource: bind via ClusterRoleBinding-equivalent rule
+    from kubernetes_tpu.api.rbac import ClusterRole, ClusterRoleBinding
+    api.store.create("ClusterRole", ClusterRole(
+        "csr-creator", rules=[PolicyRule(
+            verbs=["create"], api_groups=["*"],
+            resources=["certificatesigningrequests"])]))
+    api.store.create("ClusterRoleBinding", ClusterRoleBinding(
+        "mallory-csr", subjects=[Subject("User", "mallory")],
+        role_ref=RoleRef("ClusterRole", "csr-creator")))
+    api.create("CertificateSigningRequest", CertificateSigningRequest(
+        "sneaky", requestor="system:bootstrap:abc",
+        groups=["system:bootstrappers"], cn="system:node:n1",
+        orgs=["system:nodes"]), cred=Credential(token="evil"))
+    csr = api.store.get("CertificateSigningRequest", "", "sneaky")
+    assert csr.requestor == "mallory"
+    assert "devs" in csr.groups
+    assert "system:bootstrappers" not in csr.groups  # escalation stamped out
+
+
+def test_quota_usage_rolled_back_on_failed_create():
+    """ADVICE r1: usage committed at admission must be rolled back when the
+    create fails downstream — and every change flows through store.update
+    (watch event + rv bump), never in-place mutation."""
+    api = make_server()
+    api.store.create("ResourceQuota", ResourceQuota(
+        "q", "default", hard={"pods": 5}))
+    api.create("Pod", make_pod("dup"))
+    q1 = api.store.get("ResourceQuota", "default", "q")
+    assert q1.used["pods"] == 1
+    rv1 = q1.resource_version
+    # duplicate name -> store.create raises after admission committed usage
+    with pytest.raises(Exception):
+        api.create("Pod", make_pod("dup"))
+    q2 = api.store.get("ResourceQuota", "default", "q")
+    assert q2.used["pods"] == 1  # rolled back
+    assert q2.resource_version > rv1  # through guarded updates, not in-place
+
+
+def test_eviction_rejects_multiple_pdbs():
+    """eviction.go: more than one matching PDB is an error, not a multi-
+    decrement."""
+    api = make_server()
+    api.store.create("Pod", make_pod("web", labels={"app": "web"}))
+    for i in range(2):
+        api.store.create("PodDisruptionBudget", PodDisruptionBudget(
+            f"pdb{i}", "default",
+            selector=LabelSelector(match_labels={"app": "web"}),
+            disruptions_allowed=1))
+    with pytest.raises(Invalid):
+        api.evict(Eviction("web", "default"))
+
+
+def test_node_cannot_self_grant_secret_via_pod_create():
+    """code-review r2: NodeRestriction must reject node-created pods that
+    reference secrets/configmaps/PVCs (admission.go mirror-pod rules) —
+    else a kubelet mints a pod referencing any secret and rides the
+    reachability grant."""
+    from kubernetes_tpu.api.cluster import Secret
+    from kubernetes_tpu.api.types import Volume, VolumeKind
+
+    api = make_server(auth=True)
+    ca = CertAuthenticator(b"ca-key")
+    kubelet = Credential(cert=ca.sign("system:node:n1", ["system:nodes"]))
+    api.store.create("Node", make_node("n1"))
+    api.store.create("Secret", Secret("loot"))
+    with pytest.raises(Rejected):
+        api.create("Pod", make_pod(
+            "steal", node_name="n1",
+            volumes=[Volume("v", VolumeKind.SECRET, "loot")]), cred=kubelet)
+    with pytest.raises(Rejected):  # pods bound elsewhere can't be created
+        api.create("Pod", make_pod("other", node_name="n2"), cred=kubelet)
+    # a plain mirror-style pod bound to itself is fine
+    api.create("Pod", make_pod("static", node_name="n1"), cred=kubelet)
+
+
+def test_csr_identity_immutable_after_create():
+    """code-review r2: requestor/groups/cn/orgs frozen at create; approval
+    flips need the approval subresource permission."""
+    from kubernetes_tpu.api.cluster import CertificateSigningRequest
+    from kubernetes_tpu.api.rbac import ClusterRole, ClusterRoleBinding
+
+    api = make_server(auth=True,
+                      tokens={"u": UserInfo("mallory", groups=["devs"])})
+    api.store.create("ClusterRole", ClusterRole(
+        "csr-rw", rules=[PolicyRule(
+            verbs=["create", "update", "get"], api_groups=["*"],
+            resources=["certificatesigningrequests"])]))
+    api.store.create("ClusterRoleBinding", ClusterRoleBinding(
+        "b", subjects=[Subject("User", "mallory")],
+        role_ref=RoleRef("ClusterRole", "csr-rw")))
+    cred = Credential(token="u")
+    api.create("CertificateSigningRequest", CertificateSigningRequest(
+        "c1", cn="system:node:nX", orgs=["system:nodes"]), cred=cred)
+    csr = api.store.get("CertificateSigningRequest", "", "c1")
+    import copy
+    evil = copy.deepcopy(csr)
+    evil.groups = ["system:bootstrappers"]
+    with pytest.raises(Invalid):
+        api.update("CertificateSigningRequest", evil, cred=cred)
+    flip = copy.deepcopy(csr)
+    flip.approved = True
+    with pytest.raises(Forbidden):  # no …/approval permission
+        api.update("CertificateSigningRequest", flip, cred=cred)
