@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -94,29 +95,36 @@ class SchedulingEngine:
         # tensor analog of the equivalence cache, equivalence_cache.go:54).
         batch = ClassBatch(pods, self.snapshot)
 
-        # Symmetry routing (predicates.go:1146): a pod with NO affinity of
-        # its own can still be blocked by an EXISTING pod's required
-        # anti-affinity (or by an affinity pod earlier in this batch). Pods
-        # matching any such term take the exact host path — the device kernel
-        # doesn't model the symmetry check yet. Class-level: the verdict
-        # depends only on spec fields covered by the class key.
-        from kubernetes_tpu.ops.oracle_ext import term_matches_pod
-        anti_terms = []
+        # Affinity/spread class data (ops/affinity.py): static domain
+        # vectors vs existing pods, class-to-class match matrices for
+        # in-batch interactions, workload membership for spreading. Replaces
+        # the round-1 host-path routing of every affinity-bearing pod —
+        # only slot-overflow classes fall back to the oracle now.
+        from kubernetes_tpu.ops.affinity import AffinityData
+        all_pairs, aff_pairs = [], []
         for info in infos.values():
-            for e in info.pods_with_affinity:
-                if e.affinity and e.affinity.pod_anti_affinity:
-                    for term in e.affinity.pod_anti_affinity.required_terms:
-                        anti_terms.append((term, e))
-        for p in batch.reps:
-            if p.affinity and p.affinity.pod_anti_affinity:
-                for term in p.affinity.pod_anti_affinity.required_terms:
-                    anti_terms.append((term, p))
-        if anti_terms:
-            for c, rep in enumerate(batch.reps):
-                if not batch.reps_batch.needs_host_check[c] and any(
-                        term_matches_pod(term, owner, rep)
-                        for term, owner in anti_terms):
-                    batch.mark_host_check_class(c)
+            for q in info.pods:
+                all_pairs.append((q, info.node))
+            for q in info.pods_with_affinity:
+                aff_pairs.append((q, info.node))
+        c_pad = bucket(batch.num_classes + 1)
+        adata = AffinityData(batch.reps, self.snapshot, all_pairs, aff_pairs,
+                             self.workloads_provider(),
+                             self.hard_pod_affinity_weight, c_pad=c_pad)
+        for c in np.nonzero(adata.overflow[:batch.num_classes])[0]:
+            batch.mark_host_check_class(int(c))
+        w_ip = sum(w for nm, w in self.priorities
+                   if nm == "InterPodAffinityPriority")
+        w_sp = sum(w for nm, w in self.priorities
+                   if nm == "SelectorSpreadPriority")
+        fits_on = adata.fits_needed
+        prio_on = bool(w_ip) and adata.prio_needed
+        spread_on = bool(w_sp) and adata.spread_needed
+        aff_mode = (fits_on, prio_on, spread_on)
+        aff_arrays = adata.device_arrays() if any(aff_mode) else None
+        kernel_priorities = self.priorities if aff_arrays is not None else \
+            tuple((nm, w) for nm, w in self.priorities
+                  if nm not in prio.AFFINITY_PRIORITIES)
         # size the port bitmap to the highest word any node uses or any batch
         # pod requests (power-of-2 bucketed so the compiled shapes are stable)
         max_words = self.snapshot.port_words_used()
@@ -138,7 +146,6 @@ class SchedulingEngine:
             # nothing, commit nothing, no RR ticks) and padding pods map to
             # the first padding class.
             from kubernetes_tpu.ops.predicates import pod_arrays_padded
-            c_pad = bucket(batch.num_classes + 1)
             cls_arr = pod_arrays_padded(batch.reps_batch, c_pad)
             pf = len(fast_idx)
             p_pad = bucket(pf)
@@ -149,15 +156,18 @@ class SchedulingEngine:
                               nodes["vol_present"], nodes["vol_rw"],
                               nodes["pd_present"], nodes["pd_counts"])
             if mode == "wave":
-                selected, fit_counts, _, rr_end = waves.place_waves(
-                    cls_arr, nodes, state, pc_fast, self.rr.counter,
-                    self.priorities)
+                selected, fit_counts, rr_end = self._run_wave(
+                    batch, adata, cls_arr, nodes, state, fast_idx, pc_fast,
+                    pf, aff_arrays, aff_mode, kernel_priorities,
+                    (w_ip, w_sp))
             else:
-                selected, fit_counts, _, rr_end = gather_place_batch(
-                    cls_arr, jnp.asarray(pc_fast), nodes, state,
-                    jnp.uint32(self.rr.counter), self.priorities)
-            selected = np.asarray(selected)[:pf]
-            fit_counts = np.asarray(fit_counts)[:pf]
+                with jax.enable_x64(True):
+                    selected, fit_counts, _, rr_end = gather_place_batch(
+                        cls_arr, jnp.asarray(pc_fast), nodes, state,
+                        jnp.uint32(self.rr.counter), kernel_priorities,
+                        aff=aff_arrays, aff_mode=aff_mode)
+                selected = np.asarray(selected)[:pf]
+                fit_counts = np.asarray(fit_counts)[:pf]
             self.rr.counter = int(rr_end)
             names = self.snapshot.node_names
             placements = []
@@ -202,6 +212,65 @@ class SchedulingEngine:
         return results  # type: ignore[return-value]
 
     # ------------------------------------------------------------- internals
+
+    def _run_wave(self, batch, adata, cls_arr, nodes, state, fast_idx,
+                  pc_fast, pf, aff_arrays, aff_mode, kernel_priorities,
+                  weights):
+        """Wave mode with affinity routing: classes whose REQUIRED
+        (anti-)affinity makes placement order-dependent run through the
+        strict scan AFTER the wave pass — seeded with the wave's topology
+        occupancy so in-batch interactions stay exact — while everything
+        else takes the throughput path with batch-frozen spread/interpod
+        scores (waves.frozen_affinity_scores)."""
+        w_ip, w_sp = weights
+        fits_on, prio_on, spread_on = aff_mode
+        extra = None
+        if prio_on or spread_on:
+            with jax.enable_x64(True):
+                extra = waves.frozen_affinity_scores(
+                    cls_arr, nodes, state, aff_arrays,
+                    (w_ip if prio_on else 0, w_sp if spread_on else 0))
+        ser = adata.serialize[pc_fast[:pf]]
+        selected = np.full(pf, -1, dtype=np.int32)
+        fit_counts = np.zeros(pf, dtype=np.int32)
+        rr = self.rr.counter
+        wave_pos = np.nonzero(~ser)[0]
+        strict_pos = np.nonzero(ser)[0]
+        state_cur = state
+        if len(wave_pos):
+            wp = len(wave_pos)
+            pcw = np.full(bucket(wp), batch.num_classes, dtype=np.int32)
+            pcw[:wp] = pc_fast[wave_pos]
+            sel_w, fc_w, state_cur, rr = waves.place_waves(
+                cls_arr, nodes, state_cur, pcw, rr, kernel_priorities,
+                extra_score=extra)
+            selected[wave_pos] = sel_w[:wp]
+            fit_counts[wave_pos] = fc_w[:wp]
+        if len(strict_pos):
+            sp_n = len(strict_pos)
+            pcs = np.full(bucket(sp_n), batch.num_classes, dtype=np.int32)
+            pcs[:sp_n] = pc_fast[strict_pos]
+            aff_init = None
+            if aff_arrays is not None:
+                c_dim = aff_arrays["m_aff"].shape[0]
+                comm_np = np.zeros((c_dim, int(nodes["alloc"].shape[0])),
+                                   dtype=np.int32)
+                for j in wave_pos:
+                    if selected[j] >= 0:
+                        comm_np[pc_fast[j], selected[j]] += 1
+                committed0 = jnp.asarray(comm_np)
+                commdom0 = committed0 @ nodes["labels"].astype(jnp.int32)
+                comm_cnt0 = committed0.sum(axis=1)
+                aff_init = (commdom0, committed0, comm_cnt0)
+            with jax.enable_x64(True):
+                sel_s, fc_s, _, rr_d = gather_place_batch(
+                    cls_arr, jnp.asarray(pcs), nodes, state_cur,
+                    jnp.uint32(rr), kernel_priorities, aff=aff_arrays,
+                    aff_mode=aff_mode, aff_init=aff_init)
+            selected[strict_pos] = np.asarray(sel_s)[:sp_n]
+            fit_counts[strict_pos] = np.asarray(fc_s)[:sp_n]
+            rr = int(rr_d)
+        return selected, fit_counts, rr
 
     def _assume(self, pod: Pod, node_name: str) -> None:
         pod.node_name = node_name

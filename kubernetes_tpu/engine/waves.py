@@ -98,8 +98,11 @@ def precompute(cls: Arrays, nodes: Arrays,
     n = nodes["alloc"].shape[0]
     static_score = jnp.zeros((c, n), dtype=jnp.int32)
     for name, weight in priorities:
-        if name in _DYNAMIC or name in _REDUCE \
-                or name in prio.HOST_ONLY_PRIORITIES:
+        if name in _DYNAMIC or name in _REDUCE:
+            continue
+        if name in ("SelectorSpreadPriority", "InterPodAffinityPriority"):
+            # wave mode scores these against the batch-frozen cluster state
+            # (ops/affinity.py); the engine passes them via extra_score
             continue
         static_score = static_score \
             + prio.PRIORITY_REGISTRY[name](cls, nodes, None) * weight
@@ -356,11 +359,38 @@ def wave_step(cls, nodes, state, pod_class, active, counter, priorities):
                       priorities)
 
 
+@functools.partial(jax.jit, static_argnames=("weights",))
+def frozen_affinity_scores(cls: Arrays, nodes: Arrays, state: NodeState,
+                           aff: Arrays,
+                           weights: Tuple[int, int]) -> jnp.ndarray:
+    """SelectorSpread / InterPodAffinity scores [C, N] against the
+    batch-frozen cluster state, for the wave engine's additive static score
+    (weights = (w_interpod, w_spread)). Wave semantics score these once per
+    BATCH, not per wave — within-batch drift of preferred-affinity/spread
+    counts is a documented wave-mode approximation; classes with REQUIRED
+    (anti-)affinity never take this path (AffinityData.serialize routes
+    them to the strict scan). Trace under jax.enable_x64 when w_spread>0."""
+    from kubernetes_tpu.ops import affinity as aff_ops
+
+    w_ip, w_sp = weights
+    fits = preds.static_fits(cls, nodes) & _dynamic_fits(cls, nodes, state)
+    extra = jnp.zeros(fits.shape, dtype=jnp.int32)
+    if w_ip:
+        pre = aff_ops.precompute_static(aff, nodes["labels"])
+        extra = extra + w_ip * aff_ops.interpod_score(pre["prio_counts"],
+                                                      fits)
+    if w_sp:
+        extra = extra + w_sp * aff_ops.spread_score(
+            aff, aff["sp_has"], aff["sp_static"], fits)
+    return extra
+
+
 @functools.partial(jax.jit, static_argnames=("priorities", "max_waves"))
 def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
                pod_class: jnp.ndarray, counter: jnp.ndarray,
                priorities: Tuple[Tuple[str, int], ...],
                max_waves: int = 32,
+               extra_score: jnp.ndarray = None,
                ) -> Tuple[jnp.ndarray, NodeState]:
     """The whole wave iteration as ONE device program (lax.while_loop over
     _wave_once) — a single dispatch + a single [3P+2] host fetch regardless
@@ -373,6 +403,8 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
     P = pod_class.shape[0]
     pre = precompute(cls, nodes, priorities)  # hoisted: while_loop bodies
     # re-execute everything every iteration; XLA cannot hoist for us
+    if extra_score is not None:  # batch-frozen spread/interpod scores
+        pre = dict(pre, static_score=pre["static_score"] + extra_score)
 
     def cond(carry):
         _, active, _, _, _, w = carry
@@ -401,6 +433,7 @@ def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
                 pod_class: np.ndarray, counter: int,
                 priorities: Tuple[Tuple[str, int], ...],
                 max_waves: int = 64,
+                extra_score: jnp.ndarray = None,
                 ) -> Tuple[np.ndarray, np.ndarray, NodeState, int]:
     """Run waves until every pod is placed or proven unplaceable — one
     device program (waves_loop) + one host fetch. Returns (selected [P]
@@ -409,7 +442,8 @@ def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
     so the device loop terminates in <= P waves (typically 1-3)."""
     P = len(pod_class)
     packed, state = waves_loop(cls, nodes, state, jnp.asarray(pod_class),
-                               jnp.uint32(counter), priorities, max_waves)
+                               jnp.uint32(counter), priorities, max_waves,
+                               extra_score)
     packed_h = np.asarray(packed)  # the ONLY device->host sync
     final_sel = packed_h[:P].copy()
     final_fc = packed_h[P:2 * P].copy()
@@ -429,7 +463,7 @@ def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
         pc[:n_strag] = pod_class[idx]
         sel, fcs, state, counter_d = gather_place_batch(
             cls, jnp.asarray(pc), nodes, state, jnp.uint32(counter_h),
-            priorities)
+            priorities, extra_score=extra_score)
         final_sel[idx] = np.asarray(sel)[:n_strag]
         final_fc[idx] = np.asarray(fcs)[:n_strag]
         counter_h = int(counter_d)

@@ -906,11 +906,10 @@ class PodBatch:
             self.has_host[p] = True
             self.host_required[p] = snap.node_index.get(pod.node_name, -1)
 
-        if pod.affinity is not None and (pod.affinity.pod_affinity is not None
-                                         or pod.affinity.pod_anti_affinity is not None):
-            # inter-pod affinity is evaluated by the exact host path until the
-            # topology-incidence kernel integrates into the placement scan
-            self.needs_host_check[p] = True
+        # inter-pod affinity no longer forces the host path: the topology-
+        # incidence kernel (ops/affinity.py) evaluates it in the placement
+        # scan; only term-slot overflow routes to the oracle (the engine
+        # marks those classes from AffinityData.overflow)
 
         # tolerations -> which vocab taints remain INtolerated
         for t_idx, (tkey, tpack) in enumerate(snap.taint_vocab.items()):
