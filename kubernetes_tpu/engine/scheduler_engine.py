@@ -51,6 +51,93 @@ class PlacementResult:
         return f"Placement({self.pod.key()} -> {self.node_name})"
 
 
+def evaluate_pod(pod: Pod, infos, snap: ClusterSnapshot,
+                 priorities: Tuple[Tuple[str, int], ...],
+                 workloads: Sequence = (), hard_weight: int = 1,
+                 volume_ctx=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-node (fits [N] bool, scores [N] int32) for ONE pod against the
+    cluster state — the extender's /filter + /prioritize evaluation
+    (core/extender.go:100 Filter, :157 Prioritize). No state is committed:
+    a single pod has no in-batch carry, so the affinity/spread kernels run
+    with zero occupancy (the static side only — exactly what the reference's
+    per-pod predicate/priority calls see through the scheduler cache).
+
+    `snap` must already be refreshed against `infos`. Falls back to the
+    exact host oracle when the pod's features over-approximate on device
+    (needs_host_check / affinity slot overflow)."""
+    from kubernetes_tpu.ops.affinity import (
+        AffinityData,
+        collect_pod_pairs,
+        intern_topology_pairs,
+        precompute_static,
+        step_fits,
+        step_prio_counts,
+        step_spread_counts,
+        interpod_score,
+        spread_score,
+    )
+    from kubernetes_tpu.ops.predicates import fits_jit, node_arrays, pod_arrays
+
+    all_pairs, aff_pairs = collect_pod_pairs(infos)
+    intern_topology_pairs(snap, [pod], aff_pairs)
+    batch = ClassBatch([pod], snap)
+    adata = AffinityData(batch.reps, snap, all_pairs, aff_pairs,
+                         list(workloads), hard_weight)
+    n_real = len(snap.node_names)
+    if batch.reps_batch.needs_host_check[0] or adata.overflow[0]:
+        # exact object-level path (same routing as SchedulingEngine.schedule)
+        from kubernetes_tpu.ops.oracle_ext import AffinityMeta, SchedulingContext
+        ctx = SchedulingContext(infos, list(workloads),
+                                hard_pod_affinity_weight=hard_weight,
+                                volume_ctx=volume_ctx)
+        meta = AffinityMeta(pod, ctx)
+        names = snap.node_names
+        n_pad = snap.valid.shape[0]
+        m = np.zeros(n_pad, dtype=bool)
+        for i, nm in enumerate(names):
+            m[i] = oracle.pod_fits(pod, infos[nm], ctx, meta)
+        s = np.zeros(n_pad, dtype=np.int64)
+        fit_idx = np.nonzero(m)[0]
+        if len(fit_idx):
+            fit_infos = [infos[names[i]] for i in fit_idx]
+            per = oracle.prioritize(pod, fit_infos, priorities, ctx)
+            s[fit_idx] = per
+        return m, s
+    narr = node_arrays(snap)
+    parr = pod_arrays(batch.reps_batch)
+    w_ip = sum(w for nm, w in priorities if nm == "InterPodAffinityPriority")
+    w_sp = sum(w for nm, w in priorities if nm == "SelectorSpreadPriority")
+    plain = tuple((nm, w) for nm, w in priorities
+                  if nm not in prio.AFFINITY_PRIORITIES)
+    # same gate as schedule(): skip the whole affinity machinery (device
+    # upload + einsum traces) when nothing in the cluster or pod needs it
+    fits_on = adata.fits_needed
+    prio_on = bool(w_ip) and adata.prio_needed
+    spread_on = bool(w_sp) and adata.spread_needed
+    with jax.enable_x64(True):
+        m = fits_jit(parr, narr)[0]
+        s = prio.score(parr, narr, plain)[0]
+        if fits_on or prio_on or spread_on:
+            aff = adata.device_arrays()
+            labels = narr["labels"]
+            pre = precompute_static(aff, labels)
+            c_dim = aff["m_aff"].shape[0]
+            commdom0 = jnp.zeros((c_dim, labels.shape[1]), dtype=jnp.int32)
+            committed0 = jnp.zeros((c_dim, labels.shape[0]), dtype=jnp.int32)
+            comm_cnt0 = jnp.zeros(c_dim, dtype=jnp.int32)
+            if fits_on:
+                m = m & step_fits(aff, pre, 0, commdom0, comm_cnt0, labels)
+            if prio_on:
+                cnt = step_prio_counts(aff, pre, 0, commdom0, labels)
+                s = s + w_ip * interpod_score(cnt, m)
+            if spread_on:
+                cnt = step_spread_counts(aff, 0, committed0)
+                s = s + w_sp * spread_score(aff, aff["sp_has"][0], cnt, m)
+    m = np.array(m)  # copy: device buffers are read-only views
+    m[n_real:] = False
+    return m, np.asarray(s)
+
+
 class SchedulingEngine:
     def __init__(self, cache: SchedulerCache,
                  priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
@@ -89,7 +176,16 @@ class SchedulingEngine:
             return []
         infos = self.cache.node_infos()
         self.snapshot.refresh(infos, volume_ctx=self.volume_ctx)
-        # ClassBatch first: selector compilation may grow the label vocab and
+        from kubernetes_tpu.ops.affinity import AffinityData, \
+            collect_pod_pairs, intern_topology_pairs
+        all_pairs, aff_pairs = collect_pod_pairs(infos)
+        # topology keys referenced by ANY affinity term (pending or existing)
+        # must be in the label vocab BEFORE the label matrix is finalized —
+        # a key only an existing pod's anti-affinity mentions would otherwise
+        # have no domain columns and the symmetry forbid would silently
+        # evaporate (r2 correctness bug; ref predicates.go:1146)
+        intern_topology_pairs(self.snapshot, pods, aff_pairs)
+        # ClassBatch next: selector compilation may grow the label vocab and
         # rebuild the label matrix; upload happens after, dirty-arrays only.
         # Encoding runs once per distinct pod spec (state/classes.py — the
         # tensor analog of the equivalence cache, equivalence_cache.go:54).
@@ -100,13 +196,6 @@ class SchedulingEngine:
         # in-batch interactions, workload membership for spreading. Replaces
         # the round-1 host-path routing of every affinity-bearing pod —
         # only slot-overflow classes fall back to the oracle now.
-        from kubernetes_tpu.ops.affinity import AffinityData
-        all_pairs, aff_pairs = [], []
-        for info in infos.values():
-            for q in info.pods:
-                all_pairs.append((q, info.node))
-            for q in info.pods_with_affinity:
-                aff_pairs.append((q, info.node))
         c_pad = bucket(batch.num_classes + 1)
         adata = AffinityData(batch.reps, self.snapshot, all_pairs, aff_pairs,
                              self.workloads_provider(),
@@ -241,9 +330,13 @@ class SchedulingEngine:
             wp = len(wave_pos)
             pcw = np.full(bucket(wp), batch.num_classes, dtype=np.int32)
             pcw[:wp] = pc_fast[wave_pos]
+            # aff/aff_mode reach only the straggler fallback inside
+            # place_waves: preferred scoring stays batch-frozen (extra),
+            # so prio/spread are off there to avoid double-counting
             sel_w, fc_w, state_cur, rr = waves.place_waves(
                 cls_arr, nodes, state_cur, pcw, rr, kernel_priorities,
-                extra_score=extra)
+                extra_score=extra, aff=aff_arrays,
+                aff_mode=(fits_on, False, False))
             selected[wave_pos] = sel_w[:wp]
             fit_counts[wave_pos] = fc_w[:wp]
         if len(strict_pos):

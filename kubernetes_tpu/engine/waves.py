@@ -434,6 +434,8 @@ def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
                 priorities: Tuple[Tuple[str, int], ...],
                 max_waves: int = 64,
                 extra_score: jnp.ndarray = None,
+                aff: Arrays = None,
+                aff_mode: Tuple[bool, bool, bool] = (False, False, False),
                 ) -> Tuple[np.ndarray, np.ndarray, NodeState, int]:
     """Run waves until every pod is placed or proven unplaceable — one
     device program (waves_loop) + one host fetch. Returns (selected [P]
@@ -461,9 +463,12 @@ def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
         else:  # caller passed unpadded class arrays: no inert row to map to
             pc = np.empty(n_strag, dtype=np.int32)
         pc[:n_strag] = pod_class[idx]
+        # thread the affinity class data through so priorities containing
+        # SelectorSpread/InterPodAffinity don't trip place_batch's guard
+        # when extra_score is None (fits-only affinity batches)
         sel, fcs, state, counter_d = gather_place_batch(
             cls, jnp.asarray(pc), nodes, state, jnp.uint32(counter_h),
-            priorities, extra_score=extra_score)
+            priorities, aff=aff, aff_mode=aff_mode, extra_score=extra_score)
         final_sel[idx] = np.asarray(sel)[:n_strag]
         final_fc[idx] = np.asarray(fcs)[:n_strag]
         counter_h = int(counter_d)

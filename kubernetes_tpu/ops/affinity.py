@@ -112,6 +112,62 @@ def _has_affinity(pod: Pod) -> bool:
                               or a.pod_anti_affinity is not None)
 
 
+def _term_topology_keys(pod: Pod) -> List[str]:
+    """Every topology key any (anti-)affinity term of `pod` references."""
+    keys = []
+    a = pod.affinity
+    if a is None:
+        return keys
+    for pa in (a.pod_affinity, a.pod_anti_affinity):
+        if pa is None:
+            continue
+        for t in pa.required_terms:
+            if t.topology_key:
+                keys.append(t.topology_key)
+        for _w, t in pa.preferred_terms:
+            if t.topology_key:
+                keys.append(t.topology_key)
+    return keys
+
+
+def collect_pod_pairs(infos) -> Tuple[list, list]:
+    """(all_pairs, aff_pairs): every bound pod with its node, and the
+    pods-with-affinity subset (node_info.go PodsWithAffinity). The single
+    source for both the engine's and the extender's AffinityData inputs."""
+    all_pairs, aff_pairs = [], []
+    for info in infos.values():
+        for q in info.pods:
+            all_pairs.append((q, info.node))
+        for q in info.pods_with_affinity:
+            aff_pairs.append((q, info.node))
+    return all_pairs, aff_pairs
+
+
+def intern_topology_pairs(snap, pending_pods: Sequence[Pod],
+                          aff_pods) -> None:
+    """Intern every (topology_key, node_value) pair reachable from ANY
+    affinity term — the pending pods' own terms AND the existing
+    pods_with_affinity terms (the symmetry + priority side).
+
+    The snapshot's label vocab is demand-driven by pod SELECTORS
+    (snapshot.py compile_requirements); a topology key referenced only by an
+    affinity term would otherwise have no domain columns, making
+    AffinityData.domain_id silently return -1 and the constraint evaporate —
+    the r2 symmetry-violation bug (ref semantics: predicates.go:1146
+    satisfiesExistingPodsAntiAffinity must hold for every placement).
+    Must run after ClusterSnapshot.refresh() (needs the node label index)
+    and before PodBatch/ClassBatch construction (which finalizes the label
+    matrix)."""
+    keys = set()
+    for pod in pending_pods:
+        keys.update(_term_topology_keys(pod))
+    for pod, _node in aff_pods:
+        keys.update(_term_topology_keys(pod))
+    for key in keys:
+        for v in snap.node_values_for_key(key):
+            snap.ensure_label_pair(key, v)
+
+
 class AffinityData:
     """Host-side builder of the class-level device arrays.
 
@@ -314,9 +370,14 @@ class AffinityData:
         self.spread_needed = bool(self.sp_has.any())
         # required (anti-)affinity classes must schedule sequentially (their
         # fits depend on every prior in-batch commit) -> wave mode routes
-        # them to the strict scan
+        # them to the strict scan. Classes with a nonzero STATIC forbid row
+        # (an existing pod's required anti-affinity matches them — symmetry,
+        # predicates.go:1146) also serialize: the wave fits path doesn't
+        # evaluate affinity masks, and a plain pod forbidden from a topology
+        # by a bound guard pod must not slip through the throughput path.
         self.serialize = (self.aff_active.any(axis=1)
-                          | self.anti_active.any(axis=1) | self.fail_all)
+                          | self.anti_active.any(axis=1) | self.fail_all
+                          | self.forbid_static.any(axis=1))
 
     def device_arrays(self) -> Arrays:
         out = {}

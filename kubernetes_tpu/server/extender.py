@@ -244,10 +244,8 @@ class TPUExtenderBackend:
     # -- extender verbs -----------------------------------------------------
 
     def _eval(self, pod: Pod, nodes: Optional[List[Node]]):
-        import numpy as np
-        from kubernetes_tpu.ops.predicates import fits_jit, node_arrays, pod_arrays
-        from kubernetes_tpu.ops import priorities as prio
-        from kubernetes_tpu.state.snapshot import ClusterSnapshot, PodBatch
+        from kubernetes_tpu.engine.scheduler_engine import evaluate_pod
+        from kubernetes_tpu.state.snapshot import ClusterSnapshot
 
         if nodes is not None:
             # non-cache-capable: full node state ships in every request, so
@@ -260,12 +258,13 @@ class TPUExtenderBackend:
             snap.refresh(infos)
         else:
             snap = self.engine.snapshot
-            snap.refresh(self.cache.node_infos())
-        batch = PodBatch([pod], snap)
-        narr = node_arrays(snap)
-        parr = pod_arrays(batch)
-        m = np.asarray(fits_jit(parr, narr))[0]
-        s = np.asarray(prio.score(parr, narr, self.engine.priorities))[0]
+            infos = self.cache.node_infos()
+            snap.refresh(infos)
+        m, s = evaluate_pod(
+            pod, infos, snap, self.engine.priorities,
+            workloads=self.engine.workloads_provider(),
+            hard_weight=self.engine.hard_pod_affinity_weight,
+            volume_ctx=self.engine.volume_ctx)
         return snap, m, s
 
     def filter(self, pod, nodes, node_names):

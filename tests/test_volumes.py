@@ -267,9 +267,13 @@ def test_batch_carry_sees_committed_volumes():
     from kubernetes_tpu.engine.batch import node_state
     import jax.numpy as jnp
     from kubernetes_tpu.ops import priorities as prio
+    # direct place_batch callers without AffinityData must strip the two
+    # cluster-topology priorities (the engine does the same when no class
+    # carries affinity/spread state — batch.py's guard rejects silent zeros)
+    plain = tuple((nm, w) for nm, w in prio.DEFAULT_PRIORITIES
+                  if nm not in prio.AFFINITY_PRIORITIES)
     selected, fit_counts, state, _ = place_batch(
-        pod_arrays(batch), narr, node_state(narr), jnp.uint32(0),
-        prio.DEFAULT_PRIORITIES)
+        pod_arrays(batch), narr, node_state(narr), jnp.uint32(0), plain)
     sel = np.asarray(selected)
     assert sel[0] >= 0 and sel[1] >= 0
     assert sel[0] != sel[1]  # conflict forced apart
