@@ -1,0 +1,92 @@
+"""Profiling rig for the headline bench: times each phase of the drain.
+
+Not part of the framework; dev-only. Run: python profile_bench.py
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from bench import build
+
+
+def main():
+    n_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    n_pods = int(os.environ.get("BENCH_PODS", 30000))
+    profile = os.environ.get("BENCH_PROFILE", "density")
+
+    # warmup (compile) run
+    api, sched = build(n_nodes, n_pods, profile)
+    sched.run_until_drained()
+
+    for trial in range(3):
+        api, sched = build(n_nodes, n_pods, profile)
+        phases = {}
+
+        def timed(name, fn):
+            def wrap(*a, **k):
+                t0 = time.perf_counter()
+                r = fn(*a, **k)
+                phases[name] = phases.get(name, 0.0) + time.perf_counter() - t0
+                return r
+            return wrap
+
+        import kubernetes_tpu.engine.scheduler_engine as SE
+        import kubernetes_tpu.engine.waves as W
+        import kubernetes_tpu.state.classes as CL
+        from kubernetes_tpu.ops import affinity as AF
+
+        eng = sched.engine
+        sched.sync = timed("sync", sched.sync)
+        sched.queue.pop_batch = timed("pop_batch", sched.queue.pop_batch)
+        eng.schedule = timed("engine.schedule", eng.schedule)
+        sched.api.bind_many = timed("bind_many", sched.api.bind_many)
+        sched.cache.finish_bindings_bulk = timed("finish_bulk",
+                                                 sched.cache.finish_bindings_bulk)
+        eng.snapshot.refresh = timed("  snapshot.refresh", eng.snapshot.refresh)
+        eng._nodes_on_device = timed("  nodes_on_device", eng._nodes_on_device)
+        eng._run_wave = timed("  run_wave(device)", eng._run_wave)
+        sched.cache.assume_pods_bulk = timed("  assume_bulk",
+                                             sched.cache.assume_pods_bulk)
+        orig_cb = CL.ClassBatch
+        class TimedCB(orig_cb):
+            def __init__(self, *a, **k):
+                t0 = time.perf_counter()
+                super().__init__(*a, **k)
+                phases["  ClassBatch"] = phases.get("  ClassBatch", 0.0) \
+                    + time.perf_counter() - t0
+        SE.ClassBatch = TimedCB
+        orig_ad = AF.AffinityData
+        class TimedAD(orig_ad):
+            def __init__(self, *a, **k):
+                t0 = time.perf_counter()
+                super().__init__(*a, **k)
+                phases["  AffinityData"] = phases.get("  AffinityData", 0.0) \
+                    + time.perf_counter() - t0
+        AF.AffinityData = TimedAD
+
+        import gc
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            totals = sched.run_until_drained()
+            elapsed = time.perf_counter() - t0
+        finally:
+            gc.enable()
+            gc.unfreeze()
+            SE.ClassBatch = orig_cb
+            AF.AffinityData = orig_ad
+        print(f"trial {trial}: elapsed={elapsed:.3f}s bound={totals['bound']}")
+        top = phases.pop("engine.schedule", 0.0)
+        inner = sum(v for k, v in phases.items() if k.startswith("  "))
+        outer = sum(v for k, v in phases.items() if not k.startswith("  "))
+        for k, v in sorted(phases.items(), key=lambda kv: -kv[1]):
+            print(f"    {k:24s} {v*1e3:7.1f}ms")
+        print(f"    {'schedule other':24s} {(top-inner)*1e3:7.1f}ms")
+        print(f"    {'(unaccounted)':24s} {(elapsed-outer-top)*1e3:7.1f}ms")
+
+
+if __name__ == "__main__":
+    main()
