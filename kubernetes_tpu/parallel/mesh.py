@@ -56,3 +56,25 @@ def shard_nodes(nodes: Arrays, mesh: Mesh) -> Arrays:
 def replicate(pods: Arrays, mesh: Mesh) -> Arrays:
     sh = NamedSharding(mesh, P())
     return {k: jax.device_put(v, sh) for k, v in pods.items()}
+
+
+# AffinityData device arrays (ops/affinity.py device_arrays): most are
+# class/slot/label-indexed (replicated — the label axis L is the contraction
+# axis of the topology einsums, so splitting it would force inner-product
+# collectives per scan step; N is the embarrassingly-parallel axis), but
+# three carry a node axis and shard with the nodes:
+#   sp_static [C, N] axis 1, Z [N, ZN] axis 0, node_has_zone [N] axis 0
+_AFF_NODE_AXIS = {"sp_static": 1, "Z": 0, "node_has_zone": 0}
+
+
+def shard_affinity(aff: Arrays, mesh: Mesh) -> Arrays:
+    """Place affinity class arrays: node-axis arrays sharded along the mesh,
+    everything else replicated. The affinity scan carry (commdom [C,L],
+    committed [C,N], comm_cnt [C]) is created inside the jitted program;
+    XLA lays it out to match these operand shardings."""
+    out = {}
+    for k, v in aff.items():
+        ax = _AFF_NODE_AXIS.get(k)
+        spec = P() if ax is None else P(*([None] * ax + [NODE_AXIS]))
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out

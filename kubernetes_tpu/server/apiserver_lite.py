@@ -59,13 +59,28 @@ def _meta(obj: Any) -> Tuple[str, str]:
 
 
 class ApiServerLite:
-    def __init__(self, max_log: int = 200_000):
+    def __init__(self, max_log: int = 200_000, data_dir: Optional[str] = None,
+                 fsync: str = "batch", compact_every: int = 200_000):
+        """data_dir=None (default) is the pure in-memory benchmark store;
+        a data_dir makes every write durable through a WAL + snapshots
+        (server/durable.py — the etcd role, etcd3/store.go:85) and restores
+        state on construction. Watchers resuming with a pre-restart rv get
+        TooOldResourceVersion and must relist, like an etcd compaction."""
         self._lock = threading.Condition()
         self._objects: Dict[_KEY, Any] = {}
         self._rv = 0
         self._log: List[WatchEvent] = []
         self._log_start_rv = 0  # rv of the first retained event
         self._max_log = max_log
+        self._durable = None
+        if data_dir is not None:
+            from kubernetes_tpu.server.durable import DurableStore
+            self._durable = DurableStore(data_dir, fsync=fsync,
+                                         compact_every=compact_every)
+            self._objects, self._rv = self._durable.restore()
+            # the event log did not survive: anything before the restored rv
+            # is unreachable, so resuming watchers must relist
+            self._log_start_rv = self._rv + 1
 
     # ------------------------------------------------------------------ CRUD
 
@@ -78,6 +93,7 @@ class ApiServerLite:
             obj.resource_version = self._rv
             self._objects[key] = obj
             self._append(WatchEvent("ADDED", kind, obj, self._rv))
+            self._persist_put(key, obj)
             return self._rv
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -107,6 +123,7 @@ class ApiServerLite:
             obj.resource_version = self._rv
             self._objects[key] = obj
             self._append(WatchEvent("MODIFIED", kind, obj, self._rv))
+            self._persist_put(key, obj)
             return self._rv
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -117,6 +134,10 @@ class ApiServerLite:
                 raise NotFound(str(key))
             self._rv += 1
             self._append(WatchEvent("DELETED", kind, obj, self._rv))
+            if self._durable is not None:
+                self._durable.delete(key, self._rv)
+                self._durable.flush()
+                self._maybe_compact()
 
     # ------------------------------------------------------------- binding
 
@@ -139,26 +160,36 @@ class ApiServerLite:
             objects = self._objects
             log = self._log
             rv = self._rv
-            for b in bindings:
-                key = ("Pod", b.pod_namespace, b.pod_name)
-                pod = objects.get(key)
-                if pod is None:
-                    out.append(
-                        f"not found: pod {b.pod_namespace}/{b.pod_name}")
-                    continue
-                if pod.node_name:
-                    out.append(f"conflict: pod {pod.key()} is already "
-                               f"assigned to node {pod.node_name}")
-                    continue
-                new = object.__new__(Pod)
-                new.__dict__.update(pod.__dict__)
-                new.node_name = b.node_name
-                rv += 1
-                new.resource_version = rv
-                objects[key] = new
-                log.append(WatchEvent("MODIFIED", "Pod", new, rv))
-                out.append(None)
-            self._rv = rv
+            try:
+                for b in bindings:
+                    key = ("Pod", b.pod_namespace, b.pod_name)
+                    pod = objects.get(key)
+                    if pod is None:
+                        out.append(
+                            f"not found: pod {b.pod_namespace}/{b.pod_name}")
+                        continue
+                    if pod.node_name:
+                        out.append(f"conflict: pod {pod.key()} is already "
+                                   f"assigned to node {pod.node_name}")
+                        continue
+                    new = object.__new__(Pod)
+                    new.__dict__.update(pod.__dict__)
+                    new.node_name = b.node_name
+                    rv += 1
+                    new.resource_version = rv
+                    objects[key] = new
+                    log.append(WatchEvent("MODIFIED", "Pod", new, rv))
+                    if self._durable is not None:
+                        self._durable.put(key, new, rv)
+                    out.append(None)
+            finally:
+                # even if a durable append raises mid-batch, rv must cover
+                # every binding already applied to objects/log — reissuing
+                # an rv would break the log's bisect-by-rv invariant
+                self._rv = rv
+            if self._durable is not None:
+                self._durable.flush()
+                self._maybe_compact()
             if len(log) > self._max_log:
                 drop = len(log) - self._max_log
                 self._log = log[drop:]
@@ -183,6 +214,7 @@ class ApiServerLite:
         new.resource_version = self._rv
         self._objects[key] = new
         self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
+        self._persist_put(key, new)
         return self._rv
 
     # --------------------------------------------------------------- watch
@@ -193,10 +225,13 @@ class ApiServerLite:
         `timeout` when none are available (0/None = non-blocking)."""
         with self._lock:
             if from_rv < self._log_start_rv - 1 and from_rv < self._rv:
-                # events the watcher needs may have been compacted away
-                if self._log and self._log[0].rv > from_rv + 1:
+                # events the watcher needs were compacted away — either
+                # trimmed from the bounded log, or lost in a restart (the
+                # durable store recovers objects, not the event log)
+                if not self._log or self._log[0].rv > from_rv + 1:
                     raise TooOldResourceVersion(
-                        f"requested rv {from_rv}, log starts at {self._log[0].rv}")
+                        f"requested rv {from_rv}, log starts at rv "
+                        f"{self._log[0].rv if self._log else self._log_start_rv}")
             evs = self._collect(kinds, from_rv)
             if not evs and timeout:
                 self._lock.wait(timeout)
@@ -206,6 +241,31 @@ class ApiServerLite:
     def current_rv(self) -> int:
         with self._lock:
             return self._rv
+
+    # --------------------------------------------------------- durability
+
+    def _persist_put(self, key: _KEY, obj: Any) -> None:
+        """Called under the lock after a state mutation + event append."""
+        if self._durable is not None:
+            self._durable.put(key, obj, self._rv)
+            self._durable.flush()
+            self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        if self._durable.should_compact():
+            self._durable.compact(self._objects, self._rv)
+
+    def compact(self) -> None:
+        """Force a snapshot + WAL truncation (restore-from-backup.sh's
+        backup step; etcd's periodic snapshotting)."""
+        with self._lock:
+            if self._durable is not None:
+                self._durable.compact(self._objects, self._rv)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._durable is not None:
+                self._durable.close()
 
     # ------------------------------------------------------------ internals
 

@@ -142,3 +142,111 @@ def test_dryrun_multichip_impl_runs_in_process():
     by conftest, so the impl can run in-process here)."""
     import __graft_entry__ as g
     g._dryrun_multichip_impl(N_DEV)
+
+
+# ---------------------------------------------------------------- affinity
+
+
+def _affinity_cluster(seed, n_nodes=24, n_existing=12, n_pending=32):
+    """Cluster where the affinity machinery is genuinely exercised: existing
+    guard pods with required anti-affinity, pending pods mixing required/
+    preferred (anti-)affinity, and service workloads for spreading (reuses
+    the fuzz generators of tests/test_affinity_fuzz.py)."""
+    from tests.test_affinity_fuzz import _build_cluster, _pending
+    rng = random.Random(seed)
+    nodes, existing, workloads = _build_cluster(rng, n_nodes=n_nodes,
+                                                n_existing=n_existing)
+    pending = _pending(rng, n_pending)
+    return nodes, existing, workloads, pending
+
+
+def _affinity_kernel_inputs(nodes, existing, workloads, pending):
+    """The exact array-construction path of SchedulingEngine.schedule."""
+    from kubernetes_tpu.ops.affinity import (
+        AffinityData,
+        collect_pod_pairs,
+        intern_topology_pairs,
+    )
+    from kubernetes_tpu.ops.predicates import bucket, pod_arrays_padded
+
+    infos = node_info_map(nodes, existing)
+    snap = ClusterSnapshot(node_pad=N_DEV)
+    snap.refresh(infos)
+    all_pairs, aff_pairs = collect_pod_pairs(infos)
+    intern_topology_pairs(snap, pending, aff_pairs)
+    cbatch = ClassBatch(pending, snap)
+    c_pad = bucket(cbatch.num_classes + 1)
+    adata = AffinityData(cbatch.reps, snap, all_pairs, aff_pairs,
+                         workloads, 1, c_pad=c_pad)
+    cls_arr = pod_arrays_padded(cbatch.reps_batch, c_pad)
+    pc = np.full(preds.bucket(len(pending)), cbatch.num_classes,
+                 dtype=np.int32)
+    pc[: len(pending)] = cbatch.pod_class
+    narr = preds.node_arrays(snap)
+    return cls_arr, pc, narr, adata
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_strict_engine_affinity_parity_under_mesh(seed):
+    """The flagship kernel — the full strict scan WITH the inter-pod
+    affinity + spread machinery on — must be bit-identical sharded vs
+    unsharded (VERDICT r3 #2: the [C,S,L]x[N,L] einsums' node axis is
+    exactly what the mesh splits)."""
+    from kubernetes_tpu.engine.batch import gather_place_batch
+    from kubernetes_tpu.parallel.mesh import shard_affinity
+
+    nodes, existing, workloads, pending = _affinity_cluster(seed)
+    cls_arr, pc, narr, adata = _affinity_kernel_inputs(
+        nodes, existing, workloads, pending)
+    assert adata.fits_needed, "generator must exercise required affinity"
+    assert adata.spread_needed or adata.prio_needed
+    aff = adata.device_arrays()
+    mode = (adata.fits_needed, adata.prio_needed, adata.spread_needed)
+    with jax.enable_x64(True):
+        sel0, fc0, st0, rr0 = gather_place_batch(
+            cls_arr, jnp.asarray(pc), narr, node_state(narr),
+            jnp.uint32(0), prio.DEFAULT_PRIORITIES, aff=aff, aff_mode=mode)
+    base_sel, base_fc = np.asarray(sel0), np.asarray(fc0)
+    assert (base_sel[: len(pending)] >= 0).any()
+
+    mesh = make_mesh(N_DEV)
+    with mesh, jax.enable_x64(True):
+        nsh = shard_nodes(narr, mesh)
+        csh = replicate(cls_arr, mesh)
+        ash = shard_affinity(aff, mesh)
+        sel, fc, st, rr = gather_place_batch(
+            csh, replicate({"pc": jnp.asarray(pc)}, mesh)["pc"], nsh,
+            node_state(nsh), jnp.uint32(0), prio.DEFAULT_PRIORITIES,
+            aff=ash, aff_mode=mode)
+        sel.block_until_ready()
+    np.testing.assert_array_equal(np.asarray(sel), base_sel)
+    np.testing.assert_array_equal(np.asarray(fc), base_fc)
+    assert int(rr) == int(rr0)
+    np.testing.assert_array_equal(np.asarray(st.requested),
+                                  np.asarray(st0.requested))
+    np.testing.assert_array_equal(np.asarray(st.pod_count),
+                                  np.asarray(st0.pod_count))
+
+
+@pytest.mark.parametrize("seed", [1])
+def test_frozen_affinity_scores_parity_under_mesh(seed):
+    """Wave mode's batch-frozen spread/interpod score matrix [C,N] must be
+    bit-identical sharded vs unsharded."""
+    from kubernetes_tpu.engine.batch import node_state as mk_state
+    from kubernetes_tpu.parallel.mesh import shard_affinity
+
+    nodes, existing, workloads, pending = _affinity_cluster(seed)
+    cls_arr, pc, narr, adata = _affinity_kernel_inputs(
+        nodes, existing, workloads, pending)
+    aff = adata.device_arrays()
+    with jax.enable_x64(True):
+        base = np.asarray(waves.frozen_affinity_scores(
+            cls_arr, narr, mk_state(narr), aff, (2, 1)))
+    mesh = make_mesh(N_DEV)
+    with mesh, jax.enable_x64(True):
+        got = waves.frozen_affinity_scores(
+            replicate(cls_arr, mesh), shard_nodes(narr, mesh),
+            mk_state(shard_nodes(narr, mesh)), shard_affinity(aff, mesh),
+            (2, 1))
+        got.block_until_ready()
+    np.testing.assert_array_equal(np.asarray(got), base)

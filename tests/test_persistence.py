@@ -1,0 +1,226 @@
+"""Durable-store tests: WAL framing, snapshot/restore, compaction, and the
+kill -9 mid-storm recovery story.
+
+Reference parity targets: etcd's WAL+snapshot recovery behind
+storage/etcd3/store.go (:85 New, :257 GuaranteedUpdate CAS),
+cluster/restore-from-backup.sh, and the level-triggered relist resume of
+SURVEY §5.4 (a watcher holding a pre-crash resourceVersion must get
+TooOldResourceVersion and rebuild from a fresh List)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Binding, make_node, make_pod
+from kubernetes_tpu.server.apiserver_lite import (
+    ApiServerLite,
+    TooOldResourceVersion,
+)
+from kubernetes_tpu.server.durable import DurableStore, WriteAheadLog
+
+
+# ------------------------------------------------------------------ WAL unit
+
+
+def test_wal_roundtrip(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = WriteAheadLog(path)
+    recs = [b"alpha", b"x" * 10_000, b""]
+    for r in recs:
+        w.append(r)
+    w.flush()
+    w.close()
+    assert list(WriteAheadLog.replay(path)) == recs
+
+
+def test_wal_torn_tail_stops_cleanly(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = WriteAheadLog(path)
+    w.append(b"first")
+    w.append(b"second-record-payload")
+    w.flush()
+    w.close()
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:  # tear the last record mid-payload
+        f.truncate(size - 7)
+    assert list(WriteAheadLog.replay(path)) == [b"first"]
+
+
+def test_wal_corrupt_crc_stops(tmp_path):
+    path = str(tmp_path / "wal.log")
+    w = WriteAheadLog(path)
+    w.append(b"good")
+    w.append(b"evil")
+    w.flush()
+    w.close()
+    with open(path, "r+b") as f:  # flip a payload byte of the last record
+        f.seek(-1, os.SEEK_END)
+        f.write(b"\xff")
+    assert list(WriteAheadLog.replay(path)) == [b"good"]
+
+
+def test_wal_torn_tail_repaired_on_restore(tmp_path):
+    """Restoring over a torn tail must TRUNCATE it: appending after the tear
+    would bury every post-restart record behind an unreadable frame, so the
+    next restore would silently lose acknowledged writes."""
+    d = str(tmp_path / "data")
+    api = ApiServerLite(data_dir=d)
+    api.create("Pod", make_pod("p1"))
+    api.create("Pod", make_pod("p2"))
+    api.close()
+    wal = os.path.join(d, DurableStore.WAL)
+    with open(wal, "r+b") as f:  # tear the last record
+        f.truncate(os.path.getsize(wal) - 3)
+
+    api2 = ApiServerLite(data_dir=d)  # p2's record torn away
+    pods, _ = api2.list("Pod")
+    assert [p.name for p in pods] == ["p1"]
+    api2.create("Pod", make_pod("p3"))  # appended after the repaired tail
+    api2.close()
+
+    api3 = ApiServerLite(data_dir=d)  # p3 must survive the next replay
+    pods, _ = api3.list("Pod")
+    assert sorted(p.name for p in pods) == ["p1", "p3"]
+
+
+# ------------------------------------------------------- store level restore
+
+
+def test_restore_objects_and_rv(tmp_path):
+    d = str(tmp_path / "data")
+    api = ApiServerLite(data_dir=d)
+    api.create("Node", make_node("n1"))
+    api.create("Pod", make_pod("p1", cpu=100))
+    api.create("Pod", make_pod("p2", cpu=100))
+    api.bind(Binding("p1", "default", "", "n1"))
+    api.delete("Pod", "default", "p2")
+    rv = api.current_rv()
+    api.close()
+
+    api2 = ApiServerLite(data_dir=d)
+    assert api2.current_rv() == rv
+    assert api2.get("Pod", "default", "p1").node_name == "n1"
+    with pytest.raises(Exception):
+        api2.get("Pod", "default", "p2")
+    nodes, _ = api2.list("Node")
+    assert [n.name for n in nodes] == ["n1"]
+    # rv continuity: the next write must move past the restored rv
+    api2.create("Pod", make_pod("p3"))
+    assert api2.current_rv() == rv + 1
+
+
+def test_watch_resume_after_restart_requires_relist(tmp_path):
+    d = str(tmp_path / "data")
+    api = ApiServerLite(data_dir=d)
+    api.create("Node", make_node("n1"))
+    pre_crash_rv = api.current_rv()
+    api.create("Pod", make_pod("p1"))
+    api.close()
+
+    api2 = ApiServerLite(data_dir=d)
+    # a watcher resuming from a pre-restart cursor must be told to relist
+    with pytest.raises(TooOldResourceVersion):
+        api2.watch_since(("Pod", "Node"), pre_crash_rv)
+    # the relist handshake works and yields a valid new cursor
+    pods, rv = api2.list("Pod")
+    assert [p.name for p in pods] == ["p1"]
+    assert api2.watch_since(("Pod",), rv) == []  # current cursor: no events
+    api2.create("Pod", make_pod("p2"))
+    evs = api2.watch_since(("Pod",), rv)
+    assert [e.obj.name for e in evs] == ["p2"]
+
+
+def test_compaction_truncates_wal_and_survives(tmp_path):
+    d = str(tmp_path / "data")
+    api = ApiServerLite(data_dir=d, compact_every=50)
+    for i in range(120):  # crosses the threshold twice
+        api.create("Pod", make_pod(f"p{i}"))
+    assert os.path.exists(os.path.join(d, DurableStore.SNAPSHOT))
+    wal_size = os.path.getsize(os.path.join(d, DurableStore.WAL))
+    # WAL holds only records since the last snapshot, not all 120
+    assert wal_size < 120 * 100
+    api.close()
+    api2 = ApiServerLite(data_dir=d)
+    pods, _ = api2.list("Pod")
+    assert len(pods) == 120
+
+
+def test_in_memory_mode_unchanged(tmp_path):
+    api = ApiServerLite()
+    api.create("Pod", make_pod("p1"))
+    assert api._durable is None
+
+
+# --------------------------------------------------- kill -9 mid-storm (e2e)
+
+
+def test_kill9_midstorm_recovery_and_drain(tmp_path):
+    """The VERDICT-specified story: kill the process mid-storm, restart,
+    every pod reaches bound exactly once, watches resume from a valid rv."""
+    d = str(tmp_path / "data")
+    n_nodes, n_pods = 20, 200
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "_persistence_storm.py"),
+         d, str(n_nodes), str(n_pods)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    # watchdog: a silently-stalled child must fail the test, not hang the
+    # blocking stdout read forever
+    import threading
+    watchdog = threading.Timer(60, proc.kill)
+    watchdog.start()
+    try:
+        # wait until the storm is genuinely mid-flight, then SIGKILL
+        bound = 0
+        for line in proc.stdout:
+            if line.startswith("BOUND"):
+                bound = int(line.split()[1])
+                if bound >= 50:
+                    break
+        assert bound >= 50, "storm subprocess made no progress before 60s"
+        proc.kill()  # SIGKILL — no atexit, no flush, no close
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        watchdog.cancel()
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+
+    # ---- restart: restore every flushed write --------------------------
+    api = ApiServerLite(data_dir=d)
+    pods, rv = api.list("Pod")
+    nodes, _ = api.list("Node")
+    assert len(nodes) == n_nodes
+    assert len(pods) == n_pods, "creates were flushed before the storm"
+    already = [p for p in pods if p.node_name]
+    # every BOUND report the parent saw was flushed batch-wise; the tail
+    # batch may or may not have made it — at-least-reported durability
+    assert len(already) >= min(50, n_pods)
+    assert rv == api.current_rv() > 0
+
+    # ---- a real scheduler drains the rest; no pod is bound twice -------
+    from kubernetes_tpu.engine.scheduler import Scheduler
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    totals = sched.run_until_drained()
+    assert totals["bind_errors"] == 0
+    pods, _ = api.list("Pod")
+    assert all(p.node_name for p in pods)
+    # exactly once: rebinding a bound pod must be refused by the store
+    errs = api.bind_many([Binding(pods[0].name, "default", "", "node-0000")])
+    assert errs[0] is not None and "conflict" in errs[0]
+    api.close()
+
+    # ---- and the restored store is itself durable ----------------------
+    api2 = ApiServerLite(data_dir=d)
+    pods2, _ = api2.list("Pod")
+    assert sorted((p.name, p.node_name) for p in pods2) \
+        == sorted((p.name, p.node_name) for p in pods)
