@@ -241,6 +241,10 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
         if name in _STATIC_PRIORITIES:
             static_score = static_score + \
                 prio.PRIORITY_REGISTRY[name](pods, nodes, None) * weight
+    if "policy_score" in pods:
+        # Policy-configured NodeLabel / ServiceAntiAffinity priorities
+        # (weights pre-folded; ops/policy_algos.py)
+        static_score = static_score + pods["policy_score"]
 
     pd_kind = nodes["pd_kind"]
     pd_max = nodes["pd_max"]

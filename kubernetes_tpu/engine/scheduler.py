@@ -45,6 +45,7 @@ class Scheduler:
                  assumed_ttl: float = 30.0,
                  record_events: bool = True,
                  batch_mode: str = "wave",
+                 policy=None,
                  now=time.monotonic):
         self.api = api
         self.scheduler_name = scheduler_name
@@ -56,9 +57,18 @@ class Scheduler:
         # Service/RC/RS/StatefulSet mirror for spreading & service affinity —
         # the extra informers of factory.go:120-140
         self._workloads: Dict[str, object] = {}
+        # --policy-config-file (factory.go:619 CreateFromConfig): priority
+        # set + parameterized algorithm args come from the Policy when given
+        self._policy_algos = None
+        if policy is not None:
+            from kubernetes_tpu.ops.policy_algos import algorithms_from_policy
+            kernel_prios, self._policy_algos = algorithms_from_policy(policy)
+            if policy.priorities is not None:
+                priorities = kernel_prios
         self.engine = SchedulingEngine(
             self.cache, priorities=priorities,
-            workloads_provider=lambda: list(self._workloads.values()))
+            workloads_provider=lambda: list(self._workloads.values()),
+            policy_algos=self._policy_algos)
         self.queue = SchedulingQueue(now=now)
         self.metrics = SchedulerMetrics()
         self.record_events = record_events
@@ -271,7 +281,8 @@ class Scheduler:
         self._workloads = {}
         self.engine = SchedulingEngine(
             self.cache, priorities=self.engine.priorities,
-            workloads_provider=lambda: list(self._workloads.values()))
+            workloads_provider=lambda: list(self._workloads.values()),
+            policy_algos=self._policy_algos)
         self.queue = SchedulingQueue(now=self._now)
         self._pods = {}
         self._started = False

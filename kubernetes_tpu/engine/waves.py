@@ -106,6 +106,10 @@ def precompute(cls: Arrays, nodes: Arrays,
             continue
         static_score = static_score \
             + prio.PRIORITY_REGISTRY[name](cls, nodes, None) * weight
+    if "policy_score" in cls:
+        # Policy-configured NodeLabel / ServiceAntiAffinity priorities
+        # (weights pre-folded; ops/policy_algos.py)
+        static_score = static_score + cls["policy_score"]
     tt_cnt = jnp.einsum("ct,nt->cn", cls["intolerated_pref"],
                         nodes["taints_pref"].astype(jnp.int8),
                         preferred_element_type=jnp.int32) \

@@ -146,6 +146,11 @@ def pod_fits(pod: Pod, info: NodeInfo, ctx=None, affinity_meta=None) -> bool:
     if ok and ctx is not None:
         from kubernetes_tpu.ops.oracle_ext import inter_pod_affinity_fits
         ok = inter_pod_affinity_fits(pod, node, ctx, affinity_meta)
+    if ok and ctx is not None \
+            and getattr(ctx, "policy_algos", None) is not None \
+            and ctx.policy_algos.active:
+        # Policy-configured ServiceAffinity / NodeLabelPresence
+        ok = ctx.policy_algos.oracle_fit(pod, node, ctx)
     return ok
 
 
@@ -263,6 +268,12 @@ def prioritize(pod: Pod, infos: Sequence[NodeInfo],
             raise KeyError(name)
         for i in range(n):
             totals[i] += per[i] * weight
+    if ctx is not None and getattr(ctx, "policy_algos", None) is not None \
+            and ctx.policy_algos.active:
+        # Policy-configured NodeLabel / ServiceAntiAffinity (weights folded)
+        per = ctx.policy_algos.oracle_scores(pod, infos, ctx)
+        for i in range(n):
+            totals[i] += per[i]
     return totals
 
 

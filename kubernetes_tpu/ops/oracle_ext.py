@@ -45,12 +45,14 @@ class SchedulingContext:
     def __init__(self, infos: Dict[str, NodeInfo],
                  workloads: Sequence[WorkloadObject] = (),
                  hard_pod_affinity_weight: int = 1,
-                 volume_ctx=None):
+                 volume_ctx=None, policy_algos=None):
         self.infos = infos
         self.workloads = list(workloads)
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         # PV/PVC mirror for the volume predicates (state/volumes.VolumeContext)
         self.volume_ctx = volume_ctx
+        # Policy-configured parameterized algorithms (ops/policy_algos.py)
+        self.policy_algos = policy_algos
         self._all_pods: Optional[List[Tuple[Pod, Optional[Node]]]] = None
         self._affinity_pods: Optional[List[Tuple[Pod, Optional[Node]]]] = None
 

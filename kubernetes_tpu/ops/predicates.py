@@ -338,7 +338,7 @@ def static_fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
     safe to reuse across the placement scan because nothing here changes as
     pods commit (labels/taints/host/conditions are node-spec facts)."""
     n = nodes["alloc"].shape[0]
-    return (
+    out = (
         selector_fit(pods, nodes["labels"])
         & taints_fit(pods["intolerated"], nodes["taints_sched"])
         & host_fit(pods["has_host"], pods["host_required"], n)
@@ -349,6 +349,11 @@ def static_fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
         & ~pods["impossible"][:, None]  # ext resource no node advertises /
         # unresolvable PVC (predicate error in the reference)
     )
+    if "policy_fit" in pods:
+        # Policy-configured NodeLabelPresence / ServiceAffinity masks,
+        # precomputed host-side (ops/policy_algos.py)
+        out = out & pods["policy_fit"]
+    return out
 
 
 def fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:

@@ -203,7 +203,10 @@ class TPUExtenderBackend:
         # jax-dependent imports are local so the wire layer stays importable
         # without a TPU runtime
         from kubernetes_tpu.state.cache import SchedulerCache
-        from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+        from kubernetes_tpu.engine.scheduler_engine import (
+            EvalCache,
+            SchedulingEngine,
+        )
         from kubernetes_tpu.utils.metrics import SchedulerMetrics
 
         self.cache = SchedulerCache()
@@ -211,10 +214,15 @@ class TPUExtenderBackend:
         self.metrics = SchedulerMetrics()
         self.binder = binder
         self._known_pods: Dict[str, Pod] = {}
+        # per-request amortization + vocab-growth isolation (EvalCache
+        # docstring; the reference amortizes the same work through its
+        # scheduler cache + equivalence LRU)
+        self.eval_cache = EvalCache()
 
     # -- cache sync ---------------------------------------------------------
 
     def sync_nodes(self, nodes: List[Node]) -> None:
+        self.eval_cache.on_sync()
         seen = set()
         for n in nodes:
             self.cache.update_node(n)
@@ -224,6 +232,7 @@ class TPUExtenderBackend:
                 self.cache.remove_node(name)
 
     def sync_pods(self, pods: List[Pod]) -> None:
+        self.eval_cache.on_sync()
         seen = set()
         for p in pods:
             if not p.node_name:
@@ -264,7 +273,8 @@ class TPUExtenderBackend:
             pod, infos, snap, self.engine.priorities,
             workloads=self.engine.workloads_provider(),
             hard_weight=self.engine.hard_pod_affinity_weight,
-            volume_ctx=self.engine.volume_ctx)
+            volume_ctx=self.engine.volume_ctx,
+            eval_cache=self.eval_cache if nodes is None else None)
         return snap, m, s
 
     def filter(self, pod, nodes, node_names):

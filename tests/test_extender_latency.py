@@ -1,0 +1,132 @@
+"""Extender hot-path: latency + vocab-isolation under adversarial churn.
+
+VERDICT r3 weak #5 / task: /filter + /prioritize at 5k nodes must stay well
+inside the reference's 5s extender budget (core/extender.go:36) under label
+churn — previously every request with a fresh topology key / selector value
+grew the shared snapshot vocab, forcing a full label-matrix rebuild (and a
+recompile at the new width) per request. EvalCache
+(engine/scheduler_engine.py) now isolates request-driven growth: churn pods
+take the exact oracle, their pairs intern in one batch at the next sync.
+
+The hard guarantees tested are STRUCTURAL (snapshot version stability,
+oracle-route and build counters); the wall-clock p99 bound is a generous
+CI-safe ceiling, far under the 5s budget.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorTerm,
+    SelectorOperator,
+    SelectorRequirement,
+    make_pod,
+)
+from kubernetes_tpu.models.hollow import hollow_nodes
+from kubernetes_tpu.server.extender import TPUExtenderBackend
+
+N_NODES = 5000
+BUDGET_S = 5.0  # extender.go:36 default HTTP timeout
+CI_P99_S = 2.5  # generous CPU-CI ceiling, still ~2x under budget
+
+
+@pytest.fixture(scope="module")
+def backend():
+    b = TPUExtenderBackend()
+    nodes = hollow_nodes(N_NODES)
+    for i, n in enumerate(nodes):  # zones so affinity domains exist
+        n.labels["zone"] = f"z{i % 16}"
+    b.sync_nodes(nodes)
+    # warm: first call pays snapshot build + kernel compile
+    b.filter(make_pod("warm", cpu=100), None, None)
+    return b
+
+
+def _churn_pod(i: int):
+    """Fresh never-seen selector key+value every request (adversarial)."""
+    req = SelectorRequirement(key=f"churn-key-{i}",
+                              operator=SelectorOperator.IN,
+                              values=[f"churn-val-{i}"])
+    return make_pod(f"churn-{i}", cpu=100, affinity=Affinity(
+        node_affinity=NodeAffinity(
+            required_terms=[NodeSelectorTerm(match_expressions=[req])])))
+
+
+def test_churn_requests_cannot_force_rebuilds(backend):
+    snap = backend.engine.snapshot
+    v0 = snap.version
+    routes0 = backend.eval_cache.oracle_routes
+    lat = []
+    for i in range(25):
+        t0 = time.perf_counter()
+        passed, failed = backend.filter(_churn_pod(i), None, None)
+        lat.append(time.perf_counter() - t0)
+        assert passed == []  # no node carries the churned label
+        assert len(failed) == N_NODES
+    assert snap.version == v0, \
+        "adversarial churn must not rebuild the shared snapshot"
+    assert backend.eval_cache.oracle_routes == routes0 + 25
+    lat.sort()
+    p99 = lat[int(len(lat) * 0.99)]
+    assert p99 < CI_P99_S < BUDGET_S, f"churn p99 {p99:.3f}s"
+
+
+def test_image_churn_cannot_force_rebuilds(backend):
+    """Container-image names intern into the snapshot too (ImageLocality);
+    image churn must route like label churn, not rebuild per request."""
+    snap = backend.engine.snapshot
+    v0 = snap.version
+    routes0 = backend.eval_cache.oracle_routes
+    for i in range(10):
+        p = make_pod(f"img-{i}", cpu=100)
+        p.containers[0].image = f"registry.example/churn:{i}"
+        passed, _ = backend.filter(p, None, None)
+        assert len(passed) == N_NODES  # image only affects scoring
+    assert snap.version == v0
+    assert backend.eval_cache.oracle_routes == routes0 + 10
+
+
+def test_steady_requests_hit_the_lru(backend):
+    builds0 = backend.eval_cache.builds
+    lat = []
+    for i in range(25):
+        t0 = time.perf_counter()
+        passed, _ = backend.filter(make_pod(f"steady-{i}", cpu=100),
+                                   None, None)
+        lat.append(time.perf_counter() - t0)
+        assert len(passed) == N_NODES
+    # same spec class + same snapshot version -> at most one tensorization
+    assert backend.eval_cache.builds <= builds0 + 1
+    lat.sort()
+    p99 = lat[int(len(lat) * 0.99)]
+    assert p99 < CI_P99_S < BUDGET_S, f"steady p99 {p99:.3f}s"
+
+
+def test_prioritize_scores_under_budget(backend):
+    t0 = time.perf_counter()
+    scores = backend.prioritize(make_pod("prio", cpu=100), None, None)
+    dt = time.perf_counter() - t0
+    assert len(scores) == N_NODES
+    assert dt < BUDGET_S
+    assert {s for _, s in scores} != {0}  # real integer scores, not a stub
+
+
+def test_churned_pairs_intern_in_one_batch_at_next_sync(backend):
+    """The queued churn pairs land in ONE vocab rebuild at the next cache
+    sync, after which an equivalent pod takes the device path."""
+    snap = backend.engine.snapshot
+    assert backend.eval_cache._pending_pairs  # queued by the churn test
+    nodes = backend.cache.node_infos()
+    resync = [info.node for info in nodes.values() if info.node is not None]
+    backend.sync_nodes(resync)
+    routes_before = backend.eval_cache.oracle_routes
+    passed, _ = backend.filter(_churn_pod(0), None, None)
+    assert passed == []  # still fits nothing (no node has the label)
+    # but it went through the device path this time, not the oracle
+    assert backend.eval_cache.oracle_routes == routes_before
+    assert not backend.eval_cache._pending_pairs
