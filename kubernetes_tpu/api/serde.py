@@ -30,6 +30,7 @@ from kubernetes_tpu.api.types import (
     Pod,
     PodAffinity,
     PodAffinityTerm,
+    Probe,
     Resource,
     SelectorOperator,
     SelectorRequirement,
@@ -246,6 +247,20 @@ def encode_volume(v: Volume) -> Dict[str, Any]:
 def decode_pod(obj: Dict[str, Any]) -> Pod:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
+    def _decode_probe(p):
+        if not p:
+            return None
+        kind = "exec"
+        for k in ("httpGet", "tcpSocket", "exec"):
+            if p.get(k) is not None:
+                kind = k
+                break
+        return Probe(kind=kind,
+                     initial_delay_s=float(p.get("initialDelaySeconds", 0)),
+                     period_s=float(p.get("periodSeconds", 10)),
+                     failure_threshold=int(p.get("failureThreshold", 3)),
+                     success_threshold=int(p.get("successThreshold", 1)))
+
     containers = []
     for c in spec.get("containers") or []:
         res = c.get("resources") or {}
@@ -258,6 +273,8 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
                                  container_port=int(p.get("containerPort", 0)),
                                  protocol=p.get("protocol", "TCP"))
                    for p in c.get("ports") or []],
+            liveness_probe=_decode_probe(c.get("livenessProbe")),
+            readiness_probe=_decode_probe(c.get("readinessProbe")),
         ))
     tolerations = []
     for t in spec.get("tolerations") or []:

@@ -345,6 +345,20 @@ class ContainerPort:
 
 
 @dataclass
+class Probe:
+    """v1.Probe reduced to the fields the kubelet's prober reads
+    (reference: pkg/kubelet/prober/prober.go + worker.go). `kind` is the
+    handler type; outcomes in the hollow runtime are driven by pod
+    annotations (nodes/kubelet.py), the way kubemark fakes the runtime."""
+
+    kind: str = "exec"  # exec | httpGet | tcpSocket
+    initial_delay_s: float = 0.0  # InitialDelaySeconds
+    period_s: float = 10.0  # PeriodSeconds
+    failure_threshold: int = 3  # FailureThreshold (worker.go)
+    success_threshold: int = 1  # SuccessThreshold
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -353,6 +367,8 @@ class Container:
     requests: Dict[str, int] = field(default_factory=dict)
     limits: Dict[str, int] = field(default_factory=dict)
     ports: List[ContainerPort] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
 
 
 @dataclass
@@ -376,6 +392,11 @@ class Pod:
     # and endpoints read it.
     phase: str = "Pending"
     restart_policy: str = "Always"  # Always | OnFailure | Never
+    # PodCondition[Ready] (status manager): gates Endpoints membership.
+    # A Running pod with no readiness probe is ready (prober results_manager
+    # defaults); the kubelet flips this from probe outcomes.
+    ready: bool = True
+    restart_count: int = 0  # sum of ContainerStatus.RestartCount
     resource_version: int = 0
     owner_kind: str = ""  # controllerRef: equivalence classes, spreading,
     owner_name: str = ""  # NodePreferAvoidPods

@@ -19,7 +19,12 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from kubernetes_tpu.api.types import LabelSelector, Pod, SelectorRequirement
+from kubernetes_tpu.api.types import (
+    LabelSelector,
+    Pod,
+    SelectorRequirement,
+    WorkloadObject,
+)
 
 
 def stamp_pod(template: Pod, name: str, namespace: str,
@@ -281,6 +286,19 @@ def selector_of(obj) -> LabelSelector:
     if isinstance(sel, LabelSelector):
         return sel
     return LabelSelector(match_labels=dict(sel or {}))
+
+
+def to_workload_object(kind: str, obj) -> WorkloadObject:
+    """Normalize an apiserver workload (Service/RC/RS/StatefulSet) into the
+    scheduler's WorkloadObject view (api/types.py) — the GetPodServices /
+    GetPodControllers lister adaptation. The scheduler's spread/service-
+    affinity code calls .selects(pod), which the raw api objects lack."""
+    sel = selector_of(obj)
+    return WorkloadObject(
+        kind, obj.name, getattr(obj, "namespace", "default"),
+        match_labels=dict(sel.match_labels),
+        match_expressions=list(sel.match_expressions),
+        resource_version=getattr(obj, "resource_version", 0))
 
 
 def pods_matching(obj, pods: List[Pod]) -> List[Pod]:

@@ -66,6 +66,8 @@ class EndpointController(Controller):
             for p in self.pod_informer.store.list():
                 if (p.namespace == namespace and not p.deleted
                         and p.phase == "Running" and p.node_name
+                        and getattr(p, "ready", True)  # readiness gating
+                        # (endpoints_controller.go only lists Ready pods)
                         and all(p.labels.get(k) == v
                                 for k, v in svc.selector.items())):
                     addrs.append(EndpointAddress(

@@ -111,7 +111,10 @@ class ReplicaSetController(Controller):
             self._create_pods(rs, min(diff, BURST_REPLICAS))
         elif diff < 0:
             self._delete_pods(active, -diff)
-        ready = sum(1 for p in active if p.phase == "Running")
+        # IsPodReady, not just phase: a Running pod failing its readiness
+        # probe is not ready (replica_set.go calculateStatus)
+        ready = sum(1 for p in active
+                    if p.phase == "Running" and getattr(p, "ready", True))
         if rs.observed_replicas != len(active) or rs.ready_replicas != ready:
             fresh = self.api.get(self.kind, namespace, name)
             updated = dataclasses.replace(

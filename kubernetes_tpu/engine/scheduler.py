@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Binding, Event, Node, Pod
+from kubernetes_tpu.api.workloads import to_workload_object
 from kubernetes_tpu.engine.queue import SchedulingQueue
 from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
 from kubernetes_tpu.ops import priorities as prio
@@ -91,7 +92,7 @@ class Scheduler:
         for kind in self.WORKLOAD_KINDS:
             for w in self.api.list(kind)[0]:
                 self._workloads[kind + "/" + getattr(w, "namespace", "")
-                                + "/" + w.name] = w
+                                + "/" + w.name] = to_workload_object(kind, w)
         vctx = self.engine.volume_ctx
         for pv in self.api.list("PersistentVolume")[0]:
             vctx.pvs[pv.name] = pv
@@ -135,7 +136,7 @@ class Scheduler:
                 if ev.type == "DELETED":
                     self._workloads.pop(key, None)
                 else:
-                    self._workloads[key] = ev.obj
+                    self._workloads[key] = to_workload_object(ev.kind, ev.obj)
         return len(events)
 
     # ------------------------------------------------------------ scheduling
