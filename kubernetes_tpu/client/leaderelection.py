@@ -171,3 +171,28 @@ class LeaderElector:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+
+    def release(self) -> bool:
+        """Graceful step-down: zero out the lease's renew_time via CAS so a
+        standby can acquire immediately instead of waiting out
+        lease_duration (client-go's later ReleaseOnCancel behavior; 1.7
+        holders just crashed and made standbys wait). Returns True if the
+        lease was released. Fires on_stopped_leading."""
+        was_leading = self._leading
+        self._leading = False
+        released = False
+        try:
+            cur = self.lock.get()
+            if cur.holder == self.identity:
+                self.lock.update(
+                    Lease(name=cur.name, namespace=cur.namespace,
+                          holder="", lease_duration=cur.lease_duration,
+                          acquire_time=0.0, renew_time=0.0,
+                          leader_transitions=cur.leader_transitions),
+                    expect_rv=cur.resource_version)
+                released = True
+        except (Conflict, NotFound):
+            pass
+        if was_leading:
+            self.on_stopped_leading()
+        return released

@@ -35,6 +35,7 @@ from kubernetes_tpu.server.apiserver_lite import (
 )
 from kubernetes_tpu.state.cache import SchedulerCache
 from kubernetes_tpu.utils.metrics import SchedulerMetrics
+from kubernetes_tpu.utils.trace import SCHEDULE_TRACE_THRESHOLD_S, Trace
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
@@ -143,8 +144,11 @@ class Scheduler:
 
     def schedule_round(self, max_batch: int = 0, wait: float = 0.0) -> Dict[str, int]:
         """One batch round: pop ready pods, place on device, bind. Mirrors
-        scheduleOne (scheduler.go:253) over a whole batch."""
+        scheduleOne (scheduler.go:253) over a whole batch, wrapped in a
+        slow-schedule trace (generic_scheduler.go:89-90's 100ms utiltrace)."""
+        trace = Trace("Scheduling round")
         self.sync()
+        trace.step("informer sync done")
         pods = self.queue.pop_batch(max_n=max_batch, wait=wait)
         stats = {"popped": len(pods), "bound": 0, "unschedulable": 0,
                  "bind_errors": 0}
@@ -152,10 +156,12 @@ class Scheduler:
             self.cache.cleanup_assumed()
             self.queue.backoff.gc()
             return stats
+        trace.field("pods", len(pods))
         t0 = time.monotonic()
         results = self.engine.schedule(pods, assume=True,
                                        mode=self.batch_mode)
         t_alg = time.monotonic() - t0
+        trace.step("batch placement computed (device)")
         per_pod_alg = t_alg / max(len(pods), 1)
         placed = []
         for r in results:
@@ -189,6 +195,7 @@ class Scheduler:
             stats["bound"] += 1
             self._event(r.pod, "Normal", "Scheduled",
                         f"Successfully assigned {r.pod.key()} to {r.node_name}")
+        trace.step("bindings written")
         self.cache.finish_bindings_bulk(bound_pods)
         n = len(bound_pods)
         self.metrics.scheduled.inc(n)
@@ -197,6 +204,10 @@ class Scheduler:
         self.metrics.e2e_latency.observe_many(per_pod_alg + per_bind, n)
         self.cache.cleanup_assumed()
         self.queue.backoff.gc()
+        # per-pod amortized threshold: a 30k-pod round is not "slow" the way
+        # a 30k-pod-long one-pod trace would be; scale like the reference's
+        # per-Schedule-call threshold
+        trace.log_if_long(SCHEDULE_TRACE_THRESHOLD_S * max(len(pods), 1))
         return stats
 
     def run_until_drained(self, max_rounds: int = 10_000,

@@ -1,0 +1,57 @@
+"""utiltrace analog: timestamped step traces dumped only when slow.
+
+Mirror of staging/src/k8s.io/apiserver/pkg/util/trace/trace.go:33-90
+(Trace.Step / LogIfLong): callers mark named steps; if the total latency
+exceeds the threshold, the whole step breakdown is emitted — the
+scheduler wraps every Schedule call at a 100ms threshold
+(plugin/pkg/scheduler/core/generic_scheduler.go:89-90).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+LOG = logging.getLogger("kubernetes_tpu.trace")
+
+# the scheduler's slow-schedule threshold (generic_scheduler.go:90)
+SCHEDULE_TRACE_THRESHOLD_S = 0.1
+
+
+class Trace:
+    def __init__(self, name: str, now: Callable[[], float] = time.monotonic,
+                 sink: Optional[Callable[[str], None]] = None, **fields):
+        self.name = name
+        self._now = now
+        self._start = now()
+        self._steps: List[Tuple[float, str]] = []
+        self._sink = sink or (lambda msg: LOG.info("%s", msg))
+        self._fields = fields
+
+    def step(self, msg: str) -> None:
+        self._steps.append((self._now(), msg))
+
+    def field(self, key: str, value) -> None:
+        """Attach a context field learned after construction (shown in the
+        dump header)."""
+        self._fields[key] = value
+
+    def total(self) -> float:
+        return self._now() - self._start
+
+    def log_if_long(self, threshold_s: float) -> bool:
+        """Emit the breakdown when total exceeds threshold (trace.go:57
+        LogIfLong). Returns True if dumped."""
+        total = self.total()
+        if total < threshold_s:
+            return False
+        fields = "".join(f" {k}={v}" for k, v in self._fields.items())
+        lines = [f'Trace "{self.name}"{fields} (total {total * 1e3:.1f}ms):']
+        last = self._start
+        for t, msg in self._steps:
+            lines.append(f'  [{(t - self._start) * 1e3:.1f}ms] '
+                         f'(+{(t - last) * 1e3:.1f}ms) {msg}')
+            last = t
+        self._sink("\n".join(lines))
+        return True

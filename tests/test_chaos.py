@@ -1,0 +1,264 @@
+"""Chaos: kill components mid-storm, assert drain-to-bound convergence.
+
+The reference's recovery claims (SURVEY §5.3/§5.4: everything is
+level-triggered reconcile — controllers re-list on restart, the scheduler
+rebuilds its cache from informers, assumed-pod TTL self-heals, leader
+election gives active/passive HA) exercised the chaosmonkey way
+(test/e2e/chaosmonkey/chaosmonkey.go, test/e2e/network_partition.go):
+
+  - scheduler killed mid-storm -> replacement converges, no double binds
+  - leading daemon crashes WITHOUT releasing its lease -> standby waits
+    out the lease and finishes the drain (server.go:127-146 failover)
+  - kubelets die mid-storm -> nodelifecycle marks NotReady and evicts;
+    pods reschedule onto surviving nodes
+  - watch stream compacted under the scheduler's feet
+    (TooOldResourceVersion) -> relist, converge
+  - apiserver process "crash" + restart from WAL mid-storm -> converge
+
+Invariant after every storm: every pod bound exactly once — the store
+refuses double binds, so bind_errors==0 plus all-bound is exactly-once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from kubernetes_tpu.api.types import ConditionStatus, make_node, make_pod
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.nodes.kubelet import HollowFleet
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.server.daemon import SchedulerDaemon, SchedulerOptions
+from kubernetes_tpu.testing.chaosmonkey import Chaosmonkey, Test
+from tests.test_nodes import FakeClock
+
+Gi = 1 << 30
+
+
+def _cluster(api, n_nodes=30, n_pods=300, cpu=4000):
+    for i in range(n_nodes):
+        api.create("Node", make_node(f"node-{i:03d}", cpu=cpu,
+                                     memory=8 * Gi))
+    for i in range(n_pods):
+        api.create("Pod", make_pod(f"pod-{i:04d}", cpu=100))
+
+
+def _assert_converged(api, n_pods, runnable=None):
+    pods, _ = api.list("Pod")
+    assert len(pods) == n_pods
+    unbound = [p.name for p in pods if not p.node_name]
+    assert not unbound, f"{len(unbound)} pods never bound: {unbound[:5]}"
+    if runnable is not None:
+        for p in pods:
+            assert p.node_name in runnable, \
+                f"{p.name} on dead node {p.node_name}"
+
+
+def test_scheduler_killed_midstorm_replacement_converges():
+    api = ApiServerLite()
+    _cluster(api, n_pods=300)
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    # schedule part of the storm, then the scheduler "dies"
+    sched.schedule_round(max_batch=120)
+    bound_before = sum(1 for p in api.list("Pod")[0] if p.node_name)
+    assert 0 < bound_before < 300
+    del sched
+
+    def disruption():
+        pass  # the kill already happened; monkey verifies recovery
+
+    cm = Chaosmonkey(disruption)
+    outcome = {}
+
+    def run_replacement():
+        sched2 = Scheduler(api, record_events=False)
+        sched2.start()  # fresh relist: sees bound pods + the rest pending
+        outcome.update(sched2.run_until_drained())
+
+    cm.register(Test(test=run_replacement, name="replacement-scheduler"))
+    cm.do()
+    assert outcome["bind_errors"] == 0  # no double binds attempted
+    _assert_converged(api, 300)
+
+
+def test_daemon_failover_after_leader_crash():
+    """Two daemon instances; the leader crashes WITHOUT releasing its
+    lease mid-storm. The standby must wait out lease_duration, acquire,
+    relist, and finish the drain."""
+    clock = FakeClock()
+    api = ApiServerLite()
+    _cluster(api, n_pods=0)  # nodes only; the storm lands mid-flight
+    opts = SchedulerOptions(healthz_port=None)
+    a = SchedulerDaemon(api, "daemon-a", opts, now=clock)
+    b = SchedulerDaemon(api, "daemon-b", opts, now=clock)
+    a.step()  # a acquires
+    b.step()
+    assert a.is_leader() and not b.is_leader()
+    for i in range(240):
+        api.create("Pod", make_pod(f"pod-{i:04d}", cpu=100))
+    a.scheduler.schedule_round(max_batch=100)
+    bound_mid = sum(1 for p in api.list("Pod")[0] if p.node_name)
+    assert 0 < bound_mid < 240
+
+    def crash_leader():
+        a.stop(release=False)  # hard kill: lease NOT released
+
+    cm = Chaosmonkey(crash_leader)
+
+    def standby_takes_over():
+        # within the lease the standby must NOT lead
+        b.step()
+        assert not b.is_leader()
+        clock.t += 16.0  # > lease_duration 15s
+        for _ in range(50):
+            stats = b.step()
+            if b.is_leader() and stats["popped"] == 0 \
+                    and b.scheduler.queue.ready_count() == 0:
+                break
+        assert b.is_leader()
+
+    cm.register(Test(test=standby_takes_over, name="standby-failover"))
+    cm.do()
+    _assert_converged(api, 240)
+    lease = api.get("Lease", "kube-system", "kube-scheduler")
+    assert lease.holder == "daemon-b"
+    assert lease.leader_transitions == 1
+    b.stop()
+
+
+def test_kubelet_deaths_midstorm_reschedule_elsewhere():
+    """Kill a third of the kubelets mid-storm: nodelifecycle marks them
+    NotReady after the grace period and evicts their pods; the scheduler
+    reschedules onto survivors (network_partition.go's node-death story)."""
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+    )
+
+    from kubernetes_tpu.api.types import LabelSelector
+    from kubernetes_tpu.api.workloads import ReplicaSet
+    from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+
+    clock = FakeClock()
+    api = ApiServerLite()
+    factory = SharedInformerFactory(api)
+    fleet = HollowFleet(api, factory, now=clock)
+    n_nodes, n_pods = 12, 120
+    for i in range(n_nodes):
+        fleet.add_node(make_node(f"node-{i:03d}", cpu=32_000, memory=64 * Gi))
+    # the storm is an RC-managed workload, so evicted pods are REPLACED and
+    # rescheduled (the reference's node-death story needs the controller)
+    api.create("ReplicaSet", ReplicaSet(
+        "web", replicas=n_pods,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=make_pod("", cpu=100, labels={"app": "web"})))
+    nlc = NodeLifecycleController(api, factory, now=clock,
+                                  eviction_timeout=60.0,  # shorten the 5min
+                                  # default so the sim converges in few ticks
+                                  record_events=False)
+    rsc = ReplicaSetController(api, factory, record_events=False)
+    sched = Scheduler(api, record_events=False, now=clock)
+    sched.start()
+    factory.step_all()
+    rsc.pump()
+    sched.run_until_drained()
+    factory.step_all()
+    fleet.step()  # pods running
+
+    dead = [f"node-{i:03d}" for i in range(0, n_nodes, 3)]
+
+    def kill_kubelets():
+        for name in dead:
+            del fleet.kubelets[name]  # process gone: no more heartbeats
+
+    cm = Chaosmonkey(kill_kubelets)
+
+    def cluster_heals():
+        # heartbeats for survivors only; grace period passes for the dead,
+        # then the rate-limited eviction drains them over several ticks
+        for _ in range(30):
+            clock.t += 10.0
+            fleet.heartbeat_all()
+            factory.step_all()
+            nlc.monitor_tick()
+            nlc.pump()
+            rsc.pump()
+            sched.sync()
+            sched.schedule_round()
+            factory.step_all()
+            fleet.step()
+        ready = {n.name for n in api.list("Node")[0]
+                 if n.condition("Ready") == ConditionStatus.TRUE}
+        for name in dead:
+            assert name not in ready, f"dead {name} still Ready"
+
+    cm.register(Test(test=cluster_heals, name="node-death-heal"))
+    cm.do()
+    # convergence: the RS is back to full strength, every replacement
+    # runs on a surviving node, nothing Running remains on a dead one
+    pods = [p for p in api.list("Pod")[0] if not p.deleted]
+    running = [p for p in pods if p.phase == "Running"]
+    assert len(running) >= n_pods
+    for p in running:
+        assert p.node_name not in dead
+
+
+def test_watch_compaction_forces_relist_and_converges():
+    """A tiny event log + a flood of writes while the scheduler lags ->
+    TooOldResourceVersion on its next sync -> full relist -> drain."""
+    api = ApiServerLite(max_log=50)
+    _cluster(api, n_nodes=10, n_pods=60)
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    sched.schedule_round(max_batch=20)
+
+    def flood():
+        # unrelated churn blows the 50-event log out from under the cursor
+        for i in range(200):
+            api.create("Pod", make_pod(f"noise-{i:03d}", cpu=1,
+                                       node_name="node-000"))
+
+    cm = Chaosmonkey(flood)
+    cm.register(Test(
+        test=lambda: sched.run_until_drained(), name="relist-converge"))
+    cm.do()
+    pods, _ = api.list("Pod")
+    storm = [p for p in pods if p.name.startswith("pod-")]
+    assert all(p.node_name for p in storm)
+    assert len(storm) == 60
+
+
+def test_apiserver_crash_restart_midstorm(tmp_path):
+    """Durable apiserver dies mid-storm (nothing flushed beyond the WAL);
+    a new process restores and a new scheduler converges — the
+    restore-from-backup.sh + relist story, from the chaos angle."""
+    d = str(tmp_path / "data")
+    api = ApiServerLite(data_dir=d)
+    _cluster(api, n_pods=200)
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    sched.schedule_round(max_batch=80)
+
+    state = {}
+
+    def crash_and_restore():
+        # drop both objects without close(): batch-flushed WAL survives
+        state["api"] = ApiServerLite(data_dir=d)
+
+    cm = Chaosmonkey(crash_and_restore)
+
+    def converge():
+        api2 = state["api"]
+        pods, _ = api2.list("Pod")
+        assert len(pods) == 200
+        assert sum(1 for p in pods if p.node_name) >= 80
+        sched2 = Scheduler(api2, record_events=False)
+        sched2.start()
+        totals = sched2.run_until_drained()
+        assert totals["bind_errors"] == 0
+
+    cm.register(Test(test=converge, name="apiserver-restart"))
+    cm.do()
+    _assert_converged(state["api"], 200)

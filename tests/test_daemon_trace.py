@@ -1,0 +1,154 @@
+"""Scheduler daemon composition + slow-schedule tracing.
+
+Daemon: plugin/cmd/kube-scheduler/app/server.go:67 (healthz + metrics +
+leader election + policy flags). Trace: the 100ms utiltrace dump of
+core/generic_scheduler.go:89-90 / trace.go:33-90.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.server.daemon import SchedulerDaemon, SchedulerOptions
+from kubernetes_tpu.utils.trace import Trace
+
+
+# -------------------------------------------------------------------- trace
+
+
+def test_trace_dumps_only_when_slow():
+    t = {"now": 0.0}
+    out = []
+    tr = Trace("Scheduling round", now=lambda: t["now"],
+               sink=out.append, pods=3)
+    t["now"] = 0.02
+    tr.step("informer sync done")
+    t["now"] = 0.05
+    tr.step("batch placement computed (device)")
+    assert tr.log_if_long(0.1) is False and out == []  # fast: silent
+    t["now"] = 0.31
+    tr.step("bindings written")
+    assert tr.log_if_long(0.1) is True
+    dump = out[0]
+    assert 'Trace "Scheduling round" pods=3' in dump
+    assert "informer sync done" in dump and "(+30.0ms)" in dump
+    assert "bindings written" in dump
+
+
+def test_scheduler_round_emits_trace_when_over_threshold(monkeypatch):
+    """The wired-in trace fires for a genuinely slow round."""
+    import kubernetes_tpu.engine.scheduler as sched_mod
+
+    api = ApiServerLite()
+    api.create("Node", make_node("n0"))
+    api.create("Pod", make_pod("p0", cpu=100))
+    sched = sched_mod.Scheduler(api, record_events=False)
+    sched.start()
+    dumps = []
+    real_trace = sched_mod.Trace
+    monkeypatch.setattr(
+        sched_mod, "Trace",
+        lambda name, **kw: real_trace(name, sink=dumps.append, **kw))
+    # force slowness: a schedule call that "takes" 5s via a patched engine
+    real_schedule = sched.engine.schedule
+
+    def slow_schedule(*a, **kw):
+        import time as _t
+        r = real_schedule(*a, **kw)
+        _t.sleep(0.15)  # > 0.1s-per-pod threshold for a 1-pod round
+        return r
+
+    sched.engine.schedule = slow_schedule
+    sched.schedule_round()
+    assert len(dumps) == 1
+    assert "batch placement computed (device)" in dumps[0]
+
+
+# ------------------------------------------------------------------- daemon
+
+
+def test_daemon_healthz_metrics_and_leader_endpoints():
+    api = ApiServerLite()
+    for i in range(4):
+        api.create("Node", make_node(f"n{i}"))
+    for i in range(8):
+        api.create("Pod", make_pod(f"p{i}", cpu=100))
+    d = SchedulerDaemon(api, "me", SchedulerOptions(healthz_port=0))
+    try:
+        d.step()  # acquire + schedule
+        port = d.healthz_port
+        assert port
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.read().decode()
+
+        assert get("/healthz") == "ok"
+        assert get("/leader") == "true"
+        metrics = get("/metrics")
+        assert "scheduler" in metrics  # prometheus text with histograms
+        pods, _ = api.list("Pod")
+        assert all(p.node_name for p in pods)
+    finally:
+        d.stop()
+
+
+def test_daemon_policy_config_file(tmp_path):
+    policy_file = tmp_path / "policy.json"
+    policy_file.write_text(json.dumps({
+        "predicates": [
+            {"name": "GeneralPredicates"},
+            {"name": "P", "argument": {"labelsPresence":
+                                       {"labels": ["ok"], "presence": True}}},
+        ],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+    }))
+    api = ApiServerLite()
+    api.create("Node", make_node("labeled", labels={"ok": "1"}))
+    api.create("Node", make_node("bare"))
+    for i in range(4):
+        api.create("Pod", make_pod(f"p{i}", cpu=100))
+    d = SchedulerDaemon(
+        api, "me", SchedulerOptions(healthz_port=None, leader_elect=False,
+                                    policy_config_file=str(policy_file)))
+    try:
+        for _ in range(3):
+            d.step()
+        pods, _ = api.list("Pod")
+        assert all(p.node_name == "labeled" for p in pods)
+    finally:
+        d.stop()
+
+
+def test_daemon_demo_main(capsys):
+    from kubernetes_tpu.server.daemon import main
+    main(["--nodes", "10", "--pods", "40"])
+    out = capsys.readouterr().out
+    assert "bound=40/40" in out
+    assert "leader=daemon-a" in out
+
+
+def test_daemon_graceful_stop_releases_lease_for_immediate_handoff():
+    """Graceful stop (release=True) zeroes the lease so the standby
+    acquires WITHOUT waiting out lease_duration — contrast with the crash
+    path in tests/test_chaos.py::test_daemon_failover_after_leader_crash."""
+    from tests.test_nodes import FakeClock
+
+    clock = FakeClock()
+    api = ApiServerLite()
+    api.create("Node", make_node("n0"))
+    opts = SchedulerOptions(healthz_port=None)
+    a = SchedulerDaemon(api, "a", opts, now=clock)
+    b = SchedulerDaemon(api, "b", opts, now=clock)
+    a.step()
+    b.step()
+    assert a.is_leader() and not b.is_leader()
+    a.stop(release=True)
+    assert api.get("Lease", "kube-system", "kube-scheduler").holder == ""
+    b.step()  # NO clock advance needed
+    assert b.is_leader()
+    b.stop()
