@@ -140,9 +140,15 @@ class Ktctl:
     """The CLI against an in-process ApiServer (tests, single binary) or a
     remote REST endpoint (via RestClient below)."""
 
-    def __init__(self, api: ApiServer, out=None):
+    def __init__(self, api: ApiServer, out=None, federation=None,
+                 federation_contexts=None):
         self.api = api
         self.out = out if out is not None else sys.stdout
+        # kubefed mode (cmd_federate): `federation` is a
+        # FederationControlPlane, `federation_contexts` maps cluster name ->
+        # member ApiServer (the kubeconfig-contexts analog kubefed joins by)
+        self.federation = federation
+        self.federation_contexts = federation_contexts or {}
 
     def _print(self, s: str) -> None:
         self.out.write(s + "\n")
@@ -406,6 +412,79 @@ class Ktctl:
         for kind, (res, cluster) in sorted(KIND_INFO.items(),
                                            key=lambda kv: kv[1][0]):
             self._print(f"{res}  {kind}  {str(not cluster).lower()}")
+
+    def cmd_federate(self, args):
+        """kubefed verbs (federation/cmd kubefed + federated-RS CRUD):
+        federate join <cluster> | unjoin <cluster> | clusters |
+        federate create rs <name> --replicas N [--preferences JSON]
+                 [--cpu m] [--selector k=v] | scale rs <name> --replicas N |
+        federate get | sync"""
+        if self.federation is None:
+            raise SystemExit("error: no federation control plane configured")
+        from kubernetes_tpu.api.types import LabelSelector, make_pod
+        from kubernetes_tpu.api.workloads import ReplicaSet
+        from kubernetes_tpu.federation.controller import (
+            FEDERATED_RS_KIND,
+            FederatedReplicaSet,
+            FederatedReplicaSetController,
+        )
+        from kubernetes_tpu.federation.planner import PREFERENCES_ANNOTATION
+
+        pos, flags = self._flags(list(args))
+        if not pos:
+            raise SystemExit("error: federate verb required")
+        verb = pos[0]
+        plane = self.federation
+        if verb == "join":
+            name = pos[1]
+            if name not in self.federation_contexts:
+                raise SystemExit(f"error: unknown cluster context {name!r}")
+            plane.join(name, self.federation_contexts[name])
+            self._print(f"cluster/{name} joined")
+        elif verb == "unjoin":
+            plane.unjoin(pos[1])
+            self._print(f"cluster/{pos[1]} unjoined")
+        elif verb == "clusters":
+            for c in plane.api.list("Cluster")[0]:
+                state = "Ready" if c.ready and c.name in plane.members \
+                    else "NotReady"
+                self._print(f"{c.name}\t{state}")
+        elif verb == "create" and pos[1:2] == ["rs"]:
+            name = pos[2]
+            ns = flags.get("namespace", "default")
+            sel = dict(kv.split("=", 1)
+                       for kv in flags.get("selector", f"app={name}").split(","))
+            tmpl_pod = make_pod("", namespace=ns, labels=dict(sel),
+                                cpu=int(flags.get("cpu", 100)))
+            frs = FederatedReplicaSet(
+                name=name, namespace=ns,
+                replicas=int(flags.get("replicas", 1)),
+                template=ReplicaSet(
+                    name=name, namespace=ns,
+                    selector=LabelSelector(match_labels=dict(sel)),
+                    template=tmpl_pod))
+            if flags.get("preferences"):
+                frs.annotations[PREFERENCES_ANNOTATION] = flags["preferences"]
+            plane.api.create(FEDERATED_RS_KIND, frs)
+            self._print(f"federatedreplicaset/{name} created")
+        elif verb == "scale" and pos[1:2] == ["rs"]:
+            ns = flags.get("namespace", "default")
+            cur = plane.api.get(FEDERATED_RS_KIND, ns, pos[2])
+            import dataclasses as _dc
+            plane.api.update(FEDERATED_RS_KIND, _dc.replace(
+                cur, replicas=int(flags["replicas"])),
+                expect_rv=cur.resource_version)
+            self._print(f"federatedreplicaset/{pos[2]} scaled")
+        elif verb == "get":
+            for frs in plane.api.list(FEDERATED_RS_KIND)[0]:
+                self._print(f"{frs.namespace}/{frs.name}\t"
+                            f"replicas={frs.replicas}\t"
+                            f"ready={frs.ready_replicas}")
+        elif verb == "sync":
+            FederatedReplicaSetController(plane).sync_all()
+            self._print("synced")
+        else:
+            raise SystemExit(f"error: unknown federate verb {verb!r}")
 
     def cmd_version(self, args):
         self._print("Client Version: v1.7.0-tpu.0")

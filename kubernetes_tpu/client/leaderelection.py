@@ -108,7 +108,9 @@ class LeaderElector:
             return True
 
         if cur.holder != self.identity:
-            if now < cur.renew_time + cur.lease_duration:
+            # an empty holder is a gracefully released lease (release());
+            # only a live NAMED holder blocks acquisition
+            if cur.holder and now < cur.renew_time + cur.lease_duration:
                 return False  # current leader is live
             # lease expired: steal, bumping transitions
             lease = Lease(name=cur.name, namespace=cur.namespace,

@@ -152,3 +152,20 @@ def test_daemon_graceful_stop_releases_lease_for_immediate_handoff():
     b.step()  # NO clock advance needed
     assert b.is_leader()
     b.stop()
+
+
+def test_released_lease_acquirable_even_at_small_clock_values():
+    """Regression (review): holder=="" must read as free even when
+    now < lease_duration — a FakeClock at t=1 or a freshly booted
+    monotonic clock must not have to wait out a phantom lease."""
+    from kubernetes_tpu.client.leaderelection import LeaderElector, LeaseLock
+    from tests.test_nodes import FakeClock
+
+    clock = FakeClock(t=1.0)  # below the 15s lease_duration
+    api = ApiServerLite()
+    lock = LeaseLock(api, "kube-scheduler")
+    a = LeaderElector(lock, "a", now=clock)
+    b = LeaderElector(lock, "b", now=clock)
+    assert a.step() is True
+    a.release()
+    assert b.step() is True, "released lease must be immediately acquirable"

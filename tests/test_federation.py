@@ -1,0 +1,242 @@
+"""Federation (L9): planner semantics, federated-RS sync, cluster loss,
+kubefed-style CLI verbs.
+
+Reference targets: the replica planner
+(federation/pkg/federation-controller/util/planner/planner.go), the
+federated ReplicaSet type adapter + scheduling
+(federation/pkg/federatedtypes/{replicaset,scheduling}.go), and kubefed
+join/unjoin. Two in-process member clusters each run a real
+ReplicaSetController + Scheduler, so a federated workload ends as bound
+pods in both — and re-balances when a cluster dies (VERDICT r3 #8:
+10 replicas spread 5/5, re-balanced on cluster loss)."""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from kubernetes_tpu.api.types import LabelSelector, make_node, make_pod
+from kubernetes_tpu.api.workloads import ReplicaSet
+from kubernetes_tpu.cli.ktctl import Ktctl
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.federation.controller import (
+    FEDERATED_RS_KIND,
+    FederatedReplicaSet,
+    FederatedReplicaSetController,
+    FederationControlPlane,
+)
+from kubernetes_tpu.federation.planner import (
+    PREFERENCES_ANNOTATION,
+    ClusterPreferences,
+    Planner,
+    ReplicaAllocationPreferences,
+)
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+Gi = 1 << 30
+
+
+# ------------------------------------------------------------------ planner
+
+
+def _prefs(rebalance=False, **clusters):
+    return ReplicaAllocationPreferences(
+        rebalance=rebalance,
+        clusters={k: v for k, v in clusters.items()})
+
+
+def test_planner_even_split():
+    plan, overflow = Planner(_prefs(
+        **{"*": ClusterPreferences(weight=1)})).plan(
+            10, ["a", "b"], key="default/web")
+    assert plan == {"a": 5, "b": 5}
+    assert overflow == {}
+
+
+def test_planner_weighted():
+    plan, _ = Planner(_prefs(
+        a=ClusterPreferences(weight=3),
+        b=ClusterPreferences(weight=1))).plan(8, ["a", "b"])
+    assert plan == {"a": 6, "b": 2}
+
+
+def test_planner_min_replicas_take_priority():
+    plan, _ = Planner(_prefs(
+        a=ClusterPreferences(min_replicas=4, weight=0),
+        b=ClusterPreferences(weight=1))).plan(6, ["a", "b"])
+    assert plan == {"a": 4, "b": 2}
+
+
+def test_planner_max_replicas_cap():
+    plan, _ = Planner(_prefs(
+        a=ClusterPreferences(weight=1, max_replicas=2),
+        b=ClusterPreferences(weight=1))).plan(10, ["a", "b"])
+    assert plan == {"a": 2, "b": 8}
+
+
+def test_planner_capacity_overflow():
+    plan, overflow = Planner(_prefs(
+        rebalance=True, **{"*": ClusterPreferences(weight=1)})).plan(
+            10, ["a", "b"], capacity={"a": 2})
+    assert plan == {"a": 2, "b": 8}
+    assert overflow.get("a", 0) >= 1  # a wanted more than its capacity
+
+
+def test_planner_no_rebalance_keeps_current_layout():
+    """rebalance=false: cluster b keeps its 7 even though an even split
+    would say 5/5 (the anti-thrash preallocation, planner.go:116-140)."""
+    plan, _ = Planner(_prefs(
+        **{"*": ClusterPreferences(weight=1)})).plan(
+            10, ["a", "b"], current={"b": 7})
+    assert plan == {"a": 3, "b": 7}
+
+
+def test_planner_unlisted_cluster_without_wildcard_gets_zero():
+    plan, _ = Planner(_prefs(a=ClusterPreferences(weight=1))).plan(
+        5, ["a", "b"])
+    assert plan == {"a": 5, "b": 0}
+
+
+def test_planner_preferences_json_wire_format():
+    p = ReplicaAllocationPreferences.parse(json.dumps({
+        "rebalance": True,
+        "clusters": {"*": {"weight": 2, "minReplicas": 1,
+                           "maxReplicas": 9}}}))
+    assert p.rebalance is True
+    assert p.clusters["*"] == ClusterPreferences(1, 9, 2)
+
+
+# --------------------------------------------------------- two-cluster rig
+
+
+class _MemberCluster:
+    """A real member: apiserver + RS controller + scheduler."""
+
+    def __init__(self, name: str, n_nodes: int = 4):
+        self.name = name
+        self.api = ApiServerLite()
+        for i in range(n_nodes):
+            self.api.create("Node", make_node(f"{name}-node-{i}",
+                                              cpu=8000, memory=16 * Gi))
+        self.factory = SharedInformerFactory(self.api)
+        self.rsc = ReplicaSetController(self.api, self.factory,
+                                        record_events=False)
+        self.sched = Scheduler(self.api, record_events=False)
+        self.sched.start()
+
+    def reconcile(self):
+        self.factory.step_all()
+        self.rsc.pump()
+        self.sched.run_until_drained()
+
+    def bound_pods(self):
+        return [p for p in self.api.list("Pod")[0]
+                if p.node_name and not p.deleted]
+
+
+def _federated_rig():
+    plane = FederationControlPlane()
+    a, b = _MemberCluster("alpha"), _MemberCluster("beta")
+    plane.join("alpha", a.api)
+    plane.join("beta", b.api)
+    ctrl = FederatedReplicaSetController(plane)
+    return plane, ctrl, a, b
+
+
+def _mk_frs(replicas=10, prefs=None):
+    tmpl = ReplicaSet(
+        name="web", selector=LabelSelector(match_labels={"app": "web"}),
+        template=make_pod("", cpu=100, labels={"app": "web"}))
+    frs = FederatedReplicaSet(name="web", replicas=replicas, template=tmpl)
+    if prefs:
+        frs.annotations[PREFERENCES_ANNOTATION] = prefs
+    return frs
+
+
+def test_federated_rs_spreads_5_5_and_runs_in_both_clusters():
+    plane, ctrl, a, b = _federated_rig()
+    plane.api.create(FEDERATED_RS_KIND, _mk_frs(10))
+    ctrl.sync_all()
+    assert a.api.get("ReplicaSet", "default", "web").replicas == 5
+    assert b.api.get("ReplicaSet", "default", "web").replicas == 5
+    a.reconcile()
+    b.reconcile()
+    assert len(a.bound_pods()) == 5
+    assert len(b.bound_pods()) == 5
+    # status aggregation on the next sync
+    a.factory.step_all(); a.rsc.pump()
+    b.factory.step_all(); b.rsc.pump()
+    ctrl.sync_all()
+    frs = plane.api.get(FEDERATED_RS_KIND, "default", "web")
+    assert frs.ready_replicas == 0  # pods Pending (no kubelet in this rig)
+
+
+def test_rebalance_on_cluster_loss():
+    """beta dies -> next sync moves all 10 replicas to alpha (done
+    condition of VERDICT r3 #8)."""
+    plane, ctrl, a, b = _federated_rig()
+    plane.api.create(FEDERATED_RS_KIND, _mk_frs(
+        10, prefs=json.dumps({"rebalance": True,
+                              "clusters": {"*": {"weight": 1}}})))
+    ctrl.sync_all()
+    assert a.api.get("ReplicaSet", "default", "web").replicas == 5
+    plane.mark_ready("beta", False)  # cluster controller saw it die
+    ctrl.sync_all()
+    assert a.api.get("ReplicaSet", "default", "web").replicas == 10
+    a.reconcile()
+    assert len(a.bound_pods()) == 10
+    # recovery: beta comes back, replicas spread again
+    plane.mark_ready("beta", True)
+    ctrl.sync_all()
+    assert a.api.get("ReplicaSet", "default", "web").replicas == 5
+    assert b.api.get("ReplicaSet", "default", "web").replicas == 5
+
+
+def test_unjoin_deregisters_and_replicas_move():
+    plane, ctrl, a, b = _federated_rig()
+    plane.api.create(FEDERATED_RS_KIND, _mk_frs(
+        10, prefs=json.dumps({"rebalance": True,
+                              "clusters": {"*": {"weight": 1}}})))
+    ctrl.sync_all()
+    plane.unjoin("beta")
+    ctrl.sync_all()
+    assert a.api.get("ReplicaSet", "default", "web").replicas == 10
+    # beta keeps nothing federated-owned after unjoin sync? the reference
+    # leaves unjoined clusters' objects alone (unjoin is deregistration) —
+    # the child RS simply stops being reconciled
+    assert "beta" not in plane.members
+
+
+# ---------------------------------------------------------------- kubefed
+
+
+def test_ktctl_federate_verbs_end_to_end():
+    plane = FederationControlPlane()
+    a, b = _MemberCluster("alpha"), _MemberCluster("beta")
+    out = io.StringIO()
+    kt = Ktctl(plane.api, out=out, federation=plane,
+               federation_contexts={"alpha": a.api, "beta": b.api})
+    assert kt.run(["federate", "join", "alpha"]) == 0
+    assert kt.run(["federate", "join", "beta"]) == 0
+    assert kt.run(["federate", "create", "rs", "web",
+                   "--replicas", "10"]) == 0
+    assert kt.run(["federate", "sync"]) == 0
+    assert a.api.get("ReplicaSet", "default", "web").replicas == 5
+    assert b.api.get("ReplicaSet", "default", "web").replicas == 5
+    assert kt.run(["federate", "scale", "rs", "web",
+                   "--replicas", "16"]) == 0
+    assert kt.run(["federate", "sync"]) == 0
+    assert a.api.get("ReplicaSet", "default", "web").replicas \
+        + b.api.get("ReplicaSet", "default", "web").replicas == 16
+    assert kt.run(["federate", "clusters"]) == 0
+    assert kt.run(["federate", "get"]) == 0
+    text = out.getvalue()
+    assert "alpha\tReady" in text and "beta\tReady" in text
+    assert "default/web" in text
+    assert kt.run(["federate", "unjoin", "beta"]) == 0
+    assert kt.run(["federate", "sync"]) == 0
+    assert a.api.get("ReplicaSet", "default", "web").replicas == 16
