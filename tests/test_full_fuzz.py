@@ -1,0 +1,327 @@
+"""Full-surface differential fuzz: every registered predicate + priority,
+randomized clusters, strict bit-match device engine vs object-level oracle.
+
+VERDICT r3 #10 / the reference's table scale (predicates_test.go, 3,661
+lines): tests/helpers.py's generators covered resources/selectors/taints/
+ports/node-affinity; this suite extends the random surface to
+
+  - overlay/scratch storage requests vs nodes with and without overlay
+    (predicates.go:576-604 fallback) and extended resources
+  - direct-source volumes: GCE-PD / EBS / RBD / ISCSI / inert OTHER,
+    read-only vs read-write (NoDiskConflict) and the MaxPDVolumeCount
+    filters, seeded by EXISTING bound pods carrying volumes
+  - preferred node affinity (NodeAffinityPriority weights)
+  - container images on nodes (ImageLocalityPriority 23MB-1GB window)
+  - preferAvoidPods annotations vs controller-owned pending pods
+  - best-effort pods vs MemoryPressure nodes, pressure conditions
+  - existing bound pods seeding capacity/ports/nonzero sums
+
+and runs the whole DEFAULT priority battery (+ MostRequested for the
+autoscaler provider) through sequential strict placement, asserting the
+device engine reproduces the oracle's node choice for every pod of every
+seed. PVC/PV-bound volume paths are covered separately by test_volumes.py
+(they need a VolumeContext fixture); affinity in-batch dynamics by
+test_affinity_fuzz.py; Policy-arg algorithms by test_policy_compat.py.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import random
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    ContainerImage,
+    ContainerPort,
+    NodeAffinity,
+    NodeSelectorTerm,
+    Pod,
+    SelectorOperator,
+    SelectorRequirement,
+    Toleration,
+    TolerationOperator,
+    Volume,
+    VolumeKind,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+from kubernetes_tpu.ops import oracle
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.ops.oracle_ext import SchedulingContext
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.node_info import node_info_map
+from kubernetes_tpu.state.snapshot import AVOID_PODS_ANNOTATION
+from tests.helpers import (
+    LABEL_KEYS,
+    LABEL_VALUES,
+    TAINTS,
+    Gi,
+    Mi,
+    random_nodes,
+    random_pod,
+)
+
+IMAGES = [("nginx:1.13", 500 * Mi), ("redis:3.2", 100 * Mi),
+          ("postgres:9", 1536 * Mi), ("busybox:1", 2 * Mi)]
+EXT_RESOURCE = "example.com/widget"
+PD_KINDS = [VolumeKind.GCE_PD, VolumeKind.AWS_EBS]
+VOLUME_IDS = ["disk-a", "disk-b", "disk-c", "disk-d"]
+
+
+def _random_volume(rng: random.Random) -> Volume:
+    r = rng.random()
+    if r < 0.35:
+        return Volume(name="v", kind=rng.choice(PD_KINDS),
+                      volume_id=rng.choice(VOLUME_IDS),
+                      read_only=rng.random() < 0.5)
+    if r < 0.5:
+        return Volume(name="v", kind=VolumeKind.RBD,
+                      monitors=["mon-1", "mon-2"], pool="rbd",
+                      image=rng.choice(["img-a", "img-b"]))
+    if r < 0.6:
+        return Volume(name="v", kind=VolumeKind.ISCSI,
+                      volume_id=rng.choice(["iqn-a", "iqn-b"]),
+                      read_only=rng.random() < 0.5)
+    return Volume(name="v", kind=VolumeKind.OTHER, volume_id="inert")
+
+
+def full_random_nodes(rng: random.Random, n: int):
+    nodes = random_nodes(rng, n)
+    for node in nodes:
+        if rng.random() < 0.4:
+            node.images = [ContainerImage([name], size)
+                           for name, size in rng.sample(IMAGES, 2)]
+        if rng.random() < 0.3:
+            node.allocatable.extended[EXT_RESOURCE] = rng.choice([2, 8])
+        if rng.random() < 0.3:
+            node.allocatable.storage_scratch = rng.choice([10, 50]) * Gi
+            if rng.random() < 0.5:  # some nodes have NO overlay partition
+                node.allocatable.storage_overlay = 20 * Gi
+        if rng.random() < 0.15:
+            node.annotations[AVOID_PODS_ANNOTATION] = json.dumps(
+                {"preferAvoidPods": [{"podSignature": {"podController": {
+                    "kind": "ReplicaSet", "uid": "rs-avoided",
+                    "apiVersion": "v1"}}, "reason": "fuzz"}]})
+    return nodes
+
+
+def full_random_pod(rng: random.Random, i: int, node_names) -> Pod:
+    pod = random_pod(rng, i, node_names)
+    pod.node_name = ""  # keep every fuzz pod pending
+    if rng.random() < 0.25:
+        pod.volumes = [_random_volume(rng)
+                       for _ in range(rng.randint(1, 2))]
+    if rng.random() < 0.2:
+        pod.containers[0].requests["storage.kubernetes.io/scratch"] = \
+            rng.choice([1, 5]) * Gi
+        if rng.random() < 0.5:
+            pod.containers[0].requests["storage.kubernetes.io/overlay"] = \
+                rng.choice([1, 4]) * Gi
+    if rng.random() < 0.15:
+        pod.containers[0].requests[EXT_RESOURCE] = rng.choice([1, 4])
+    if rng.random() < 0.3:
+        pod.containers[0].image = rng.choice(IMAGES)[0]
+    if rng.random() < 0.2:  # preferred node affinity
+        terms = [(rng.randint(1, 100), NodeSelectorTerm([
+            SelectorRequirement(k, SelectorOperator.IN,
+                                [rng.choice(LABEL_VALUES[k])])]))
+            for k in rng.sample(LABEL_KEYS, rng.randint(1, 2))]
+        if pod.affinity is None:
+            pod.affinity = Affinity()
+        if pod.affinity.node_affinity is None:
+            pod.affinity.node_affinity = NodeAffinity()
+        pod.affinity.node_affinity.preferred_terms = terms
+    if rng.random() < 0.2:  # controller-owned (prefer-avoid interaction)
+        pod.owner_kind = "ReplicaSet"
+        pod.owner_uid = rng.choice(["rs-avoided", "rs-ordinary"])
+    return pod
+
+
+def _existing(rng: random.Random, nodes, n: int):
+    """Bound pods seeding capacity, ports, images, and volume presence."""
+    out = []
+    for i in range(n):
+        p = make_pod(f"bound-{i}", cpu=rng.choice([100, 500]),
+                     memory=rng.choice([128 * Mi, 1 * Gi]))
+        if rng.random() < 0.4:
+            p.volumes = [_random_volume(rng)]
+        if rng.random() < 0.2:
+            p.containers[0].ports = [ContainerPort(
+                host_port=rng.choice([80, 443, 8080, 9090]))]
+        p.node_name = rng.choice(nodes).name
+        out.append(p)
+    return out
+
+
+PRIORITY_SETS = [
+    prio.DEFAULT_PRIORITIES,
+    tuple((nm, w) for nm, w in prio.DEFAULT_PRIORITIES
+          if nm != "LeastRequestedPriority") + (("MostRequestedPriority", 1),),
+    (("ImageLocalityPriority", 2), ("NodeAffinityPriority", 3),
+     ("EqualPriority", 1)),
+]
+
+
+def _oracle_sequence(nodes, existing, pending, priorities):
+    infos = node_info_map(nodes, existing)
+    names = sorted(infos.keys())
+    rr = oracle.RoundRobin()
+    ctx = SchedulingContext(infos, [])
+    out = []
+    for pod in pending:
+        name = oracle.schedule_one(pod, names, infos, rr, priorities, ctx)
+        out.append(name)
+        if name is not None:
+            p = copy.deepcopy(pod)
+            p.node_name = name
+            infos[name].add_pod(p)
+            ctx.invalidate()
+    return out
+
+
+def _engine_sequence(nodes, existing, pending, priorities):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(copy.deepcopy(p))
+    eng = SchedulingEngine(cache, priorities=priorities)
+    results = eng.schedule([copy.deepcopy(p) for p in pending],
+                           mode="strict")
+    return [r.node_name for r in results]
+
+
+@pytest.mark.parametrize("seed", list(range(12)))
+def test_full_surface_strict_engine_matches_oracle(seed):
+    rng = random.Random(1000 + seed)
+    nodes = full_random_nodes(rng, rng.choice([8, 16]))
+    existing = _existing(rng, nodes, rng.randint(4, 12))
+    names = [n.name for n in nodes]
+    pending = [full_random_pod(rng, i, names)
+               for i in range(rng.choice([16, 24]))]
+    pset = PRIORITY_SETS[seed % len(PRIORITY_SETS)]
+    want = _oracle_sequence(nodes, existing, pending, pset)
+    got = _engine_sequence(nodes, existing, pending, pset)
+    assert got == want, (
+        f"seed {seed}: first divergence at "
+        f"{next(i for i, (g, w) in enumerate(zip(got, want)) if g != w)}")
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_full_surface_feature_coverage(seed):
+    """The generator genuinely exercises every axis (a fuzz suite whose
+    random draws silently stopped producing a feature tests nothing)."""
+    rng = random.Random(1000 + seed)
+    nodes = full_random_nodes(rng, 16)
+    pending = [full_random_pod(rng, i, [n.name for n in nodes])
+               for i in range(64)]
+    assert any(n.images for n in nodes)
+    assert any(EXT_RESOURCE in n.allocatable.extended for n in nodes)
+    assert any(AVOID_PODS_ANNOTATION in n.annotations for n in nodes)
+    assert any(n.allocatable.storage_scratch for n in nodes)
+    assert any(p.volumes for p in pending)
+    assert any("storage.kubernetes.io/scratch" in p.containers[0].requests
+               for p in pending)
+    assert any(p.affinity and p.affinity.node_affinity
+               and p.affinity.node_affinity.preferred_terms
+               for p in pending)
+    assert any(p.owner_uid == "rs-avoided" for p in pending)
+    assert any(p.containers[0].ports for p in pending)
+    assert any(p.tolerations for p in pending)
+
+
+@pytest.mark.parametrize("seed", [1, 4, 9])
+def test_full_surface_wave_mode_placements_are_valid(seed):
+    """Wave mode may order ties differently (documented batch semantics),
+    but every placement must still satisfy the hard predicates: capacity
+    never oversubscribed, pod counts respected, host ports never collide,
+    and volumes never conflict (NoDiskConflict over the co-located set)."""
+    rng = random.Random(2000 + seed)
+    nodes = full_random_nodes(rng, 12)
+    existing = _existing(rng, nodes, 8)
+    names = [n.name for n in nodes]
+    pending = [full_random_pod(rng, i, names) for i in range(32)]
+
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(copy.deepcopy(p))
+    eng = SchedulingEngine(cache, priorities=prio.DEFAULT_PRIORITIES)
+    results = eng.schedule([copy.deepcopy(p) for p in pending], mode="wave")
+
+    by_node = {}
+    for r in results:
+        if r.node_name is not None:
+            by_node.setdefault(r.node_name, []).append(r.pod)
+    node_by_name = {n.name: n for n in nodes}
+    for nm, pods in by_node.items():
+        node = node_by_name[nm]
+        prior = [p for p in existing if p.node_name == nm]
+        cpu = sum(p.resource_request().milli_cpu for p in pods + prior)
+        mem = sum(p.resource_request().memory for p in pods + prior)
+        assert cpu <= node.allocatable.milli_cpu, f"{nm} cpu oversubscribed"
+        assert mem <= node.allocatable.memory, f"{nm} mem oversubscribed"
+        assert len(pods) + len(prior) <= node.allowed_pod_number
+        ports = [pt.host_port for p in pods + prior
+                 for pt in p.containers[0].ports if pt.host_port]
+        assert len(ports) == len(set(ports)), f"{nm} port collision"
+        # NoDiskConflict: two CO-LOCATED pods sharing a conflict key must
+        # both mount it read-only (predicates.go:128-177; a pod never
+        # conflicts with itself)
+        from kubernetes_tpu.state.volumes import pod_conflict_keys
+        per_pod = []
+        for p in pods + prior:
+            keys = {}
+            for key, ro in pod_conflict_keys(p):
+                keys[key] = keys.get(key, True) and ro
+            per_pod.append(keys)
+        for i, ka in enumerate(per_pod):
+            for kb in per_pod[i + 1:]:
+                for key in set(ka) & set(kb):
+                    assert ka[key] and kb[key], \
+                        f"{nm}: volume conflict on {key}"
+
+
+@pytest.mark.parametrize("seed", [2, 4])
+def test_max_pd_volume_reject_branch_exercised(seed, monkeypatch):
+    """Regression (review): with the default 39/16 limits and only 4
+    distinct volume ids, the MaxPDVolumeCount reject branch can never fire.
+    Pin KUBE_MAX_PD_VOLS=2 so clusters actually hit the ceiling, and
+    bit-match engine vs oracle through it (defaults.go:233 getMaxVols)."""
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "2")
+    rng = random.Random(3000 + seed)
+    nodes = full_random_nodes(rng, 6)
+    existing = _existing(rng, nodes, 10)
+    # make PD volumes common so per-node distinct ids exceed the limit
+    pending = []
+    for i in range(24):
+        p = full_random_pod(rng, i, [n.name for n in nodes])
+        if rng.random() < 0.7:
+            p.volumes = [Volume(name="v", kind=rng.choice(PD_KINDS),
+                                volume_id=rng.choice(VOLUME_IDS))]
+        pending.append(p)
+    pset = prio.DEFAULT_PRIORITIES
+    want = _oracle_sequence(nodes, existing, pending, pset)
+    got = _engine_sequence(nodes, existing, pending, pset)
+    assert got == want
+    # the ceiling genuinely bites: against the FINAL state (existing +
+    # placed pending), some PD pod is rejected by some node's filter
+    from kubernetes_tpu.ops.oracle_volumes import max_pd_volume_count
+    from kubernetes_tpu.state.volumes import EMPTY_VOLUME_CONTEXT
+    placed = []
+    for p, nm in zip(pending, want):
+        if nm is not None:
+            q = copy.deepcopy(p)
+            q.node_name = nm
+            placed.append(q)
+    infos = node_info_map(nodes, existing + placed)
+    rejected = any(
+        not all(max_pd_volume_count(p, info, EMPTY_VOLUME_CONTEXT))
+        for p in pending if p.volumes for info in infos.values())
+    assert rejected, "generator failed to exercise the reject branch"
