@@ -1,0 +1,45 @@
+// pause: the container that holds a pod's network namespace.
+//
+// C++ equivalent of the reference's only in-tree native program
+// (build/pause/pause.c, 51 lines): block until terminated, reaping any
+// zombies re-parented onto us (we are PID 1 inside the pod sandbox).
+//
+// Build: `make pause` (build/Makefile) -> build/bin/pause
+
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+void sigdown(int sig) {
+  std::fprintf(stderr, "shutting down, got signal %d\n", sig);
+  std::exit(0);
+}
+
+void sigreap(int) {
+  // reap everything that exited; WNOHANG so we never block in the handler
+  while (waitpid(-1, nullptr, WNOHANG) > 0) {
+  }
+}
+
+}  // namespace
+
+int main() {
+  struct sigaction down = {};
+  down.sa_handler = sigdown;
+  struct sigaction reap = {};
+  reap.sa_handler = sigreap;
+  reap.sa_flags = SA_NOCLDSTOP;
+  if (sigaction(SIGINT, &down, nullptr) < 0) return 1;
+  if (sigaction(SIGTERM, &down, nullptr) < 0) return 2;
+  if (sigaction(SIGCHLD, &reap, nullptr) < 0) return 3;
+  for (;;) {
+    pause();
+  }
+  return 42;  // unreachable (pause.c's "epic fail" exit)
+}

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Start a hollow cluster with two competing scheduler daemons — the rig's
+# analog of the reference's cluster/ provisioning + kubemark start scripts
+# (test/kubemark/start-kubemark.sh): everything in one process, sized by
+# env, exiting non-zero if the storm does not fully bind.
+#
+#   NUM_NODES=100 NUM_PODS=2000 ./cluster/start-hollow-cluster.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NUM_NODES="${NUM_NODES:-50}"
+NUM_PODS="${NUM_PODS:-500}"
+POLICY="${POLICY_CONFIG_FILE:-}"
+
+args=(--nodes "$NUM_NODES" --pods "$NUM_PODS")
+if [[ -n "$POLICY" ]]; then
+  args+=(--policy-config-file "$POLICY")
+fi
+
+out="$(python -m kubernetes_tpu.server.daemon "${args[@]}")"
+echo "$out"
+[[ "$out" == *"bound=${NUM_PODS}/${NUM_PODS}"* ]]

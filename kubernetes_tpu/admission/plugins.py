@@ -333,6 +333,47 @@ class StorageClassDefault(_StorePlugin):
                 return
 
 
+class PodSecurityPolicyPlugin(_StorePlugin):
+    """plugin/pkg/admission/security/podsecuritypolicy (admission.go:120
+    Admit): on pod CREATE, try every PodSecurityPolicy in name order; the
+    first whose generated defaults validate wins — the pod is mutated with
+    those defaults and annotated kubernetes.io/psp=<name>. No policy
+    passing (or none existing while the plugin is enabled) rejects the pod.
+
+    Opt-in, like the reference (not in the 1.7 recommended set):
+    AdmissionChain(default_plugins() + [PodSecurityPolicyPlugin()], ...)."""
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.operation == CREATE and req.kind == "Pod"
+
+    def admit(self, req: AdmissionRequest) -> None:
+        from kubernetes_tpu.security.psp import (
+            PSP_ANNOTATION,
+            PSP_KIND,
+            Provider,
+        )
+        if self.store is None:
+            return
+        policies = sorted(self.store.list(PSP_KIND)[0],
+                          key=lambda p: p.name)
+        pod: Pod = req.obj
+        all_errs = []
+        for psp in policies:
+            provider = Provider(psp)
+            candidate = provider.apply_defaults(pod)
+            errs = provider.validate(candidate)
+            if not errs:
+                candidate.annotations = dict(candidate.annotations)
+                candidate.annotations[PSP_ANNOTATION] = psp.name
+                # commit the mutation (the chain passes req.obj onward)
+                pod.__dict__.update(candidate.__dict__)
+                return
+            all_errs.extend(f"{psp.name}: {e}" for e in errs)
+        raise Rejected(
+            "unable to validate against any pod security policy: "
+            + ("; ".join(all_errs) if all_errs else "no policies defined"))
+
+
 class ResourceQuotaPlugin(_StorePlugin):
     """plugin/pkg/admission/resourcequota: on CREATE, check the delta
     against every matching quota's hard limits and commit the new usage

@@ -30,8 +30,10 @@ from kubernetes_tpu.api.types import (
     Pod,
     PodAffinity,
     PodAffinityTerm,
+    PodSecurityContext,
     Probe,
     Resource,
+    SecurityContext,
     SelectorOperator,
     SelectorRequirement,
     Taint,
@@ -247,6 +249,21 @@ def encode_volume(v: Volume) -> Dict[str, Any]:
 def decode_pod(obj: Dict[str, Any]) -> Pod:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
+    def _decode_sc(s, pod_level: bool):
+        if not s:
+            return None
+        if pod_level:
+            return PodSecurityContext(
+                run_as_user=(int(s["runAsUser"])
+                             if s.get("runAsUser") is not None else None),
+                run_as_non_root=s.get("runAsNonRoot"))
+        return SecurityContext(
+            privileged=s.get("privileged"),
+            run_as_user=(int(s["runAsUser"])
+                         if s.get("runAsUser") is not None else None),
+            run_as_non_root=s.get("runAsNonRoot"),
+            read_only_root_filesystem=s.get("readOnlyRootFilesystem"))
+
     def _decode_probe(p):
         if not p:
             return None
@@ -275,6 +292,7 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
                    for p in c.get("ports") or []],
             liveness_probe=_decode_probe(c.get("livenessProbe")),
             readiness_probe=_decode_probe(c.get("readinessProbe")),
+            security_context=_decode_sc(c.get("securityContext"), False),
         ))
     tolerations = []
     for t in spec.get("tolerations") or []:
@@ -306,6 +324,8 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
         tolerations=tolerations,
         scheduler_name=spec.get("schedulerName", "default-scheduler"),
         priority=int(spec.get("priority") or 0),
+        host_network=bool(spec.get("hostNetwork", False)),
+        security_context=_decode_sc(spec.get("securityContext"), True),
         owner_kind=owner_kind,
         owner_name=owner_name,
         owner_uid=owner_uid,
@@ -353,24 +373,49 @@ def decode_node(obj: Dict[str, Any]) -> Node:
 
 def encode_pod(pod: Pod) -> Dict[str, Any]:
     """Minimal re-encode (enough for extender round-trips and debugging)."""
+    def _enc_sc(s) -> Optional[Dict[str, Any]]:
+        if s is None:
+            return None
+        out = {}
+        if getattr(s, "privileged", None) is not None:
+            out["privileged"] = s.privileged
+        if s.run_as_user is not None:
+            out["runAsUser"] = s.run_as_user
+        if s.run_as_non_root is not None:
+            out["runAsNonRoot"] = s.run_as_non_root
+        if getattr(s, "read_only_root_filesystem", None) is not None:
+            out["readOnlyRootFilesystem"] = s.read_only_root_filesystem
+        return out or None
+
     containers = []
     for c in pod.containers:
         req = {}
         for k, v in c.requests.items():
             req[k] = f"{v}m" if k == "cpu" else str(v)
-        containers.append({
+        enc = {
             "name": c.name, "image": c.image,
             "resources": {"requests": req},
             "ports": [{"hostPort": p.host_port, "containerPort": p.container_port,
                        "protocol": p.protocol} for p in c.ports],
-        })
+        }
+        csc = _enc_sc(c.security_context)
+        if csc:
+            enc["securityContext"] = csc
+        containers.append(enc)
+    spec: Dict[str, Any] = {
+        "containers": containers, "nodeName": pod.node_name,
+        "nodeSelector": pod.node_selector,
+        "schedulerName": pod.scheduler_name,
+        "volumes": [encode_volume(v) for v in pod.volumes]}
+    if pod.host_network:
+        spec["hostNetwork"] = True
+    psc = _enc_sc(pod.security_context)
+    if psc:
+        spec["securityContext"] = psc
     return {
         "metadata": {"name": pod.name, "namespace": pod.namespace,
                      "uid": pod.uid, "labels": pod.labels},
-        "spec": {"containers": containers, "nodeName": pod.node_name,
-                 "nodeSelector": pod.node_selector,
-                 "schedulerName": pod.scheduler_name,
-                 "volumes": [encode_volume(v) for v in pod.volumes]},
+        "spec": spec,
     }
 
 

@@ -359,6 +359,28 @@ class Probe:
 
 
 @dataclass
+class SecurityContext:
+    """v1.SecurityContext (container-level), reduced to the fields PSP and
+    the kubelet's securitycontext provider read (pkg/securitycontext/,
+    pkg/apis/extensions PSP validation)."""
+
+    privileged: Optional[bool] = None
+    run_as_user: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+    read_only_root_filesystem: Optional[bool] = None
+
+
+@dataclass
+class PodSecurityContext:
+    """v1.PodSecurityContext: pod-wide defaults containers inherit (only
+    the fields a strategy actually enforces; FSGroup/SupplementalGroups
+    strategies are not modeled)."""
+
+    run_as_user: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -369,6 +391,7 @@ class Container:
     ports: List[ContainerPort] = field(default_factory=list)
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    security_context: Optional[SecurityContext] = None
 
 
 @dataclass
@@ -397,6 +420,8 @@ class Pod:
     # defaults); the kubelet flips this from probe outcomes.
     ready: bool = True
     restart_count: int = 0  # sum of ContainerStatus.RestartCount
+    host_network: bool = False  # spec.hostNetwork (PSP HostNetwork check)
+    security_context: Optional[PodSecurityContext] = None
     resource_version: int = 0
     owner_kind: str = ""  # controllerRef: equivalence classes, spreading,
     owner_name: str = ""  # NodePreferAvoidPods

@@ -55,6 +55,14 @@ KIND_REGISTRY: Dict[str, Type] = {
     "RoleBinding": rbac_mod.RoleBinding,
     "ClusterRoleBinding": rbac_mod.ClusterRoleBinding,
 }
+
+
+def _psp_type():
+    from kubernetes_tpu.security.psp import PodSecurityPolicy
+    return PodSecurityPolicy
+
+
+KIND_REGISTRY["PodSecurityPolicy"] = _psp_type()
 KIND_REGISTRY = {k: v for k, v in KIND_REGISTRY.items() if v is not None}
 
 

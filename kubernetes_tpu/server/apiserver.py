@@ -91,6 +91,7 @@ KIND_INFO: Dict[str, Tuple[str, bool]] = {
     "CertificateSigningRequest": ("certificatesigningrequests", True),
     "CustomResourceDefinition": ("customresourcedefinitions", True),
     "APIService": ("apiservices", True),
+    "PodSecurityPolicy": ("podsecuritypolicies", True),
 }
 
 

@@ -315,8 +315,18 @@ class ClusterSnapshot:
             self._labels_width = want
             n = self.alloc.shape[0] if self._shape_sig else 0
             self.labels = np.zeros((n, want), dtype=np.int8)
-            for i, lbls in enumerate(self._row_labels):
-                self._write_label_row(i, lbls)
+            # batch scatter through the native encoder (C++ hostops with
+            # numpy fallback) instead of a per-row rewrite loop — this is
+            # the full-matrix rebuild every vocab growth pays
+            from kubernetes_tpu import native as hostops
+            pairs = [(i, idx)
+                     for i, lbls in enumerate(self._row_labels)
+                     for idx in (self.label_vocab.get(k, v)
+                                 for k, v in lbls.items())
+                     if idx >= 0]
+            if pairs:
+                hostops.fill_multi_hot(np.asarray(pairs, dtype=np.int64),
+                                       self.labels)
             self._vocab_dirty = False
             self.dirty.add("labels")
             self.version += 1

@@ -1,0 +1,61 @@
+// hostops: C++ host-side tensor-encoding kernels for the snapshot layer.
+//
+// The SURVEY §2 native seam: "a C++ host-side tensor snapshot encoder for
+// the Go->TPU boundary". The Python snapshot (state/snapshot.py) flattens
+// object state into index lists; these kernels turn them into the dense
+// device-ready arrays without a Python-bytecode inner loop. Pure C ABI
+// (ctypes-loadable, no CPython API): see kubernetes_tpu/native/__init__.py
+// for the build-on-demand loader and the pure-Python fallbacks that keep
+// every path working when no toolchain is present.
+//
+// Build: `make hostops` (build/Makefile) -> native/libhostops.so
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Fill the [n_nodes, words] uint32 host-port bitmap from (row, port) pairs.
+// Ports outside [1, words*32-1] are ignored, like the Python writer
+// (snapshot.py _write_ports_row). `bitmap` must be zeroed by the caller.
+void fill_port_bitmaps(const int64_t* pairs, int64_t n_pairs,
+                       uint32_t* bitmap, int64_t n_nodes, int64_t words) {
+  const int64_t port_space = words * 32;
+  for (int64_t i = 0; i < n_pairs; ++i) {
+    const int64_t row = pairs[2 * i];
+    const int64_t port = pairs[2 * i + 1];
+    if (row < 0 || row >= n_nodes || port <= 0 || port >= port_space) {
+      continue;
+    }
+    bitmap[row * words + port / 32] |=
+        static_cast<uint32_t>(1u) << (port % 32);
+  }
+}
+
+// Scatter 1s into an int8 [n_rows, width] multi-hot matrix from
+// (row, col) pairs — the label/taint/avoid incidence builder. Out-of-range
+// pairs are ignored (vocab columns beyond the padded width).
+void fill_multi_hot(const int64_t* pairs, int64_t n_pairs, int8_t* out,
+                    int64_t n_rows, int64_t width) {
+  for (int64_t i = 0; i < n_pairs; ++i) {
+    const int64_t row = pairs[2 * i];
+    const int64_t col = pairs[2 * i + 1];
+    if (row < 0 || row >= n_rows || col < 0 || col >= width) {
+      continue;
+    }
+    out[row * width + col] = 1;
+  }
+}
+
+// FNV-1a 64-bit over a byte buffer — the content hash the equivalence
+// classes use for spec identity prehashing.
+uint64_t fnv1a64(const uint8_t* data, int64_t n) {
+  uint64_t h = 14695981039346656037ull;  // FNV-1a 64 offset basis
+  for (int64_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+}  // extern "C"

@@ -142,9 +142,11 @@ def test_compaction_truncates_wal_and_survives(tmp_path):
     for i in range(120):  # crosses the threshold twice
         api.create("Pod", make_pod(f"p{i}"))
     assert os.path.exists(os.path.join(d, DurableStore.SNAPSHOT))
-    wal_size = os.path.getsize(os.path.join(d, DurableStore.WAL))
     # WAL holds only records since the last snapshot, not all 120
-    assert wal_size < 120 * 100
+    assert api._durable._records_since_snapshot == 120 % 50
+    remaining = sum(1 for _ in WriteAheadLog.replay(
+        os.path.join(d, DurableStore.WAL)))
+    assert remaining == 120 % 50
     api.close()
     api2 = ApiServerLite(data_dir=d)
     pods, _ = api2.list("Pod")

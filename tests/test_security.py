@@ -1,0 +1,274 @@
+"""PodSecurityPolicy admission + securitycontext resolution.
+
+Reference targets: plugin/pkg/admission/security/podsecuritypolicy/
+admission.go (try policies in order, first validating wins, mutate +
+annotate), pkg/security/podsecuritypolicy strategies (RunAsAny /
+MustRunAs / MustRunAsNonRoot, host ports, volumes FSTypes, privileged,
+readOnlyRootFilesystem), pkg/securitycontext (container overrides pod).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.admission.chain import (
+    AdmissionChain,
+    AdmissionRequest,
+    CREATE,
+    Rejected,
+    default_plugins,
+)
+from kubernetes_tpu.admission.plugins import PodSecurityPolicyPlugin
+from kubernetes_tpu.api.types import (
+    PodSecurityContext,
+    SecurityContext,
+    Volume,
+    VolumeKind,
+    make_pod,
+)
+from kubernetes_tpu.security import securitycontext as sc
+from kubernetes_tpu.security.psp import (
+    MUST_RUN_AS,
+    MUST_RUN_AS_NON_ROOT,
+    PSP_ANNOTATION,
+    PSP_KIND,
+    PodSecurityPolicy,
+    Provider,
+)
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+
+# ------------------------------------------------------- securitycontext
+
+
+def test_container_overrides_pod_security_context():
+    pod = make_pod("p")
+    pod.security_context = PodSecurityContext(run_as_user=1000,
+                                              run_as_non_root=True)
+    c = pod.containers[0]
+    assert sc.effective_run_as_user(pod, c) == 1000
+    assert sc.effective_run_as_non_root(pod, c) is True
+    c.security_context = SecurityContext(run_as_user=0,
+                                         run_as_non_root=False)
+    assert sc.effective_run_as_user(pod, c) == 0
+    assert sc.effective_run_as_non_root(pod, c) is False
+
+
+# ----------------------------------------------------------- provider
+
+
+def test_privileged_gate():
+    pod = make_pod("p")
+    pod.containers[0].security_context = SecurityContext(privileged=True)
+    assert Provider(PodSecurityPolicy("restricted")).validate(pod)
+    assert not Provider(
+        PodSecurityPolicy("priv", privileged=True)).validate(pod)
+
+
+def test_host_network_gate():
+    pod = make_pod("p")
+    pod.host_network = True
+    assert Provider(PodSecurityPolicy("restricted")).validate(pod)
+    assert not Provider(
+        PodSecurityPolicy("hostnet", host_network=True)).validate(pod)
+
+
+def test_host_port_ranges():
+    pod = make_pod("p", ports=[8080])
+    assert Provider(PodSecurityPolicy("none")).validate(pod)
+    assert Provider(PodSecurityPolicy(
+        "low", host_ports=[(1, 1024)])).validate(pod)
+    assert not Provider(PodSecurityPolicy(
+        "web", host_ports=[(8000, 9000)])).validate(pod)
+
+
+def test_volume_fstypes():
+    pod = make_pod("p", volumes=[
+        Volume(name="v", kind=VolumeKind.GCE_PD, volume_id="d1")])
+    assert not Provider(PodSecurityPolicy("any")).validate(pod)  # "*"
+    assert not Provider(PodSecurityPolicy(
+        "pd-only", volumes=["GCEPersistentDisk"])).validate(pod)
+    errs = Provider(PodSecurityPolicy(
+        "none", volumes=["Other"])).validate(pod)
+    assert errs and "GCEPersistentDisk" in errs[0]
+
+
+def test_must_run_as_non_root():
+    psp = PodSecurityPolicy("nonroot",
+                            run_as_user_rule=MUST_RUN_AS_NON_ROOT)
+    root = make_pod("root")
+    root.containers[0].security_context = SecurityContext(run_as_user=0)
+    assert Provider(psp).validate(root)
+    unset = make_pod("unset")  # neither uid nor runAsNonRoot: reject
+    assert Provider(psp).validate(unset)
+    marked = make_pod("marked")
+    marked.security_context = PodSecurityContext(run_as_non_root=True)
+    assert not Provider(psp).validate(marked)
+    uid = make_pod("uid")
+    uid.containers[0].security_context = SecurityContext(run_as_user=100)
+    assert not Provider(psp).validate(uid)
+
+
+def test_must_run_as_defaults_and_validates_range():
+    psp = PodSecurityPolicy("ranged", run_as_user_rule=MUST_RUN_AS,
+                            run_as_user_ranges=[(1000, 2000)])
+    pod = make_pod("p")
+    out = Provider(psp).apply_defaults(pod)
+    assert pod.security_context is None  # input untouched
+    assert out.security_context.run_as_user == 1000  # range min assigned
+    assert not Provider(psp).validate(out)
+    bad = make_pod("bad")
+    bad.security_context = PodSecurityContext(run_as_user=5)
+    assert Provider(psp).validate(Provider(psp).apply_defaults(bad))
+
+
+def test_read_only_root_filesystem_required():
+    psp = PodSecurityPolicy("ro", read_only_root_filesystem=True)
+    pod = make_pod("p")
+    assert Provider(psp).validate(pod)
+    pod.containers[0].security_context = SecurityContext(
+        read_only_root_filesystem=True)
+    assert not Provider(psp).validate(pod)
+
+
+# ----------------------------------------------------------- admission
+
+
+def _store():
+    from kubernetes_tpu.api.workloads import Namespace
+    store = ApiServerLite()
+    store.create("Namespace", Namespace("default"))
+    return store
+
+
+def _chain_with_psp(store):
+    return AdmissionChain(default_plugins() + [PodSecurityPolicyPlugin()],
+                          store=store)
+
+
+def _admit_pod(chain, pod):
+    req = AdmissionRequest(operation=CREATE, kind="Pod",
+                           namespace=pod.namespace, name=pod.name,
+                           obj=pod)
+    chain.admit(req)
+    return pod
+
+
+def test_admission_first_policy_by_name_wins_and_annotates():
+    store = _store()
+    store.create(PSP_KIND, PodSecurityPolicy(
+        "a-ranged", run_as_user_rule=MUST_RUN_AS,
+        run_as_user_ranges=[(1000, 2000)]))
+    store.create(PSP_KIND, PodSecurityPolicy("b-anything",
+                                             privileged=True))
+    chain = _chain_with_psp(store)
+    pod = _admit_pod(chain, make_pod("p"))
+    assert pod.annotations[PSP_ANNOTATION] == "a-ranged"
+    assert pod.security_context.run_as_user == 1000  # mutation committed
+
+
+def test_admission_falls_through_to_permissive_policy():
+    store = _store()
+    store.create(PSP_KIND, PodSecurityPolicy("a-restricted"))
+    store.create(PSP_KIND, PodSecurityPolicy("b-priv", privileged=True))
+    chain = _chain_with_psp(store)
+    pod = make_pod("p")
+    pod.containers[0].security_context = SecurityContext(privileged=True)
+    _admit_pod(chain, pod)
+    assert pod.annotations[PSP_ANNOTATION] == "b-priv"
+
+
+def test_admission_rejects_when_nothing_validates():
+    store = _store()
+    store.create(PSP_KIND, PodSecurityPolicy("restricted"))
+    chain = _chain_with_psp(store)
+    pod = make_pod("p")
+    pod.host_network = True
+    with pytest.raises(Rejected, match="hostNetwork"):
+        _admit_pod(chain, pod)
+
+
+def test_admission_rejects_with_no_policies():
+    chain = _chain_with_psp(_store())
+    with pytest.raises(Rejected, match="no policies defined"):
+        _admit_pod(chain, make_pod("p"))
+
+
+def test_default_chain_without_psp_plugin_still_admits():
+    """PSP is opt-in (not in the 1.7 recommended set) — the default chain
+    must not start rejecting pods."""
+    chain = AdmissionChain(default_plugins(), store=_store())
+    pod = make_pod("p")
+    pod.host_network = True
+    _admit_pod(chain, pod)  # no exception
+
+
+def test_full_apiserver_with_psp_end_to_end():
+    """Through the real handler chain: POST pod -> authn -> admission(PSP)
+    -> registry -> store, both accept and reject paths."""
+    from kubernetes_tpu.server.apiserver import ApiServer
+
+    from kubernetes_tpu.api.workloads import Namespace
+
+    srv = ApiServer(auth=False)
+    srv.store.create("Namespace", Namespace("default"))
+    srv.admission.plugins.append(PodSecurityPolicyPlugin())
+    for plug in srv.admission.plugins:
+        if hasattr(plug, "set_store"):
+            plug.set_store(srv.store)
+    srv.create(PSP_KIND, PodSecurityPolicy(
+        "default", host_ports=[(8000, 9000)]))
+    ok = srv.create("Pod", make_pod("web", ports=[8080]))
+    stored = srv.get("Pod", "default", "web")
+    assert stored.annotations[PSP_ANNOTATION] == "default"
+    with pytest.raises(Rejected):
+        srv.create("Pod", make_pod("bad", ports=[22]))
+
+
+def test_manifest_wire_format_carries_security_fields():
+    """Regression (review): a k8s JSON manifest's hostNetwork and
+    securityContext must survive decode (else PSP enforcement is bypassed
+    for REST-submitted pods) and re-encode."""
+    from kubernetes_tpu.api import serde
+
+    manifest = {
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "hostNetwork": True,
+            "securityContext": {"runAsUser": 1000, "runAsNonRoot": True},
+            "containers": [{
+                "name": "c0",
+                "securityContext": {"privileged": True, "runAsUser": 0,
+                                    "readOnlyRootFilesystem": True},
+            }],
+        },
+    }
+    pod = serde.decode_pod(manifest)
+    assert pod.host_network is True
+    assert pod.security_context.run_as_user == 1000
+    assert pod.security_context.run_as_non_root is True
+    csc = pod.containers[0].security_context
+    assert csc.privileged is True and csc.run_as_user == 0
+    assert csc.read_only_root_filesystem is True
+    # PSP actually sees the decoded fields
+    assert Provider(PodSecurityPolicy("restricted")).validate(pod)
+    # and the round-trip preserves them
+    enc = serde.encode_pod(pod)
+    again = serde.decode_pod(enc)
+    assert again.host_network is True
+    assert again.containers[0].security_context.privileged is True
+    assert again.security_context.run_as_user == 1000
+
+
+def test_psp_kind_decodes_over_the_wire():
+    """Regression (review): the podsecuritypolicies REST route must be able
+    to decode a PSP body (wire.KIND_REGISTRY entry)."""
+    from kubernetes_tpu.api import wire
+
+    obj = wire.decode_any(
+        {"name": "restricted", "privileged": False,
+         "host_ports": [[8000, 9000]],
+         "run_as_user_rule": "MustRunAsNonRoot"},
+        kind=PSP_KIND)
+    assert isinstance(obj, PodSecurityPolicy)
+    assert obj.run_as_user_rule == "MustRunAsNonRoot"
