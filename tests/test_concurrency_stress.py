@@ -1,0 +1,252 @@
+"""Threaded stress of the shared mutable state: cache, queue, store, and
+the watch+schedule interleaving.
+
+The reference leans on `-race` builds (hack/make-rules/test.sh:107
+KUBE_RACE) and construction (single scheduleOne goroutine, mutex-guarded
+caches — schedulercache/cache.go:50); Python has no race detector, so
+these tests hammer the same invariants under real threads:
+
+  - SchedulerCache: assume/confirm/forget/expire from competing threads
+    leaves balanced node accounting
+  - SchedulingQueue: concurrent producers/consumers pop every pod exactly
+    once
+  - ApiServerLite: racing binders bind every pod exactly once; a watcher
+    sees a strictly-increasing rv stream covering every write
+  - Scheduler vs churn: a live scheduler drains while another thread keeps
+    creating pods — converges with zero double binds
+
+Also covers the proxy healthcheck server (pkg/proxy/healthcheck) since it
+is probed concurrently by external LBs in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubernetes_tpu.api.types import Binding, make_node, make_pod
+from kubernetes_tpu.engine.queue import SchedulingQueue
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.state.cache import SchedulerCache
+
+Gi = 1 << 30
+
+
+def _run_threads(fns):
+    errors = []
+
+    def wrap(fn):
+        def go():
+            try:
+                fn()
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+        return go
+
+    threads = [threading.Thread(target=wrap(fn)) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "stress thread wedged"
+    assert not errors, errors
+
+
+def test_cache_concurrent_assume_confirm_forget_balances():
+    cache = SchedulerCache(ttl_seconds=1000.0)
+    cache.add_node(make_node("n0", cpu=10_000_000, memory=1000 * Gi))
+    base = cache.node_infos()["n0"].requested.milli_cpu
+    n_per = 300
+
+    def assume_then_forget(tag):
+        def go():
+            for i in range(n_per):
+                p = make_pod(f"{tag}-{i}", cpu=7, node_name="n0")
+                cache.assume_pod(p)
+                cache.finish_binding(p)
+                if i % 2:
+                    cache.forget_pod(p)
+                else:
+                    cache.add_pod(p)   # informer confirm
+                    cache.remove_pod(p)  # and deletion
+        return go
+
+    _run_threads([assume_then_forget(f"t{k}") for k in range(4)])
+    info = cache.node_infos()["n0"]
+    assert info.requested.milli_cpu == base
+    assert not info.pods
+    assert cache.pod_count() == 0
+
+
+def test_queue_concurrent_producers_consumers_exactly_once():
+    q = SchedulingQueue()
+    n_producers, n_per = 4, 250
+    total = n_producers * n_per
+    popped = []
+    popped_lock = threading.Lock()
+    done = threading.Event()
+
+    def producer(tag):
+        def go():
+            for i in range(n_per):
+                q.add(make_pod(f"{tag}-{i}"))
+        return go
+
+    def consumer():
+        while not done.is_set() or len(q):
+            batch = q.pop_batch(max_n=16, wait=0.01)
+            if batch:
+                with popped_lock:
+                    popped.extend(p.key() for p in batch)
+            with popped_lock:
+                if len(popped) >= total:
+                    return
+
+    producers = [producer(f"p{k}") for k in range(n_producers)]
+    consumers = [consumer, consumer]
+
+    def run_producers():
+        _run_threads(producers)
+        done.set()
+
+    prod_thread = threading.Thread(target=run_producers)
+    prod_thread.start()
+    _run_threads(consumers)
+    prod_thread.join(timeout=60)
+    assert len(popped) == total
+    assert len(set(popped)) == total, "a pod was popped twice"
+
+
+def test_apiserver_racing_binders_bind_exactly_once():
+    api = ApiServerLite()
+    api.create("Node", make_node("n0"))
+    n_pods = 400
+    for i in range(n_pods):
+        api.create("Pod", make_pod(f"p{i:03d}", cpu=10))
+    conflicts = []
+    lock = threading.Lock()
+
+    def binder(offset):
+        def go():
+            errs = 0
+            # every binder tries EVERY pod: exactly one thread can win each
+            for i in range(n_pods):
+                j = (i + offset) % n_pods
+                out = api.bind_many([Binding(f"p{j:03d}", "default", "",
+                                             "n0")])
+                if out[0] is not None:
+                    errs += 1
+            with lock:
+                conflicts.append(errs)
+        return go
+
+    _run_threads([binder(k * 100) for k in range(4)])
+    pods, _ = api.list("Pod")
+    assert all(p.node_name == "n0" for p in pods)
+    # 4 attempts per pod, exactly 1 success: 3 conflicts each
+    assert sum(conflicts) == 3 * n_pods
+
+
+def test_watcher_sees_monotonic_rv_stream_under_writes():
+    api = ApiServerLite(max_log=100_000)
+    stop = threading.Event()
+    seen = []
+
+    def writer():
+        for i in range(500):
+            api.create("Pod", make_pod(f"w-{i:03d}"))
+        stop.set()
+
+    def watcher():
+        rv = 0
+        while True:
+            evs = api.watch_since(("Pod",), rv, timeout=0.05)
+            for ev in evs:
+                assert ev.rv > rv, "rv went backwards"
+                rv = ev.rv
+                seen.append(ev.rv)
+            if stop.is_set() and not evs:
+                return
+
+    _run_threads([writer, watcher])
+    assert len(seen) == 500
+    assert seen == sorted(seen)
+
+
+def test_scheduler_drains_under_concurrent_churn():
+    from kubernetes_tpu.engine.scheduler import Scheduler
+
+    api = ApiServerLite()
+    for i in range(20):
+        api.create("Node", make_node(f"n{i:02d}", cpu=64_000,
+                                     memory=256 * Gi))
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    n_pods = 600
+    created = threading.Event()
+
+    def churn():
+        for i in range(n_pods):
+            api.create("Pod", make_pod(f"c-{i:03d}", cpu=50))
+        created.set()
+
+    totals = {"bound": 0, "bind_errors": 0}
+
+    def drain():
+        while not created.is_set() or any(
+                not p.node_name for p in api.list("Pod")[0]):
+            stats = sched.schedule_round(wait=0.01)
+            totals["bound"] += stats["bound"]
+            totals["bind_errors"] += stats["bind_errors"]
+
+    _run_threads([churn, drain])
+    assert totals["bound"] == n_pods
+    assert totals["bind_errors"] == 0
+    pods, _ = api.list("Pod")
+    assert all(p.node_name for p in pods)
+
+
+# --------------------------------------------------------- proxy healthz
+
+
+def test_proxy_healthcheck_server_reports_local_endpoints():
+    import json
+    import urllib.request
+    import urllib.error
+
+    from kubernetes_tpu.api.workloads import Service, ServicePort
+    from kubernetes_tpu.client.informer import SharedInformerFactory
+    from kubernetes_tpu.controllers.endpoint import EndpointController
+    from kubernetes_tpu.nodes.proxy import HollowProxy, ProxyHealthServer
+
+    api = ApiServerLite()
+    factory = SharedInformerFactory(api)
+    api.create("Service", Service("svc", "default", selector={"app": "w"},
+                                  ports=[ServicePort(port=80)]))
+    p0 = make_pod("w0", cpu=10, labels={"app": "w"}, node_name="n0")
+    p0.phase = "Running"
+    api.create("Pod", p0)
+    epc = EndpointController(api, factory, record_events=False)
+    proxy = HollowProxy(factory)
+    factory.step_all()
+    epc.pump()
+    factory.step_all()
+    hs0 = ProxyHealthServer(proxy, "n0")
+    hs1 = ProxyHealthServer(proxy, "n1")
+    hs0.start()
+    hs1.start()
+    try:
+        def probe(port):
+            url = f"http://127.0.0.1:{port}/healthz/default/svc"
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code0, body0 = probe(hs0.port)
+        assert code0 == 200 and body0["localEndpoints"] == 1
+        code1, body1 = probe(hs1.port)  # n1 has no local endpoint
+        assert code1 == 503 and body1["localEndpoints"] == 0
+    finally:
+        hs0.stop()
+        hs1.stop()
