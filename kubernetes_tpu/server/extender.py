@@ -65,10 +65,12 @@ class ExtenderHTTPServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _read_json(self):
+            def _read_raw(self):
                 length = int(self.headers.get("Content-Length", 0))
-                raw = self.rfile.read(length) if length else b"{}"
-                return json.loads(raw or b"{}")
+                return self.rfile.read(length) if length else b""
+
+            def _read_json(self):
+                return json.loads(self._read_raw() or b"{}")
 
             def _write_json(self, obj, code: int = 200):
                 body = json.dumps(obj).encode()
@@ -100,6 +102,36 @@ class ExtenderHTTPServer:
                 if outer.prefix and path.startswith(outer.prefix):
                     path = path[len(outer.prefix):]
                 try:
+                    if path in ("/cache/nodes", "/cache/pods"):
+                        # bulk sync: binary fast path (protobuf, SURVEY
+                        # §5.8 — the --kube-api-content-type analog) or
+                        # the JSON contract, picked by Content-Type
+                        from kubernetes_tpu.api import protowire
+                        ctype = self.headers.get("Content-Type", "")
+                        raw = self._read_raw()
+                        is_nodes = path == "/cache/nodes"
+                        if ctype == protowire.CONTENT_TYPE:
+                            if not protowire.available():
+                                # negotiable failure: tell the client to
+                                # fall back to the JSON contract
+                                self._write_json(
+                                    {"Error": "protobuf unavailable; use "
+                                     "application/json"}, 415)
+                                return
+                            items = (protowire.decode_nodes(raw) if is_nodes
+                                     else protowire.decode_pods(raw))
+                        else:
+                            raw_items = json.loads(raw or b"{}").get(
+                                "items", [])
+                            items = [(serde.decode_node(o) if is_nodes
+                                      else serde.decode_pod(o))
+                                     for o in raw_items]
+                        if is_nodes:
+                            outer.backend.sync_nodes(items)
+                        else:
+                            outer.backend.sync_pods(items)
+                        self._write_json({"synced": len(items)})
+                        return
                     payload = self._read_json()
                     if path == "/filter":
                         self._write_json(outer.handle_filter(payload))
@@ -107,14 +139,6 @@ class ExtenderHTTPServer:
                         self._write_json(outer.handle_prioritize(payload))
                     elif path == "/bind":
                         self._write_json(outer.handle_bind(payload))
-                    elif path == "/cache/nodes":
-                        outer.backend.sync_nodes(
-                            [serde.decode_node(n) for n in payload.get("items", [])])
-                        self._write_json({"synced": len(payload.get("items", []))})
-                    elif path == "/cache/pods":
-                        outer.backend.sync_pods(
-                            [serde.decode_pod(p) for p in payload.get("items", [])])
-                        self._write_json({"synced": len(payload.get("items", []))})
                     else:
                         self._write_json({"error": f"unknown path {self.path}"}, 404)
                 except Exception as e:  # wire errors surface in-band, like the

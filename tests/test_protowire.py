@@ -1,0 +1,121 @@
+"""Protobuf fast-path codec: round-trip fidelity + the extender's binary
+cache sync (the --kube-api-content-type analog, SURVEY §5.8).
+
+The scheduling outcome must be IDENTICAL whether state crossed the wire as
+JSON or protobuf — pinned by evaluating the same pod against a backend
+synced each way.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import protowire, serde
+from kubernetes_tpu.api.types import make_pod
+from kubernetes_tpu.server.extender import ExtenderHTTPServer, TPUExtenderBackend
+from tests.test_full_fuzz import _existing, full_random_nodes, full_random_pod
+
+pytestmark = pytest.mark.skipif(not protowire.available(),
+                                reason="protoc/protobuf unavailable")
+
+
+def _rand_cluster(seed=5, n_nodes=24, n_pods=40):
+    rng = random.Random(seed)
+    nodes = full_random_nodes(rng, n_nodes)
+    pods = [full_random_pod(rng, i, [n.name for n in nodes])
+            for i in range(n_pods)] + _existing(rng, nodes, 10)
+    return nodes, pods
+
+
+def test_nodes_roundtrip_bitexact():
+    nodes, _ = _rand_cluster()
+    out = protowire.decode_nodes(protowire.encode_nodes(nodes))
+    assert len(out) == len(nodes)
+    for a, b in zip(nodes, out):
+        assert a == b, f"node {a.name} diverged over the wire"
+
+
+def test_pods_roundtrip_bitexact_scheduling_fields():
+    _, pods = _rand_cluster()
+    out = protowire.decode_pods(protowire.encode_pods(pods))
+    assert len(out) == len(pods)
+    for a, b in zip(pods, out):
+        # scheduling-read surface must survive exactly (probes/status-only
+        # fields are JSON-path; zero them for the comparison)
+        import dataclasses
+        strip = dict(resource_version=0, ready=True, restart_count=0,
+                     restart_policy="Always", host_network=False,
+                     security_context=None)
+        ca = dataclasses.replace(a, **strip)
+        cb = dataclasses.replace(b, **strip)
+        for c in ca.containers + cb.containers:
+            c.liveness_probe = c.readiness_probe = None
+            c.security_context = None
+        assert ca == cb, f"pod {a.key()} diverged over the wire"
+
+
+def test_binary_payload_is_smaller_than_json():
+    nodes, _ = _rand_cluster(n_nodes=200)
+    binary = protowire.encode_nodes(nodes)
+    as_json = json.dumps({"items": [serde.encode_node(n)
+                                    for n in nodes]}).encode()
+    assert len(binary) < len(as_json), (len(binary), len(as_json))
+
+
+def test_extender_binary_sync_scheduling_equivalence():
+    """Same cluster synced via JSON vs protobuf -> identical /filter and
+    /prioritize answers for the same pod."""
+    nodes, pods = _rand_cluster(seed=9, n_nodes=16, n_pods=0)
+    bound = [p for p in pods if p.node_name]
+
+    def serve(backend):
+        srv = ExtenderHTTPServer(backend)
+        srv.start()
+        return srv
+
+    # JSON path
+    b_json = TPUExtenderBackend()
+    srv_json = serve(b_json)
+    # protobuf path
+    b_pb = TPUExtenderBackend()
+    srv_pb = serve(b_pb)
+    try:
+        url_json = f"http://127.0.0.1:{srv_json.port}"
+        url_pb = f"http://127.0.0.1:{srv_pb.port}"
+        body = json.dumps({"items": [serde.encode_node(n)
+                                     for n in nodes]}).encode()
+        req = urllib.request.Request(
+            url_json + "/cache/nodes", data=body,
+            headers={"Content-Type": "application/json"})
+        assert json.loads(urllib.request.urlopen(req, timeout=30).read())[
+            "synced"] == len(nodes)
+        req = urllib.request.Request(
+            url_pb + "/cache/nodes", data=protowire.encode_nodes(nodes),
+            headers={"Content-Type": protowire.CONTENT_TYPE})
+        assert json.loads(urllib.request.urlopen(req, timeout=30).read())[
+            "synced"] == len(nodes)
+
+        pod = make_pod("probe", cpu=100, node_selector={"disk": "ssd"})
+        args = json.dumps({"Pod": serde.encode_pod(pod),
+                           "NodeNames": [n.name for n in nodes]}).encode()
+
+        def post(url, verb):
+            r = urllib.request.Request(
+                url + verb, data=args,
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(r, timeout=120).read())
+
+        f_json = post(url_json, "/filter")
+        f_pb = post(url_pb, "/filter")
+        assert f_json["NodeNames"] == f_pb["NodeNames"]
+        assert sorted(f_json["FailedNodes"]) == sorted(f_pb["FailedNodes"])
+        p_json = post(url_json, "/prioritize")
+        p_pb = post(url_pb, "/prioritize")
+        assert p_json == p_pb
+    finally:
+        srv_json.stop()
+        srv_pb.stop()
