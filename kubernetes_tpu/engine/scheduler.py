@@ -26,8 +26,12 @@ from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import Binding, Event, Node, Pod
 from kubernetes_tpu.api.workloads import to_workload_object
+from kubernetes_tpu.engine import gang as gangmod
 from kubernetes_tpu.engine.queue import SchedulingQueue
-from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+from kubernetes_tpu.engine.scheduler_engine import (
+    PlacementResult,
+    SchedulingEngine,
+)
 from kubernetes_tpu.ops import priorities as prio
 from kubernetes_tpu.server.apiserver_lite import (
     ApiServerLite,
@@ -75,6 +79,11 @@ class Scheduler:
         self.metrics = SchedulerMetrics()
         self.record_events = record_events
         self.events: List[Event] = []
+        # gangs parked below quorum: name -> {pod key: pod} (engine/gang.py)
+        self._gang_waiting: Dict[str, Dict[str, Pod]] = {}
+        # gangs whose quorum committed: members now schedule individually
+        # (insertion-ordered; trimmed so unbounded gang churn can't leak)
+        self._gang_degraded: Dict[str, None] = {}
         self._rv = 0
         self._pods: Dict[str, Pod] = {}  # last-seen apiserver pod state
         self._started = False
@@ -157,9 +166,47 @@ class Scheduler:
             self.queue.backoff.gc()
             return stats
         trace.field("pods", len(pods))
+        # gang (coscheduling) gating: pods in a group schedule atomically
+        # once their quorum is in the queue (engine/gang.py); incomplete
+        # gangs park in _gang_waiting until members arrive
+        plain, gangs = gangmod.partition(pods)
+        ready_gangs = []
+        for gname, members in gangs.items():
+            if gname in self._gang_degraded:
+                # past the gang's atomicity point (quorum already bound):
+                # stragglers and bind-retries schedule individually instead
+                # of parking below quorum forever
+                plain.extend(members)
+                continue
+            waiting = self._gang_waiting.setdefault(gname, {})
+            for m in members:
+                waiting[m.key()] = m
+            quorum = gangmod.min_available(list(waiting.values()))
+            if len(waiting) >= quorum:
+                ready_gangs.append((gname, list(waiting.values()), quorum))
+                del self._gang_waiting[gname]
         t0 = time.monotonic()
-        results = self.engine.schedule(pods, assume=True,
-                                       mode=self.batch_mode)
+        results = list(self.engine.schedule(plain, assume=True,
+                                            mode=self.batch_mode)) \
+            if plain else []
+        if ready_gangs:
+            for gr in gangmod.schedule_gangs(self.engine, ready_gangs,
+                                             mode=self.batch_mode):
+                if gr.placed:
+                    # quorum committed: the gang is past its atomicity
+                    # point — later members/retries go solo
+                    self._mark_gang_degraded(gr.name)
+                    results.extend(PlacementResult(m, m.node_name, 1)
+                                   for m in gr.placed_members)
+                unschedulable = gr.unplaced_members
+                stats["unschedulable"] += len(unschedulable)
+                if unschedulable:
+                    self.metrics.failed.inc(len(unschedulable))
+                for m in unschedulable:
+                    self._event(m, "Warning", "FailedScheduling",
+                                f"gang {gr.name}: {gr.reason}")
+                    self.queue.add_backoff(
+                        dataclasses.replace(m, node_name=""))
         t_alg = time.monotonic() - t0
         trace.step("batch placement computed (device)")
         per_pod_alg = t_alg / max(len(pods), 1)
@@ -225,6 +272,13 @@ class Scheduler:
 
     # ------------------------------------------------------------- handlers
 
+    _GANG_DEGRADED_MAX = 10_000
+
+    def _mark_gang_degraded(self, name: str) -> None:
+        self._gang_degraded[name] = None
+        while len(self._gang_degraded) > self._GANG_DEGRADED_MAX:
+            self._gang_degraded.pop(next(iter(self._gang_degraded)))
+
     def _responsible_for(self, pod: Pod) -> bool:
         return (pod.scheduler_name or DEFAULT_SCHEDULER_NAME) == self.scheduler_name
 
@@ -255,6 +309,11 @@ class Scheduler:
     def _on_pod_event(self, etype: str, pod: Pod) -> None:
         key = pod.key()
         prev = self._pods.get(key)
+        # any event invalidates a parked gang copy: the pod either left
+        # (DELETED/bound) or changed spec — it re-enters via the queue and
+        # re-partitions fresh, never schedules from a stale parked object
+        for waiting in self._gang_waiting.values():
+            waiting.pop(key, None)
         if etype == "DELETED":
             self._pods.pop(key, None)
             self.queue.remove(key)
@@ -297,6 +356,8 @@ class Scheduler:
             policy_algos=self._policy_algos)
         self.queue = SchedulingQueue(now=self._now)
         self._pods = {}
+        self._gang_waiting = {}
+        self._gang_degraded = {}
         self._started = False
         self.start()
 

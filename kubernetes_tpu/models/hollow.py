@@ -114,11 +114,41 @@ def hetero_gpu_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod
     return out
 
 
+def gang_pods(n: int, seed: int = 0, namespace: str = "bench",
+              gang_size: int = 8) -> List[Pod]:
+    """BASELINE.json config 4: coscheduled batch jobs — n pods in gangs of
+    `gang_size` (scheduling.k8s.io/group-name), all-or-nothing placement.
+    Every ~16th gang is provably infeasible (one member requests more CPU
+    than any node has) so atomic rollback is exercised, not just the happy
+    path."""
+    from kubernetes_tpu.engine.gang import (
+        GANG_MIN_AVAILABLE_ANNOTATION,
+        GANG_NAME_ANNOTATION,
+    )
+    out: List[Pod] = []
+    rng = random.Random(seed)
+    n_gangs = (n + gang_size - 1) // gang_size
+    for g in range(n_gangs):
+        infeasible = g % 16 == 15
+        for m in range(min(gang_size, n - g * gang_size)):
+            cpu = 100 if not (infeasible and m == 0) else 1_000_000
+            pod = make_pod(f"gang-{g:04d}-{m:02d}", namespace=namespace,
+                           cpu=cpu, memory=128 * Mi,
+                           labels={"job": f"job-{g:04d}"})
+            pod.annotations[GANG_NAME_ANNOTATION] = f"job-{g:04d}"
+            pod.annotations[GANG_MIN_AVAILABLE_ANNOTATION] = str(
+                min(gang_size, n - g * gang_size))
+            out.append(pod)
+    rng.shuffle(out)  # members arrive interleaved, like real job storms
+    return out
+
+
 PROFILES = {
     "density": density_pods,
     "binpack": binpack_pods,
     "affinity": affinity_pods,
     "hetero": hetero_gpu_pods,
+    "gang": gang_pods,
 }
 
 
