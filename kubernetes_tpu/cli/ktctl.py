@@ -413,6 +413,93 @@ class Ktctl:
                                            key=lambda kv: kv[1][0]):
             self._print(f"{res}  {kind}  {str(not cluster).lower()}")
 
+    def cmd_auth(self, args):
+        """kubectl auth can-i VERB RESOURCE [NAME] [--as user] [--as-group g]
+        [-n ns] — evaluates the configured authorizer chain
+        (pkg/kubectl/cmd/auth/cani.go via SelfSubjectAccessReview)."""
+        pos, flags = self._flags(args)
+        if pos[:1] != ["can-i"] or len(pos) < 3:
+            raise SystemExit("error: usage: auth can-i VERB RESOURCE [NAME]")
+        authorizer = getattr(self.api, "authorizer", None)
+        if authorizer is None:
+            raise SystemExit("error: server has no authorizer configured")
+        from kubernetes_tpu.auth.authz import ALLOW, Attributes
+        from kubernetes_tpu.api.rbac import UserInfo
+        groups = [g for g in flags.get("as-group", "").split(",") if g]
+        user = UserInfo(name=flags.get("as", "system:admin"), groups=groups)
+        attrs = Attributes(
+            user=user, verb=pos[1], resource=pos[2],
+            namespace=flags.get("namespace", "default"),
+            name=pos[3] if len(pos) > 3 else "")
+        self._print("yes" if authorizer.authorize(attrs) == ALLOW else "no")
+
+    def cmd_expose(self, args):
+        """kubectl expose KIND NAME --port P [--target-port T] [--name N]:
+        create a Service selecting the workload's pods
+        (pkg/kubectl/cmd/expose.go + the service generator)."""
+        from kubernetes_tpu.api.workloads import (
+            Service,
+            ServicePort,
+            selector_of,
+        )
+        pos, flags = self._flags(args)
+        if len(pos) < 2 or "port" not in flags:
+            raise SystemExit("error: usage: expose KIND NAME --port P")
+        kind = resolve_kind(pos[0])
+        ns = flags.get("namespace", "default")
+        obj = self.api.get(kind, ns, pos[1])
+        sel = selector_of(obj)
+        if sel.match_expressions:
+            raise SystemExit("error: cannot expose via expression selector "
+                             "(service selectors are equality-only)")
+        if not sel.match_labels:
+            raise SystemExit(f"error: {kind} {pos[1]} has no selector")
+        try:
+            port = int(flags["port"])
+            target = int(flags.get("target-port", port))
+        except ValueError:
+            raise SystemExit("error: --port/--target-port must be integers")
+        svc = Service(
+            flags.get("name", pos[1]), ns, selector=dict(sel.match_labels),
+            ports=[ServicePort(port=port, target_port=target)])
+        self.api.create("Service", svc)
+        self._print(f"service/{svc.name} exposed")
+
+    def cmd_set(self, args):
+        """kubectl set image KIND NAME CONTAINER=IMAGE...: update pod
+        template images (pkg/kubectl/cmd/set/set_image.go) — rollouts pick
+        the change up through the normal template-hash machinery."""
+        import dataclasses as _dc
+        pos, flags = self._flags(args)
+        if pos[:1] != ["image"] or len(pos) < 4:
+            raise SystemExit(
+                "error: usage: set image KIND NAME CONTAINER=IMAGE")
+        kind = resolve_kind(pos[1])
+        ns = flags.get("namespace", "default")
+        obj = self.api.get(kind, ns, pos[2])
+        template = getattr(obj, "template", None)
+        if template is None:
+            raise SystemExit(f"error: {kind} has no pod template")
+        if any("=" not in kv for kv in pos[3:]):
+            raise SystemExit(
+                "error: usage: set image KIND NAME CONTAINER=IMAGE")
+        updates = dict(kv.split("=", 1) for kv in pos[3:])
+        new_containers = []
+        changed = False
+        for c in template.containers:
+            if c.name in updates or "*" in updates:
+                img = updates.get(c.name, updates.get("*"))
+                new_containers.append(_dc.replace(c, image=img))
+                changed = True
+            else:
+                new_containers.append(c)
+        if not changed:
+            raise SystemExit("error: no matching container")
+        new_template = _dc.replace(template, containers=new_containers)
+        self.api.update(kind, _dc.replace(obj, template=new_template),
+                        expect_rv=obj.resource_version)
+        self._print(f"{kind.lower()}/{pos[2]} image updated")
+
     def cmd_federate(self, args):
         """kubefed verbs (federation/cmd kubefed + federated-RS CRUD):
         federate join <cluster> | unjoin <cluster> | clusters |

@@ -84,6 +84,7 @@ class Scheduler:
         # gangs whose quorum committed: members now schedule individually
         # (insertion-ordered; trimmed so unbounded gang churn can't leak)
         self._gang_degraded: Dict[str, None] = {}
+        self._gang_parked_at: Dict[str, float] = {}
         self._rv = 0
         self._pods: Dict[str, Pod] = {}  # last-seen apiserver pod state
         self._started = False
@@ -179,16 +180,36 @@ class Scheduler:
                 plain.extend(members)
                 continue
             waiting = self._gang_waiting.setdefault(gname, {})
+            if gname not in self._gang_parked_at:
+                self._gang_parked_at[gname] = self._now()
             for m in members:
                 waiting[m.key()] = m
             quorum = gangmod.min_available(list(waiting.values()))
             if len(waiting) >= quorum:
                 ready_gangs.append((gname, list(waiting.values()), quorum))
                 del self._gang_waiting[gname]
+                self._gang_parked_at.pop(gname, None)
+        # parked-too-long gangs surface instead of waiting silently forever
+        # (quorum may never arrive: members deleted, minAvailable typo);
+        # members re-queue with backoff — retried AND visible via events
+        now = self._now()
+        for gname in [g for g, t0_ in self._gang_parked_at.items()
+                      if now - t0_ > self.GANG_WAIT_TIMEOUT_S]:
+            waiting = self._gang_waiting.pop(gname, {})
+            self._gang_parked_at.pop(gname, None)
+            for m in waiting.values():
+                self._event(m, "Warning", "FailedScheduling",
+                            f"gang {gname} below quorum for "
+                            f"{self.GANG_WAIT_TIMEOUT_S:.0f}s")
+                self.queue.add_backoff(m)
         t0 = time.monotonic()
-        results = list(self.engine.schedule(plain, assume=True,
-                                            mode=self.batch_mode)) \
-            if plain else []
+        scheduled_count = len(plain) + sum(len(m) for _g, m, _q in
+                                           ready_gangs)
+        results = []
+        # ready gangs place FIRST: their members were necessarily queued at
+        # or before this round's plain pods, and placing plain first would
+        # let a sustained plain stream starve contended gangs (each retry
+        # seeing capacity already consumed)
         if ready_gangs:
             for gr in gangmod.schedule_gangs(self.engine, ready_gangs,
                                              mode=self.batch_mode):
@@ -207,9 +228,15 @@ class Scheduler:
                                 f"gang {gr.name}: {gr.reason}")
                     self.queue.add_backoff(
                         dataclasses.replace(m, node_name=""))
+        if plain:
+            results.extend(self.engine.schedule(plain, assume=True,
+                                                mode=self.batch_mode))
         t_alg = time.monotonic() - t0
         trace.step("batch placement computed (device)")
-        per_pod_alg = t_alg / max(len(pods), 1)
+        # amortize over pods actually SCHEDULED this round (parked gang
+        # members were popped but not placed; counting them would
+        # understate the per-pod latency histograms)
+        per_pod_alg = t_alg / max(scheduled_count, 1)
         placed = []
         for r in results:
             if r.node_name is None:
@@ -254,7 +281,8 @@ class Scheduler:
         # per-pod amortized threshold: a 30k-pod round is not "slow" the way
         # a 30k-pod-long one-pod trace would be; scale like the reference's
         # per-Schedule-call threshold
-        trace.log_if_long(SCHEDULE_TRACE_THRESHOLD_S * max(len(pods), 1))
+        trace.log_if_long(SCHEDULE_TRACE_THRESHOLD_S
+                          * max(scheduled_count, 1))
         return stats
 
     def run_until_drained(self, max_rounds: int = 10_000,
@@ -273,8 +301,12 @@ class Scheduler:
     # ------------------------------------------------------------- handlers
 
     _GANG_DEGRADED_MAX = 10_000
+    GANG_WAIT_TIMEOUT_S = 60.0  # parked-below-quorum visibility timeout
 
     def _mark_gang_degraded(self, name: str) -> None:
+        # re-marking refreshes recency so an active gang's entry is never
+        # the one evicted
+        self._gang_degraded.pop(name, None)
         self._gang_degraded[name] = None
         while len(self._gang_degraded) > self._GANG_DEGRADED_MAX:
             self._gang_degraded.pop(next(iter(self._gang_degraded)))
@@ -358,6 +390,7 @@ class Scheduler:
         self._pods = {}
         self._gang_waiting = {}
         self._gang_degraded = {}
+        self._gang_parked_at = {}
         self._started = False
         self.start()
 

@@ -215,3 +215,61 @@ def test_ktctl_bool_flag_then_output_flag():
     assert cli.run(["get", "pods", "--all-namespaces", "-o", "json"]) == 0
     data = json.loads(out.getvalue())
     assert data[0]["name"] == "a"
+
+
+def test_ktctl_auth_can_i():
+    """auth can-i through the full apiserver's authorizer chain."""
+    import io
+
+    from kubernetes_tpu.api.rbac import (
+        PolicyRule,
+        Role,
+        RoleBinding,
+        RoleRef,
+        Subject,
+    )
+    from kubernetes_tpu.cli.ktctl import Ktctl
+    from kubernetes_tpu.server.apiserver import ApiServer
+
+    srv = ApiServer(auth=False)
+    srv.store.create("Role", Role(
+        "pod-reader", "default",
+        rules=[PolicyRule(verbs=["get", "list"], resources=["pods"])]))
+    srv.store.create("RoleBinding", RoleBinding(
+        "rb", "default",
+        subjects=[Subject(kind="User", name="alice")],
+        role_ref=RoleRef(kind="Role", name="pod-reader")))
+    out = io.StringIO()
+    kt = Ktctl(srv, out=out)
+    assert kt.run(["auth", "can-i", "get", "pods", "--as", "alice"]) == 0
+    assert kt.run(["auth", "can-i", "delete", "pods", "--as", "alice"]) == 0
+    text = out.getvalue().splitlines()
+    assert text == ["yes", "no"]
+
+
+def test_ktctl_expose_and_set_image():
+    import io
+
+    from kubernetes_tpu.api.types import LabelSelector, make_pod
+    from kubernetes_tpu.api.workloads import Namespace, ReplicaSet
+    from kubernetes_tpu.cli.ktctl import Ktctl
+    from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+    api = ApiServerLite()
+    api.create("Namespace", Namespace("default"))
+    tmpl = make_pod("", labels={"app": "web"})
+    tmpl.containers[0].image = "nginx:1.12"
+    api.create("ReplicaSet", ReplicaSet(
+        "web", replicas=3,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=tmpl))
+    out = io.StringIO()
+    kt = Ktctl(api, out=out)
+    assert kt.run(["expose", "rs", "web", "--port", "80",
+                   "--target-port", "8080"]) == 0
+    svc = api.get("Service", "default", "web")
+    assert svc.selector == {"app": "web"}
+    assert svc.ports[0].port == 80 and svc.ports[0].target_port == 8080
+    assert kt.run(["set", "image", "rs", "web", "c0=nginx:1.13"]) == 0
+    rs = api.get("ReplicaSet", "default", "web")
+    assert rs.template.containers[0].image == "nginx:1.13"
