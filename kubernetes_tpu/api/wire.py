@@ -62,7 +62,15 @@ def _psp_type():
     return PodSecurityPolicy
 
 
+def _ext_types():
+    from kubernetes_tpu.api import extensions as ext
+    return ext
+
+
 KIND_REGISTRY["PodSecurityPolicy"] = _psp_type()
+KIND_REGISTRY["CustomResourceDefinition"] = \
+    _ext_types().CustomResourceDefinition
+KIND_REGISTRY["APIService"] = _ext_types().APIService
 KIND_REGISTRY = {k: v for k, v in KIND_REGISTRY.items() if v is not None}
 
 
@@ -145,11 +153,57 @@ def decode_any(data: Dict[str, Any], kind: Optional[str] = None) -> Any:
         return decode_pod(data)
     if "metadata" in data and kind == "Node":
         return decode_node(data)
+    if "metadata" in data and kind == "CustomResourceDefinition":
+        return decode_crd_manifest(data)
     cls = KIND_REGISTRY.get(kind)
     if cls is None:
-        raise ValueError(f"unknown kind {kind!r}")
+        # custom (CRD-defined) kind: decode into the schemaless
+        # CustomResource shape — both the native flat encoding and the
+        # upstream metadata/spec manifest shape are accepted
+        from kubernetes_tpu.api.extensions import CustomResource
+        if "metadata" in data:
+            meta = data.get("metadata", {})
+            return CustomResource(
+                kind=kind, name=meta.get("name", ""),
+                namespace=meta.get("namespace", ""),
+                api_version=data.get("apiVersion", ""),
+                labels=dict(meta.get("labels", {})),
+                spec=dict(data.get("spec", {})),
+                status=dict(data.get("status", {})))
+        body = {k: v for k, v in data.items()
+                if k not in ("kind", "apiVersion")}
+        return decode_dataclass({"kind": kind, **body}, CustomResource)
     data = {k: v for k, v in data.items() if k not in ("kind", "apiVersion")}
     return decode_dataclass(data, cls)
+
+
+def decode_crd_manifest(data: Dict[str, Any]) -> Any:
+    """Decode an upstream apiextensions.k8s.io CRD manifest
+    (metadata/spec shape, incl. the v1.7-era
+    spec.validation.openAPIV3Schema) into the native
+    CustomResourceDefinition."""
+    from kubernetes_tpu.api.extensions import CRDNames, \
+        CustomResourceDefinition
+    meta, spec = data.get("metadata", {}), data.get("spec", {})
+    names = spec.get("names", {})
+    validation: Dict[str, Any] = {}
+    schema = (spec.get("validation", {}) or {}).get("openAPIV3Schema", {})
+    spec_schema = (schema.get("properties", {}) or {}).get("spec", {})
+    if spec_schema:
+        validation = dict(spec_schema.get("properties", {}) or {})
+        if spec_schema.get("required"):
+            validation["required"] = list(spec_schema["required"])
+    return CustomResourceDefinition(
+        name=meta.get("name", ""),
+        group=spec.get("group", ""),
+        version=spec.get("version", ""),
+        names=CRDNames(
+            plural=names.get("plural", ""),
+            kind=names.get("kind", ""),
+            singular=names.get("singular", ""),
+            short_names=list(names.get("shortNames", []))),
+        scope=spec.get("scope", "Namespaced"),
+        validation=validation)
 
 
 def dumps(obj: Any, kind: str) -> str:

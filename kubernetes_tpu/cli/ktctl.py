@@ -67,6 +67,10 @@ def resolve_kind(arg: str) -> str:
     return kind
 
 
+def kind_plural(kind: str) -> str:
+    return KIND_INFO.get(kind, (kind.lower() + "s", False))[0]
+
+
 # ---------------------------------------------------------------- printers
 
 def _pod_row(p: Pod) -> List[str]:
@@ -111,14 +115,15 @@ def table(kind: str, objs: Sequence[Any], wide: bool = False) -> str:
     return "\n".join(lines)
 
 
-def render(kind: str, objs: Sequence[Any], output: str) -> str:
+def render(kind: str, objs: Sequence[Any], output: str,
+           plural: str = "") -> str:
     if output == "json":
         return json.dumps([wire.encode(o, kind=kind) for o in objs],
                           indent=2)
     if output == "yaml":
         return yaml.safe_dump([wire.encode(o, kind=kind) for o in objs])
     if output == "name":
-        res = KIND_INFO[kind][0]
+        res = plural or kind_plural(kind)
         return "\n".join(f"{res}/{getattr(o, 'name', '')}" for o in objs)
     return table(kind, objs, wide=(output == "wide"))
 
@@ -208,13 +213,58 @@ class Ktctl:
             i += 1
         return pos, flags
 
+    # -- dynamic resource resolution (discovery-backed, CRDs included) ----
+
+    def _discovery_resources(self) -> List[Dict[str, Any]]:
+        try:
+            return self.api.discovery().get("resources", [])
+        except Exception:
+            return []
+
+    def _resolve_kind(self, arg: str) -> str:
+        """Builtin aliases first, then the discovery doc — so
+        `ktctl get tputopologies` (or a CRD short name) works as soon as
+        the CRD is Established, like kubectl's RESTMapper over the
+        discovery client."""
+        try:
+            return resolve_kind(arg)
+        except SystemExit:
+            low = arg.lower()
+            res = ALIASES.get(low, low)
+            for r in self._discovery_resources():
+                if not r.get("group"):
+                    continue
+                if r["name"] == res or r["kind"].lower() == low or low in \
+                        [s.lower() for s in r.get("shortNames", [])]:
+                    return r["kind"]
+            raise
+
+    def _cluster_scoped(self, kind: str) -> bool:
+        if kind in KIND_INFO:
+            return KIND_INFO[kind][1]
+        for r in self._discovery_resources():
+            if r["kind"] == kind:
+                return not r["namespaced"]
+        return False
+
+    def _plural(self, kind: str) -> str:
+        """Resource name for output (`pods/x created`, `-o name`) — the
+        discovery doc is authoritative for CRD kinds, so the printed name
+        round-trips back into ktctl."""
+        if kind in KIND_INFO:
+            return KIND_INFO[kind][0]
+        for r in self._discovery_resources():
+            if r["kind"] == kind:
+                return r["name"]
+        return kind_plural(kind)
+
     def _objs(self, kind: str, ns: str, name: str = "",
               selector: str = "") -> List[Any]:
         if name:
-            return [self.api.get(kind, ns if not KIND_INFO[kind][1] else "",
+            return [self.api.get(kind, ns if not self._cluster_scoped(kind) else "",
                                  name)]
         objs, _ = self.api.list(kind)
-        if not KIND_INFO[kind][1] and ns != "*":
+        if not self._cluster_scoped(kind) and ns != "*":
             objs = [o for o in objs if getattr(o, "namespace", "") == ns]
         if selector:
             want = dict(kv.split("=", 1) for kv in selector.split(",")
@@ -228,17 +278,18 @@ class Ktctl:
         pos, flags = self._flags(args)
         if not pos:
             raise SystemExit("error: resource type required")
-        kind = resolve_kind(pos[0])
+        kind = self._resolve_kind(pos[0])
         ns = flags.get("namespace", "default")
         if "all-namespaces" in flags:
             ns = "*"
         objs = self._objs(kind, ns, pos[1] if len(pos) > 1 else "",
                           flags.get("selector", ""))
-        self._print(render(kind, objs, flags.get("output", "table")))
+        self._print(render(kind, objs, flags.get("output", "table"),
+                           plural=self._plural(kind)))
 
     def cmd_describe(self, args):
         pos, flags = self._flags(args)
-        kind = resolve_kind(pos[0])
+        kind = self._resolve_kind(pos[0])
         ns = flags.get("namespace", "default")
         for obj in self._objs(kind, ns, pos[1] if len(pos) > 1 else ""):
             self._print(describe(kind, obj))
@@ -255,7 +306,7 @@ class Ktctl:
         for obj, raw in zip(objs, raws):
             kind = raw.get("kind")
             self.api.create(kind, obj)
-            self._print(f"{KIND_INFO[kind][0]}/{obj.name} created")
+            self._print(f"{self._plural(kind)}/{obj.name} created")
 
     def cmd_apply(self, args):
         _, flags = self._flags(args)
@@ -267,42 +318,42 @@ class Ktctl:
                                                            sort_keys=True)
             ns = getattr(obj, "namespace", "")
             try:
-                cur = self.api.get(kind, ns if not KIND_INFO[kind][1] else "",
+                cur = self.api.get(kind, ns if not self._cluster_scoped(kind) else "",
                                    obj.name)
             except Exception:
                 cur = None
             if cur is None:
                 self.api.create(kind, obj)
-                self._print(f"{KIND_INFO[kind][0]}/{obj.name} created")
+                self._print(f"{self._plural(kind)}/{obj.name} created")
             else:
                 prev = getattr(cur, "annotations", {}).get(LAST_APPLIED)
                 if prev == json.dumps(raw, sort_keys=True):
-                    self._print(f"{KIND_INFO[kind][0]}/{obj.name} unchanged")
+                    self._print(f"{self._plural(kind)}/{obj.name} unchanged")
                     continue
                 obj.resource_version = cur.resource_version
                 self.api.update(kind, obj)
-                self._print(f"{KIND_INFO[kind][0]}/{obj.name} configured")
+                self._print(f"{self._plural(kind)}/{obj.name} configured")
 
     def cmd_delete(self, args):
         pos, flags = self._flags(args)
-        kind = resolve_kind(pos[0])
+        kind = self._resolve_kind(pos[0])
         ns = flags.get("namespace", "default")
         for obj in self._objs(kind, ns, pos[1] if len(pos) > 1 else "",
                               flags.get("selector", "")):
             self.api.delete(kind, getattr(obj, "namespace", ""), obj.name)
-            self._print(f"{KIND_INFO[kind][0]}/{obj.name} deleted")
+            self._print(f"{self._plural(kind)}/{obj.name} deleted")
 
     def cmd_scale(self, args):
         pos, flags = self._flags(args)
-        kind = resolve_kind(pos[0])
+        kind = self._resolve_kind(pos[0])
         reps = int(flags["replicas"])
         self.api.scale(kind, flags.get("namespace", "default"), pos[1],
                        replicas=reps)
-        self._print(f"{KIND_INFO[kind][0]}/{pos[1]} scaled")
+        self._print(f"{self._plural(kind)}/{pos[1]} scaled")
 
     def _mutate_meta(self, args, field: str):
         pos, flags = self._flags(args)
-        kind = resolve_kind(pos[0])
+        kind = self._resolve_kind(pos[0])
         ns = flags.get("namespace", "default")
         obj = self._objs(kind, ns, pos[1])[0]
         d = getattr(obj, field)
@@ -313,7 +364,7 @@ class Ktctl:
                 k, _, v = kv.partition("=")
                 d[k] = v
         self.api.update(kind, obj)
-        self._print(f"{KIND_INFO[kind][0]}/{pos[1]} {field[:-1]}ed")
+        self._print(f"{self._plural(kind)}/{pos[1]} {field[:-1]}ed")
 
     def cmd_label(self, args):
         self._mutate_meta(args, "labels")
@@ -367,14 +418,14 @@ class Ktctl:
     def cmd_rollout(self, args):
         pos, flags = self._flags(args)
         sub, kind_arg, name = pos[0], pos[1], pos[2]
-        kind = resolve_kind(kind_arg)
+        kind = self._resolve_kind(kind_arg)
         ns = flags.get("namespace", "default")
         obj = self.api.get(kind, ns, name)
         if sub == "status":
             ready = getattr(obj, "ready_replicas", 0)
             want = getattr(obj, "replicas", 0)
             if ready >= want:
-                self._print(f'{KIND_INFO[kind][0]} "{name}" successfully '
+                self._print(f'{self._plural(kind)} "{name}" successfully '
                             "rolled out")
             else:
                 self._print(f"Waiting for rollout to finish: {ready} of "
@@ -388,7 +439,7 @@ class Ktctl:
                 raise SystemExit("error: no rollout history found")
             obj.template = hist[-1]
             self.api.update(kind, obj)
-            self._print(f"{KIND_INFO[kind][0]}/{name} rolled back")
+            self._print(f"{self._plural(kind)}/{name} rolled back")
 
     def cmd_top(self, args):
         pos, _ = self._flags(args)
@@ -408,10 +459,14 @@ class Ktctl:
                 self._print(f"{n.name}  {u[0]}m  {u[1]}")
 
     def cmd_api_resources(self, args):
-        self._print("NAME  KIND  NAMESPACED")
-        for kind, (res, cluster) in sorted(KIND_INFO.items(),
-                                           key=lambda kv: kv[1][0]):
-            self._print(f"{res}  {kind}  {str(not cluster).lower()}")
+        self._print("NAME  APIGROUP  KIND  NAMESPACED")
+        rows = self._discovery_resources() or [
+            {"name": res, "group": "", "kind": kind,
+             "namespaced": not cluster}
+            for kind, (res, cluster) in KIND_INFO.items()]
+        for r in sorted(rows, key=lambda r: (r.get("group", ""), r["name"])):
+            self._print(f"{r['name']}  {r.get('group', '')}  {r['kind']}  "
+                        f"{str(r['namespaced']).lower()}")
 
     def cmd_auth(self, args):
         """kubectl auth can-i VERB RESOURCE [NAME] [--as user] [--as-group g]
@@ -445,7 +500,7 @@ class Ktctl:
         pos, flags = self._flags(args)
         if len(pos) < 2 or "port" not in flags:
             raise SystemExit("error: usage: expose KIND NAME --port P")
-        kind = resolve_kind(pos[0])
+        kind = self._resolve_kind(pos[0])
         ns = flags.get("namespace", "default")
         obj = self.api.get(kind, ns, pos[1])
         sel = selector_of(obj)
@@ -474,7 +529,7 @@ class Ktctl:
         if pos[:1] != ["image"] or len(pos) < 4:
             raise SystemExit(
                 "error: usage: set image KIND NAME CONTAINER=IMAGE")
-        kind = resolve_kind(pos[1])
+        kind = self._resolve_kind(pos[1])
         ns = flags.get("namespace", "default")
         obj = self.api.get(kind, ns, pos[2])
         template = getattr(obj, "template", None)

@@ -27,13 +27,32 @@ class RestClient:
     def __init__(self, base: str, token: str = ""):
         self.base = base.rstrip("/")
         self.token = token
+        self._discovery_cache = None
 
     # ------------------------------------------------------------ plumbing
 
+    def discovery(self) -> dict:
+        """GET /apis — the discovery document (built-ins + CRDs +
+        aggregated groups); cached per client like client-go's
+        CachedDiscoveryClient."""
+        if self._discovery_cache is None:
+            self._discovery_cache = self._do("GET", self.base + "/apis")
+        return self._discovery_cache
+
     def _url(self, kind: str, namespace: str, name: str = "",
              sub: str = "") -> str:
-        resource, cluster = KIND_INFO[kind]
-        path = "/api/v1"
+        if kind in KIND_INFO:
+            resource, cluster = KIND_INFO[kind]
+            path = "/api/v1"
+        else:
+            # CRD-defined kind: route through the group path
+            # /apis/{group}/{version}/... per the discovery doc
+            row = next((r for r in self.discovery()["resources"]
+                        if r["kind"] == kind and r.get("group")), None)
+            if row is None:
+                raise NotFound(f"unknown kind {kind!r}")
+            resource, cluster = row["name"], not row["namespaced"]
+            path = f"/apis/{row['group']}/{row['version']}"
         if namespace and not cluster:
             path += f"/namespaces/{namespace}"
         path += f"/{resource}"
@@ -76,6 +95,9 @@ class RestClient:
         ns = getattr(obj, "namespace", "")
         out = self._do("POST", self._url(kind, ns),
                        wire.encode(obj, kind=kind))
+        if kind == "CustomResourceDefinition":
+            # the served-resource set changed; re-discover on next use
+            self._discovery_cache = None
         return out.get("resourceVersion", 0)
 
     def update(self, kind: str, obj: Any,
@@ -95,6 +117,8 @@ class RestClient:
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._do("DELETE", self._url(kind, namespace, name))
+        if kind == "CustomResourceDefinition":
+            self._discovery_cache = None
 
     def bind(self, binding: Binding) -> int:
         out = self._do("POST",

@@ -162,6 +162,20 @@ class Scheduler:
         pods = self.queue.pop_batch(max_n=max_batch, wait=wait)
         stats = {"popped": len(pods), "bound": 0, "unschedulable": 0,
                  "bind_errors": 0}
+        # parked-too-long gangs surface even on empty rounds — a gang below
+        # quorum with no new arrivals would otherwise never reach the sweep
+        # (quorum may never come: members deleted, minAvailable typo);
+        # members re-queue with backoff — retried AND visible via events
+        now = self._now()
+        for gname in [g for g, t0_ in self._gang_parked_at.items()
+                      if now - t0_ > self.GANG_WAIT_TIMEOUT_S]:
+            waiting = self._gang_waiting.pop(gname, {})
+            self._gang_parked_at.pop(gname, None)
+            for m in waiting.values():
+                self._event(m, "Warning", "FailedScheduling",
+                            f"gang {gname} below quorum for "
+                            f"{self.GANG_WAIT_TIMEOUT_S:.0f}s")
+                self.queue.add_backoff(m)
         if not pods:
             self.cache.cleanup_assumed()
             self.queue.backoff.gc()
@@ -189,19 +203,6 @@ class Scheduler:
                 ready_gangs.append((gname, list(waiting.values()), quorum))
                 del self._gang_waiting[gname]
                 self._gang_parked_at.pop(gname, None)
-        # parked-too-long gangs surface instead of waiting silently forever
-        # (quorum may never arrive: members deleted, minAvailable typo);
-        # members re-queue with backoff — retried AND visible via events
-        now = self._now()
-        for gname in [g for g, t0_ in self._gang_parked_at.items()
-                      if now - t0_ > self.GANG_WAIT_TIMEOUT_S]:
-            waiting = self._gang_waiting.pop(gname, {})
-            self._gang_parked_at.pop(gname, None)
-            for m in waiting.values():
-                self._event(m, "Warning", "FailedScheduling",
-                            f"gang {gname} below quorum for "
-                            f"{self.GANG_WAIT_TIMEOUT_S:.0f}s")
-                self.queue.add_backoff(m)
         t0 = time.monotonic()
         scheduled_count = len(plain) + sum(len(m) for _g, m, _q in
                                            ready_gangs)

@@ -57,6 +57,13 @@ from kubernetes_tpu.server.apiserver_lite import (
     Conflict,
     NotFound,
 )
+from kubernetes_tpu.server.extensions import (
+    crd_delete_cascade,
+    crd_on_create,
+    discovery_doc,
+    resolve_crd,
+    validate_custom_create,
+)
 
 # kind -> (resource plural, cluster-scoped)
 KIND_INFO: Dict[str, Tuple[str, bool]] = {
@@ -166,12 +173,31 @@ class ApiServer:
             raise Unauthenticated("no credentials provided")
         return self.authenticator.authenticate(cred)
 
+    def _serving_info(self, kind: str, for_write: bool = False):
+        """Dynamic discovery: (plural, cluster_scoped, crd-or-None) for a
+        served kind — built-in or backed by an Established CRD; anything
+        else 404s like an unregistered resource on the real server
+        (apiextensions customresource_handler.go)."""
+        if kind in KIND_INFO:
+            plural, cluster_scoped = KIND_INFO[kind]
+            return plural, cluster_scoped, None
+        crd = resolve_crd(self.store, kind, for_write=for_write)
+        if crd is None:
+            raise NotFound(
+                f"the server could not find the requested resource "
+                f"(kind {kind!r})")
+        return crd.names.plural, crd.scope == "Cluster", crd
+
     def _authz(self, user: UserInfo, verb: str, kind: str, namespace: str,
                name: str, subresource: str = "") -> None:
         if not self.auth_enabled:
             return
         resource, cluster_scoped = KIND_INFO.get(kind, (kind.lower() + "s",
                                                         False))
+        crd = None if kind in KIND_INFO else resolve_crd(self.store, kind)
+        if crd is not None:
+            resource, cluster_scoped = (crd.names.plural,
+                                        crd.scope == "Cluster")
         if subresource:
             resource = resource + "/" + subresource
         attrs = Attributes(user=user, verb=verb, resource=resource,
@@ -231,6 +257,13 @@ class ApiServer:
         ns = getattr(obj, "namespace", "")
 
         def do(user: UserInfo) -> int:
+            _, _, crd = self._serving_info(kind, for_write=True)
+            if kind == "CustomResourceDefinition":
+                # naming + establishing controller work, done atomically
+                # at admission time (server/extensions.py)
+                crd_on_create(self.store, obj, KIND_INFO)
+            elif crd is not None:
+                validate_custom_create(crd, obj)
             if self.auth_enabled and kind == "CertificateSigningRequest":
                 # registry strategy PrepareForCreate: requestor identity is
                 # stamped from the authenticated user, never client-supplied
@@ -258,8 +291,11 @@ class ApiServer:
 
     def get(self, kind: str, namespace: str, name: str,
             cred: Optional[Credential] = None) -> Any:
-        return self._run(cred, "get", kind, namespace, name,
-                         lambda u: self.store.get(kind, namespace, name))
+        def do(user: UserInfo) -> Any:
+            self._serving_info(kind)
+            return self.store.get(kind, namespace, name)
+
+        return self._run(cred, "get", kind, namespace, name, do)
 
     def list(self, kind: str, cred: Optional[Credential] = None,
              namespace: str = ""):
@@ -268,6 +304,7 @@ class ApiServer:
         namespaced list endpoints."""
 
         def do(user: UserInfo):
+            self._serving_info(kind)
             objs, rv = self.store.list(kind)
             if namespace:
                 objs = [o for o in objs
@@ -281,6 +318,14 @@ class ApiServer:
         ns = getattr(obj, "namespace", "")
 
         def do(user: UserInfo) -> int:
+            _, _, crd = self._serving_info(kind, for_write=True)
+            if crd is not None:
+                validate_custom_create(crd, obj)
+            if kind == "CustomResourceDefinition":
+                # updates re-run the naming/structure checks create
+                # enforces — else a PUT could rename plural/kind/group
+                # into a collision or break the plural.group invariant
+                crd_on_create(self.store, obj, KIND_INFO)
             old = self._try_get(kind, ns, obj.name)
             if kind == "CertificateSigningRequest" and old is not None:
                 # ValidateUpdate (certificates/strategy.go): the request
@@ -310,9 +355,18 @@ class ApiServer:
     def delete(self, kind: str, namespace: str, name: str,
                cred: Optional[Credential] = None) -> None:
         def do(user: UserInfo) -> None:
+            self._serving_info(kind)
             old = self._try_get(kind, namespace, name)
             self.admission.admit(AdmissionRequest(
                 "DELETE", kind, namespace, name, old_obj=old, user=user))
+            if kind == "CustomResourceDefinition":
+                if old is None:
+                    raise NotFound(
+                        f"customresourcedefinitions {name!r} not found")
+                # customresourcecleanup finalizer: purge instances
+                # before the definition row goes away
+                crd_delete_cascade(self.store, old)
+                return
             if kind == "Namespace":
                 # two-phase delete: mark Terminating; the namespace
                 # controller empties it then finalizes (pkg/controller/
@@ -458,6 +512,16 @@ class ApiServer:
 
     def healthz(self) -> Dict[str, str]:
         return {"status": "ok"}
+
+    def discovery(self) -> Dict[str, Any]:
+        """/apis discovery document (group/version/resource triples for
+        built-ins + Established CRDs + aggregated groups) — what the
+        discovery client and `ktctl api-resources` consume."""
+        try:
+            apiservices = self.store.list("APIService")[0]
+        except NotFound:
+            apiservices = []
+        return discovery_doc(self.store, KIND_INFO, apiservices)
 
     def configz(self) -> Dict[str, Any]:
         return {"admission": [type(p).__name__ for p in

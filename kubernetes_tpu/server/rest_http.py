@@ -127,8 +127,12 @@ class RestServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                if url.path in ("/api", "/apis"):
+                if url.path == "/api":
                     return self._send(200, {"versions": ["v1"]})
+                if url.path == "/apis":
+                    # discovery document: built-ins + Established CRDs +
+                    # aggregated groups (kube-aggregator /apis root)
+                    return self._send(200, api.discovery())
                 if url.path == "/api/v1" and method == "GET":
                     return self._send(200, {
                         "resources": sorted(RESOURCE_TO_KIND)})
@@ -146,9 +150,26 @@ class RestServer:
                         {"type": e.type, "kind": e.kind, "rv": e.rv,
                          "object": wire.encode(e.obj, kind=e.kind)}
                         for e in evs])
-                if parts[:2] != ["api", "v1"]:
+                if parts[:2] == ["api", "v1"]:
+                    rest = parts[2:]
+                    resolve = RESOURCE_TO_KIND.get
+                elif parts[0] == "apis" and len(parts) >= 4:
+                    # /apis/{group}/{version}/[namespaces/{ns}/]{plural}/...
+                    # — the CRD serving path (apiextensions
+                    # customresource_handler.go route shape)
+                    group, version = parts[1], parts[2]
+                    rest = parts[3:]
+
+                    def resolve(res, _g=group, _v=version):
+                        for crd in api.store.list(
+                                "CustomResourceDefinition")[0]:
+                            if crd.names.plural == res and crd.group == _g \
+                                    and crd.version == _v \
+                                    and crd.established:
+                                return crd.names.kind
+                        return None
+                else:
                     raise NotFound(self.path)
-                rest = parts[2:]
                 ns = ""
                 if rest and rest[0] == "namespaces" and len(rest) >= 3:
                     # /namespaces/{ns}/{resource}/...; a bare
@@ -158,7 +179,7 @@ class RestServer:
                 if not rest:
                     raise NotFound(self.path)
                 resource = rest[0]
-                kind = RESOURCE_TO_KIND.get(resource)
+                kind = resolve(resource)
                 if kind is None:
                     raise NotFound(f"unknown resource {resource!r}")
                 name = rest[1] if len(rest) > 1 else ""

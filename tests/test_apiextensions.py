@@ -1,0 +1,460 @@
+"""CRD serving (apiextensions-apiserver analog) + the aggregator
+(kube-aggregator analog).
+
+Reference behaviors pinned here:
+- CRD naming rule name == "<plural>.<group>" and NamesAccepted/Established
+  conditions (apiextensions-apiserver/pkg/apis/apiextensions/validation,
+  pkg/controller/{naming,establish}).
+- dynamic registry: an Established CRD's kind is served through the full
+  handler chain, unknown kinds 404
+  (apiextensions-apiserver/pkg/apiserver/customresource_handler.go).
+- customresourcecleanup finalizer: deleting a CRD purges its instances.
+- APIService routing + availability gating (kube-aggregator/pkg/
+  controllers/status/available_controller.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.api.extensions import (
+    APIService,
+    CRDNames,
+    CustomResource,
+    CustomResourceDefinition,
+    ServiceReference,
+)
+from kubernetes_tpu.api.rbac import (
+    PolicyRule,
+    Role,
+    RoleBinding,
+    RoleRef,
+    Subject,
+    UserInfo,
+)
+from kubernetes_tpu.api.workloads import Namespace
+from kubernetes_tpu.auth.authn import Credential, TokenAuthenticator, \
+    UnionAuthenticator
+from kubernetes_tpu.auth.authz import Forbidden
+from kubernetes_tpu.server.apiserver import ApiServer, Invalid
+from kubernetes_tpu.server.apiserver_lite import NotFound
+from kubernetes_tpu.server.extensions import Aggregator, Unavailable
+
+
+def make_crd(**over):
+    kw = dict(
+        name="tputopologies.sched.example.io",
+        group="sched.example.io",
+        version="v1",
+        names=CRDNames(plural="tputopologies", kind="TpuTopology"),
+        validation={
+            "required": ["chips"],
+            "chips": {"type": "integer", "minimum": 1, "maximum": 4096},
+            "generation": {"type": "string",
+                           "enum": ["v4", "v5e", "v5p"]},
+        },
+    )
+    kw.update(over)
+    return CustomResourceDefinition(**kw)
+
+
+def make_server():
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    return api
+
+
+# ---------------------------------------------------------------- lifecycle
+
+
+def test_crd_create_establishes_and_serves():
+    api = make_server()
+    api.create("CustomResourceDefinition", make_crd())
+    crd = api.get("CustomResourceDefinition", "",
+                  "tputopologies.sched.example.io")
+    assert crd.names_accepted and crd.established
+    api.create("TpuTopology", CustomResource(
+        "TpuTopology", "pod-a", namespace="default",
+        spec={"chips": 256, "generation": "v5e"}))
+    got = api.get("TpuTopology", "default", "pod-a")
+    assert got.spec["chips"] == 256
+    objs, _ = api.list("TpuTopology")
+    assert [o.name for o in objs] == ["pod-a"]
+
+
+def test_crd_name_must_be_plural_dot_group():
+    api = make_server()
+    with pytest.raises(Invalid):
+        api.create("CustomResourceDefinition",
+                   make_crd(name="topologies.sched.example.io"))
+    with pytest.raises(Invalid):
+        api.create("CustomResourceDefinition",
+                   make_crd(name="tputopologies.sched", group="sched"))
+
+
+def test_unknown_kind_404s_everywhere():
+    api = make_server()
+    with pytest.raises(NotFound):
+        api.create("TpuTopology", CustomResource(
+            "TpuTopology", "x", namespace="default", spec={"chips": 1}))
+    with pytest.raises(NotFound):
+        api.list("TpuTopology")
+    with pytest.raises(NotFound):
+        api.get("TpuTopology", "default", "x")
+    with pytest.raises(NotFound):
+        api.delete("TpuTopology", "default", "x")
+
+
+def test_name_conflict_with_builtin_not_accepted_and_not_served():
+    api = make_server()
+    bad = CustomResourceDefinition(
+        name="pods.fake.example.io", group="fake.example.io", version="v1",
+        names=CRDNames(plural="pods", kind="Pod"))
+    api.create("CustomResourceDefinition", bad)
+    stored = api.get("CustomResourceDefinition", "", "pods.fake.example.io")
+    assert not stored.names_accepted and not stored.established
+    # the conflicting kind resolves to the BUILT-IN resource, and the CRD
+    # plural conflict means no custom serving was added
+    cond = stored.condition("NamesAccepted")
+    assert "already in use" in cond.message
+
+
+def test_name_conflict_between_crds():
+    api = make_server()
+    api.create("CustomResourceDefinition", make_crd())
+    second = CustomResourceDefinition(
+        name="tputopologies.other.example.io", group="other.example.io",
+        version="v1",
+        names=CRDNames(plural="tputopologies", kind="TpuTopology2"))
+    api.create("CustomResourceDefinition", second)
+    stored = api.get("CustomResourceDefinition", "",
+                     "tputopologies.other.example.io")
+    assert not stored.names_accepted
+
+
+def test_crd_delete_cascades_instances():
+    api = make_server()
+    api.create("CustomResourceDefinition", make_crd())
+    for i in range(3):
+        api.create("TpuTopology", CustomResource(
+            "TpuTopology", f"t{i}", namespace="default",
+            spec={"chips": 8}))
+    api.delete("CustomResourceDefinition", "",
+               "tputopologies.sched.example.io")
+    with pytest.raises(NotFound):
+        api.get("CustomResourceDefinition", "",
+                "tputopologies.sched.example.io")
+    # kind no longer served, instances gone from the raw store too
+    with pytest.raises(NotFound):
+        api.list("TpuTopology")
+    assert api.store.list("TpuTopology")[0] == []
+
+
+# --------------------------------------------------------------- validation
+
+
+def test_schema_validation_rejects():
+    api = make_server()
+    api.create("CustomResourceDefinition", make_crd())
+
+    def cr(spec):
+        return CustomResource("TpuTopology", "bad", namespace="default",
+                              spec=spec)
+
+    with pytest.raises(Invalid):  # missing required
+        api.create("TpuTopology", cr({}))
+    with pytest.raises(Invalid):  # wrong type
+        api.create("TpuTopology", cr({"chips": "many"}))
+    with pytest.raises(Invalid):  # below minimum
+        api.create("TpuTopology", cr({"chips": 0}))
+    with pytest.raises(Invalid):  # above maximum
+        api.create("TpuTopology", cr({"chips": 8192}))
+    with pytest.raises(Invalid):  # enum violation
+        api.create("TpuTopology", cr({"chips": 8, "generation": "v3"}))
+    with pytest.raises(Invalid):  # bool is not an integer
+        api.create("TpuTopology", cr({"chips": True}))
+    # update path validates too
+    api.create("TpuTopology", cr({"chips": 8}))
+    broken = CustomResource("TpuTopology", "bad", namespace="default",
+                            spec={"chips": -1})
+    with pytest.raises(Invalid):
+        api.update("TpuTopology", broken)
+
+
+def test_scope_enforced():
+    api = make_server()
+    api.create("CustomResourceDefinition", make_crd())
+    with pytest.raises(Invalid):  # namespaced CRD, no namespace
+        api.create("TpuTopology",
+                   CustomResource("TpuTopology", "x", spec={"chips": 1}))
+    api.create("CustomResourceDefinition", CustomResourceDefinition(
+        name="meshes.sched.example.io", group="sched.example.io",
+        version="v1", names=CRDNames(plural="meshes", kind="Mesh"),
+        scope="Cluster"))
+    with pytest.raises(Invalid):  # cluster-scoped CRD, namespace set
+        api.create("Mesh", CustomResource("Mesh", "m", namespace="default"))
+    api.create("Mesh", CustomResource("Mesh", "m"))
+    assert api.get("Mesh", "", "m").name == "m"
+
+
+# --------------------------------------------------------------------- rbac
+
+
+def test_rbac_over_custom_resources():
+    authn = UnionAuthenticator([TokenAuthenticator({
+        "admin": UserInfo("root", groups=["system:masters"]),
+        "dev": UserInfo("dev-user")})])
+    api = ApiServer(auth=True, authenticator=authn)
+    api.store.create("Namespace", Namespace("default"))
+    api.bootstrap_rbac()
+    admin, dev = Credential(token="admin"), Credential(token="dev")
+    api.create("CustomResourceDefinition", make_crd(), cred=admin)
+    api.store.create("Role", Role("topo-reader", "default", rules=[
+        PolicyRule(verbs=["get", "list"], resources=["tputopologies"])]))
+    api.store.create("RoleBinding", RoleBinding(
+        "read-topos", "default", subjects=[Subject("User", "dev-user")],
+        role_ref=RoleRef("Role", "topo-reader")))
+    api.create("TpuTopology", CustomResource(
+        "TpuTopology", "t", namespace="default", spec={"chips": 4}),
+        cred=admin)
+    # reader can read via the CRD's plural, cannot write
+    assert api.get("TpuTopology", "default", "t", cred=dev).spec["chips"] == 4
+    with pytest.raises(Forbidden):
+        api.create("TpuTopology", CustomResource(
+            "TpuTopology", "t2", namespace="default", spec={"chips": 4}),
+            cred=dev)
+
+
+# ---------------------------------------------------------------- discovery
+
+
+def test_discovery_lists_builtins_and_crds():
+    api = make_server()
+    doc = api.discovery()
+    names = {(r["kind"], r["name"]) for r in doc["resources"]}
+    assert ("Pod", "pods") in names and ("Node", "nodes") in names
+    assert not any(r["kind"] == "TpuTopology" for r in doc["resources"])
+    api.create("CustomResourceDefinition", make_crd())
+    doc = api.discovery()
+    custom = [r for r in doc["resources"] if r["kind"] == "TpuTopology"]
+    assert custom and custom[0]["group"] == "sched.example.io"
+    assert custom[0]["namespaced"]
+
+
+# --------------------------------------------------------------- aggregator
+
+
+def make_backend():
+    """An in-process extension apiserver (sample-apiserver shape): a second
+    ApiServer serving a CRD-defined kind of its own."""
+    backend = ApiServer()
+    backend.store.create("Namespace", Namespace("default"))
+    backend.create("CustomResourceDefinition", CustomResourceDefinition(
+        name="nodemetrics.metrics.example.io", group="metrics.example.io",
+        version="v1",
+        names=CRDNames(plural="nodemetrics", kind="NodeMetrics"),
+        scope="Cluster"))
+    backend.create("NodeMetrics",
+                   CustomResource("NodeMetrics", "n1", spec={"cpu": 2}))
+    return backend
+
+
+def test_aggregator_routes_remote_group():
+    primary = make_server()
+    agg = Aggregator(primary)
+    backend = make_backend()
+    agg.register_backend(APIService(
+        name="v1.metrics.example.io", group="metrics.example.io",
+        version="v1", service=ServiceReference("kube-system", "metrics")),
+        backend=backend)
+    objs, _ = agg.handle("metrics.example.io", "v1", "list", "NodeMetrics")
+    assert [o.name for o in objs] == ["n1"]
+    # core group falls through to the primary
+    primary.store.create("Namespace", Namespace("kube-system"))
+    objs, _ = agg.handle("", "v1", "list", "Namespace")
+    assert {o.name for o in objs} == {"default", "kube-system"}
+
+
+def test_aggregator_unavailable_backend_503s():
+    primary = make_server()
+    agg = Aggregator(primary, probe_interval=0.0)
+    backend = make_backend()
+    svc = APIService(
+        name="v1.metrics.example.io", group="metrics.example.io",
+        version="v1", service=ServiceReference("kube-system", "metrics"))
+    agg.register_backend(svc, backend=backend)
+    assert primary.store.get("APIService", "",
+                             "v1.metrics.example.io").available
+    # break the backend's healthz; the availability pass flips the row
+    backend.healthz = lambda: {"status": "failed"}
+    with pytest.raises(Unavailable):
+        agg.handle("metrics.example.io", "v1", "list", "NodeMetrics")
+    row = primary.store.get("APIService", "", "v1.metrics.example.io")
+    assert not row.available
+    # recovery: healthz back up -> traffic resumes
+    backend.healthz = lambda: {"status": "ok"}
+    objs, _ = agg.handle("metrics.example.io", "v1", "list", "NodeMetrics")
+    assert len(objs) == 1
+
+
+def test_aggregator_local_apiservice_and_discovery():
+    primary = make_server()
+    agg = Aggregator(primary)
+    agg.register_backend(APIService(
+        name="v1.sched.example.io", group="sched.example.io", version="v1"))
+    primary.create("CustomResourceDefinition", make_crd())
+    primary.create("TpuTopology", CustomResource(
+        "TpuTopology", "t", namespace="default", spec={"chips": 2}))
+    objs, _ = agg.handle("sched.example.io", "v1", "list", "TpuTopology")
+    assert [o.name for o in objs] == ["t"]
+    doc = agg.discovery()
+    groups = {(g["group"], g["local"], g["available"])
+              for g in doc["aggregatedGroups"]}
+    assert ("sched.example.io", True, True) in groups
+
+
+# ----------------------------------------------------- REST + CLI end-to-end
+
+
+def test_crd_over_rest_group_path():
+    import pytest as _pytest
+    from kubernetes_tpu.cli.rest_client import RestClient
+    from kubernetes_tpu.server.rest_http import RestServer
+
+    api = make_server()
+    srv = RestServer(api)
+    srv.start()
+    try:
+        client = RestClient(f"http://127.0.0.1:{srv.port}")
+        client.create("CustomResourceDefinition", make_crd())
+        # the discovery doc now advertises the group resource
+        doc = client.discovery()
+        assert any(r["kind"] == "TpuTopology" and
+                   r["group"] == "sched.example.io"
+                   for r in doc["resources"])
+        # CRUD rides /apis/sched.example.io/v1/namespaces/default/...
+        client.create("TpuTopology", CustomResource(
+            "TpuTopology", "ring0", namespace="default",
+            spec={"chips": 64, "generation": "v5p"}))
+        got = client.get("TpuTopology", "default", "ring0")
+        assert got.spec == {"chips": 64, "generation": "v5p"}
+        objs, _ = client.list("TpuTopology")
+        assert [o.name for o in objs] == ["ring0"]
+        client.delete("TpuTopology", "default", "ring0")
+        with pytest.raises(NotFound):
+            client.get("TpuTopology", "default", "ring0")
+        # schema violations surface as HTTP errors, not silent accepts
+        from kubernetes_tpu.cli.rest_client import HttpError
+        with _pytest.raises(HttpError):
+            client.create("TpuTopology", CustomResource(
+                "TpuTopology", "bad", namespace="default",
+                spec={"chips": 0}))
+    finally:
+        srv.stop()
+
+
+def test_ktctl_crd_workflow(tmp_path):
+    import io
+
+    from kubernetes_tpu.cli.ktctl import Ktctl
+
+    api = make_server()
+    out = io.StringIO()
+    kt = Ktctl(api, out=out)
+    # apply an upstream-shaped CRD manifest (apiextensions.k8s.io v1.7 era)
+    manifest = tmp_path / "crd.yaml"
+    manifest.write_text("""
+apiVersion: apiextensions.k8s.io/v1beta1
+kind: CustomResourceDefinition
+metadata:
+  name: tputopologies.sched.example.io
+spec:
+  group: sched.example.io
+  version: v1
+  scope: Namespaced
+  names:
+    plural: tputopologies
+    kind: TpuTopology
+    shortNames: [tt]
+  validation:
+    openAPIV3Schema:
+      properties:
+        spec:
+          required: [chips]
+          properties:
+            chips: {type: integer, minimum: 1}
+---
+apiVersion: sched.example.io/v1
+kind: TpuTopology
+metadata:
+  name: ring0
+  namespace: default
+spec:
+  chips: 128
+""")
+    assert kt.run(["create", "-f", str(manifest)]) == 0
+    assert kt.run(["get", "tputopologies", "-n", "default"]) == 0
+    assert "ring0" in out.getvalue()
+    # short-name resolution via discovery
+    assert kt.run(["get", "tt", "ring0", "-n", "default",
+                   "-o", "json"]) == 0
+    assert '"chips": 128' in out.getvalue()
+    # api-resources lists the custom group
+    assert kt.run(["api-resources"]) == 0
+    assert "sched.example.io" in out.getvalue()
+    # delete through the CLI
+    assert kt.run(["delete", "tputopologies", "ring0", "-n", "default"]) == 0
+    assert kt.run(["get", "tputopologies", "-n", "default"]) == 0
+
+
+# ----------------------------------------------- review-finding regressions
+
+
+def test_bounded_field_with_nonnumeric_value_422s_not_500s():
+    api = make_server()
+    api.create("CustomResourceDefinition", CustomResourceDefinition(
+        name="widgets.w.example.io", group="w.example.io", version="v1",
+        names=CRDNames(plural="widgets", kind="Widget"),
+        validation={"replicas": {"minimum": 0}}))  # bounds, no "type"
+    with pytest.raises(Invalid):
+        api.create("Widget", CustomResource(
+            "Widget", "w", namespace="default",
+            spec={"replicas": "three"}))
+
+
+def test_crd_update_revalidates_names():
+    api = make_server()
+    api.create("CustomResourceDefinition", make_crd())
+    crd = api.get("CustomResourceDefinition", "",
+                  "tputopologies.sched.example.io")
+    # a PUT that renames the kind into a builtin collision is stored
+    # not-accepted and the custom kind stops being served
+    crd.names.kind = "Pod"
+    api.update("CustomResourceDefinition", crd)
+    stored = api.get("CustomResourceDefinition", "",
+                     "tputopologies.sched.example.io")
+    assert not stored.names_accepted and not stored.established
+    with pytest.raises(NotFound):
+        api.create("TpuTopology", CustomResource(
+            "TpuTopology", "x", namespace="default", spec={"chips": 1}))
+
+
+def test_delete_missing_crd_raises_not_found():
+    api = make_server()
+    with pytest.raises(NotFound):
+        api.delete("CustomResourceDefinition", "", "nope.example.io")
+
+
+def test_ktctl_prints_real_plural_for_custom_kinds():
+    import io
+
+    from kubernetes_tpu.cli.ktctl import Ktctl
+
+    api = make_server()
+    api.create("CustomResourceDefinition", make_crd())
+    api.create("TpuTopology", CustomResource(
+        "TpuTopology", "ring0", namespace="default", spec={"chips": 8}))
+    out = io.StringIO()
+    kt = Ktctl(api, out=out)
+    assert kt.run(["get", "tputopologies", "-n", "default",
+                   "-o", "name"]) == 0
+    assert "tputopologies/ring0" in out.getvalue()
+    assert "tputopologys" not in out.getvalue()

@@ -160,3 +160,23 @@ def test_gang_bench_profile_places_feasible_gangs_only():
     placed = sum(1 for flags in by_gang.values() if flags[0])
     assert placed == 30  # all but the two infeasible gangs
     assert totals["bound"] == 30 * 8
+
+
+def test_gang_park_timeout_fires_on_empty_rounds():
+    """A below-quorum gang with NO new pod arrivals must still hit the
+    parked-too-long sweep (the sweep runs before the empty-round early
+    return): FailedScheduling surfaces and members re-queue with backoff."""
+    t = [1000.0]
+    api = ApiServerLite()
+    api.create("Node", make_node("n1", cpu=4000, memory=8 * Gi))
+    sched = Scheduler(api, now=lambda: t[0])
+    sched.start()
+    api.create("Pod", _gang_pod("g-a", "g", 3))
+    sched.schedule_round()           # parks below quorum
+    assert sched._gang_waiting.get("g")
+    t[0] += sched.GANG_WAIT_TIMEOUT_S + 1
+    sched.schedule_round()           # EMPTY round: nothing in the queue
+    assert not sched._gang_waiting.get("g")
+    evs = [e for e in sched.events
+           if e.reason == "FailedScheduling" and "below quorum" in e.message]
+    assert evs
