@@ -291,6 +291,12 @@ class Volume:
     monitors: List[str] = field(default_factory=list)  # RBD CephMonitors
     pool: str = ""  # RBD RBDPool
     image: str = ""  # RBD RBDImage
+    # concrete source for scheduling-inert kinds (OTHER collapses EmptyDir/
+    # HostPath/NFS/DownwardAPI/...) — the volume plugin layer
+    # (volumes/plugins.py) selects its driver by this, the way
+    # pkg/volume/plugins.go FindPluginBySpec switches on the populated
+    # VolumeSource member
+    driver: str = ""
 
 
 # PV node-affinity alpha annotation — v1.AlphaStorageNodeAffinityAnnotation

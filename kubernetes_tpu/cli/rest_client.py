@@ -107,6 +107,8 @@ class RestClient:
         if expect_rv is not None:
             url += f"?resourceVersion={expect_rv}"  # CAS precondition
         out = self._do("PUT", url, wire.encode(obj, kind=kind))
+        if kind == "CustomResourceDefinition":
+            self._discovery_cache = None
         return out.get("resourceVersion", 0)
 
     def update_status(self, kind: str, obj: Any) -> int:

@@ -27,6 +27,11 @@ from kubernetes_tpu.controllers.base import Controller
 from kubernetes_tpu.server.apiserver_lite import ApiServerLite, NotFound
 
 ATTACHED_ANNOTATION = "volumes.kubernetes.io/attached"
+# node.status.volumesInUse analog: devices some pod on the node has
+# mounted — the kubelet publishes it (nodes/kubelet.py heartbeat), this
+# controller refuses to detach them (attach_detach_controller.go honoring
+# volumesInUse via the operation executor's VerifyVolumesAreAttached)
+IN_USE_ANNOTATION = "volumes.kubernetes.io/in-use"
 # volume kinds that require attach before mount (the attachable plugins:
 # EBS/GCE-PD/AzureDisk/Cinder... — pkg/volume/*/attacher.go)
 ATTACHABLE = {VolumeKind.AWS_EBS, VolumeKind.GCE_PD, VolumeKind.AZURE_DISK}
@@ -183,15 +188,29 @@ class AttachDetachController(Controller):
             node = self.api.get("Node", "", key)
         except NotFound:
             return
+        from kubernetes_tpu.volumes.plugins import VolumeError, resolve_spec
         want: Set[str] = set()
         for p in self.pod_informer.store.list():
             if p.node_name != key or p.deleted:
                 continue
             for v in p.volumes:
-                if VolumeKind(v.kind) in ATTACHABLE and v.volume_id:
-                    want.add(str(VolumeKind(v.kind).value) + ":" + v.volume_id)
+                try:
+                    # dereferences claim -> bound PV, like the desired-state
+                    # populator's CreateVolumeSpec (attachdetach/cache/
+                    # desired_state_of_world_populator.go)
+                    src = resolve_spec(v, self.api, p.namespace).source
+                except VolumeError:
+                    continue  # missing/unbound claim: nothing to attach yet
+                if VolumeKind(src.kind) in ATTACHABLE and src.volume_id:
+                    want.add(str(VolumeKind(src.kind).value) + ":"
+                             + src.volume_id)
         current = set(filter(None, node.annotations.get(
             ATTACHED_ANNOTATION, "").split(",")))
+        # in-use protection: a device the kubelet still has mounted stays
+        # attached even with no desiring pod (multi-attach corruption guard)
+        in_use = set(filter(None, node.annotations.get(
+            IN_USE_ANNOTATION, "").split(",")))
+        want |= current & in_use
         if want != current:
             node.annotations[ATTACHED_ANNOTATION] = ",".join(sorted(want))
             self.api.update("Node", node, expect_rv=node.resource_version)

@@ -162,13 +162,21 @@ class Scheduler:
         pods = self.queue.pop_batch(max_n=max_batch, wait=wait)
         stats = {"popped": len(pods), "bound": 0, "unschedulable": 0,
                  "bind_errors": 0}
+        # gang (coscheduling) gating: pods in a group schedule atomically
+        # once their quorum is in the queue (engine/gang.py); incomplete
+        # gangs park in _gang_waiting until members arrive
+        plain, gangs = gangmod.partition(pods)
         # parked-too-long gangs surface even on empty rounds — a gang below
         # quorum with no new arrivals would otherwise never reach the sweep
         # (quorum may never come: members deleted, minAvailable typo);
-        # members re-queue with backoff — retried AND visible via events
+        # members re-queue with backoff — retried AND visible via events.
+        # A gang receiving members THIS round is exempt: the arrival may
+        # complete its quorum below, and evicting it first would turn an
+        # on-time completion into a spurious backoff cycle.
         now = self._now()
         for gname in [g for g, t0_ in self._gang_parked_at.items()
-                      if now - t0_ > self.GANG_WAIT_TIMEOUT_S]:
+                      if now - t0_ > self.GANG_WAIT_TIMEOUT_S
+                      and g not in gangs]:
             waiting = self._gang_waiting.pop(gname, {})
             self._gang_parked_at.pop(gname, None)
             for m in waiting.values():
@@ -181,10 +189,6 @@ class Scheduler:
             self.queue.backoff.gc()
             return stats
         trace.field("pods", len(pods))
-        # gang (coscheduling) gating: pods in a group schedule atomically
-        # once their quorum is in the queue (engine/gang.py); incomplete
-        # gangs park in _gang_waiting until members arrive
-        plain, gangs = gangmod.partition(pods)
         ready_gangs = []
         for gname, members in gangs.items():
             if gname in self._gang_degraded:

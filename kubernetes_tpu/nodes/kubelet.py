@@ -317,7 +317,8 @@ class EvictionManager:
 class HollowKubelet:
     def __init__(self, api: ApiServerLite, node: Node,
                  startup_latency: float = 0.0,
-                 now: Callable[[], float] = time.monotonic):
+                 now: Callable[[], float] = time.monotonic,
+                 volume_manager=None):
         self.api = api
         self.node_name = node.name
         self._template = node
@@ -334,6 +335,9 @@ class HollowKubelet:
         self.prober = ProberManager(now)
         self.eviction = EvictionManager(node)
         self._static: Dict[str, Pod] = {}  # static (mirror-backed) pods
+        # volumes/manager.py VolumeManager; None keeps the hollow-fleet
+        # fast path volume-free (kubemark's hollow kubelet does the same)
+        self.volumes = volume_manager
 
     # ----------------------------------------------------------- node status
 
@@ -362,8 +366,20 @@ class HollowKubelet:
         conds.append(NodeCondition(
             "DiskPressure", ConditionStatus.TRUE
             if self.eviction.disk_pressure else ConditionStatus.FALSE))
+        ann = dict(cur.annotations)
+        if self.volumes is not None:
+            # node.status.volumesInUse: the attach-detach controller's
+            # detach guard (volume_manager.go GetVolumesInUse)
+            from kubernetes_tpu.controllers.cloudctrl import \
+                IN_USE_ANNOTATION
+            in_use = ",".join(self.volumes.volumes_in_use())
+            if in_use:
+                ann[IN_USE_ANNOTATION] = in_use
+            else:
+                ann.pop(IN_USE_ANNOTATION, None)
         self.api.update("Node", dataclasses.replace(
-            cur, heartbeat=self._now(), conditions=conds))
+            cur, heartbeat=self._now(), conditions=conds,
+            annotations=ann))
 
     # ------------------------------------------------------------- pod flow
 
@@ -407,6 +423,19 @@ class HollowKubelet:
         if reason is not None:
             self._write_status(pod, phase="Failed", reason=reason)
             return
+        if self.volumes is not None and pod.volumes:
+            # syncPod blocks on WaitForAttachAndMount before containers
+            # start (kubelet.go:1390 → volume_manager.go:339); failure
+            # leaves the pod Pending for the next sync retry
+            from kubernetes_tpu.volumes.plugins import VolumeError
+            try:
+                # non-blocking (timeout=0): one reconcile attempt per sync
+                # pass; a pending attach retries on the next sync instead
+                # of stalling the serialized pod workers on wall-clock
+                self.volumes.wait_for_attach_and_mount(pod, timeout=0)
+            except VolumeError:
+                self._write_status(pod, reason="FailedMount")
+                return
         self._admitted[key] = pod
         self._starting[key] = self._now() + self.startup_latency
         self.prober.add_pod(pod, self._now())
@@ -423,6 +452,8 @@ class HollowKubelet:
         self._ready.pop(key, None)
         self.workers.forget(key)
         self.prober.remove_pod(key)
+        if self.volumes is not None:
+            self.volumes.teardown_pod(key)
 
     # ----------------------------------------------------------- static pods
 

@@ -180,3 +180,24 @@ def test_gang_park_timeout_fires_on_empty_rounds():
     evs = [e for e in sched.events
            if e.reason == "FailedScheduling" and "below quorum" in e.message]
     assert evs
+
+
+def test_gang_completing_in_timeout_round_schedules():
+    """A gang whose final quorum member arrives in the same round the park
+    timeout expires must schedule, not be swept into backoff."""
+    t = [1000.0]
+    api = ApiServerLite()
+    for i in range(3):
+        api.create("Node", make_node(f"n{i}", cpu=4000, memory=8 * Gi))
+    sched = Scheduler(api, now=lambda: t[0])
+    sched.start()
+    api.create("Pod", _gang_pod("g-a", "g", 2))
+    sched.schedule_round()           # parks 1/2
+    assert sched._gang_waiting.get("g")
+    t[0] += sched.GANG_WAIT_TIMEOUT_S + 1
+    api.create("Pod", _gang_pod("g-b", "g", 2))
+    sched.schedule_round()           # completion + timeout in one round
+    bound = [p for p in api.list("Pod")[0] if p.node_name]
+    assert len(bound) == 2, [p.name for p in bound]
+    assert not any(e.reason == "FailedScheduling" and "below quorum"
+                   in e.message for e in sched.events)

@@ -1,0 +1,324 @@
+"""The volume plugin layer + kubelet volume manager.
+
+Reference behaviors pinned (pkg/volume/ + pkg/kubelet/volumemanager/):
+- FindPluginBySpec: exactly-one-match semantics (plugins.go:372-392).
+- per-driver mount semantics: EmptyDir isolation, HostPath node sharing,
+  ConfigMap/Secret payload materialization (secret values land decoded),
+  DownwardAPI field rendering, Projected merge, NFS cross-node sharing,
+  Local node pinning, attachable devices requiring attach-before-mount.
+- reconciler: mounts desired, unmounts orphans, surfaces errors;
+  WaitForAttachAndMount timeout -> FailedMount.
+- in-use protection: the attach-detach controller must not detach a
+  device the kubelet still has mounted.
+"""
+
+import base64
+
+import pytest
+
+from kubernetes_tpu.api.cluster import ConfigMap, Secret
+from kubernetes_tpu.api.types import (
+    NodeSelectorTerm,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    SelectorRequirement,
+    Volume,
+    VolumeKind,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.controllers.cloudctrl import (
+    ATTACHED_ANNOTATION,
+    IN_USE_ANNOTATION,
+)
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.volumes import (
+    VolumeHost,
+    VolumeManager,
+    VolumePluginManager,
+    VolumeSpec,
+    default_plugins,
+)
+from kubernetes_tpu.volumes.plugins import VolumeError
+
+Mi = 1 << 20
+
+
+def vol(name, kind=VolumeKind.OTHER, vid="", driver=""):
+    return Volume(name=name, kind=kind, volume_id=vid, driver=driver)
+
+
+def rig(node_name="n1"):
+    api = ApiServerLite()
+    api.create("Node", make_node(node_name, cpu=4000, memory=1 << 33))
+    host = VolumeHost(api=api, node_name=node_name)
+    mgr = VolumeManager(VolumePluginManager(default_plugins()), host)
+    return api, host, mgr
+
+
+# ------------------------------------------------------------ plugin lookup
+
+
+def test_find_plugin_by_spec_exactly_one():
+    pm = VolumePluginManager(default_plugins())
+    assert pm.find_plugin_by_spec(
+        VolumeSpec(volume=vol("v", driver="EmptyDir"))
+    ).name == "kubernetes.io/empty-dir"
+    assert pm.find_plugin_by_spec(
+        VolumeSpec(volume=vol("v", VolumeKind.GCE_PD, "disk-1"))
+    ).name == "kubernetes.io/gce-pd"
+    assert pm.find_plugin_by_spec(
+        VolumeSpec(volume=vol("v", VolumeKind.CONFIG_MAP, "cm"))
+    ).name == "kubernetes.io/configmap"
+    # a driver hint nothing claims
+    with pytest.raises(VolumeError):
+        pm.find_plugin_by_spec(
+            VolumeSpec(volume=vol("v", driver="FlockerISH")))
+
+
+def test_duplicate_registration_rejected():
+    plugins = default_plugins()
+    with pytest.raises(VolumeError):
+        VolumePluginManager(plugins + [plugins[0].__class__()])
+
+
+# ------------------------------------------------------------ driver мounts
+
+
+def test_emptydir_isolated_per_pod():
+    api, host, mgr = rig()
+    p1 = make_pod("p1", cpu=10, memory=Mi)
+    p1.volumes = [vol("scratch", driver="EmptyDir")]
+    p2 = make_pod("p2", cpu=10, memory=Mi)
+    p2.volumes = [vol("scratch", driver="EmptyDir")]
+    for p in (p1, p2):
+        mgr.add_pod(p)
+    mgr.reconcile()
+    host.pod_dir(p1.key())["scratch"]["f"] = b"one"
+    assert "f" not in host.pod_dir(p2.key())["scratch"]
+
+
+def test_hostpath_shared_on_node():
+    api, host, mgr = rig()
+    p1 = make_pod("p1", cpu=10, memory=Mi)
+    p1.volumes = [vol("logs", driver="HostPath", vid="/var/log")]
+    p2 = make_pod("p2", cpu=10, memory=Mi)
+    p2.volumes = [vol("logs", driver="HostPath", vid="/var/log")]
+    mgr.add_pod(p1)
+    mgr.add_pod(p2)
+    mgr.reconcile()
+    host.pod_dir(p1.key())["logs"]["a.log"] = b"x"
+    assert host.pod_dir(p2.key())["logs"]["a.log"] == b"x"
+
+
+def test_configmap_and_secret_materialize_payload():
+    api, host, mgr = rig()
+    api.create("ConfigMap", ConfigMap("settings", "default",
+                                      data={"mode": "fast"}))
+    api.create("Secret", Secret("creds", "default", data={
+        "token": base64.b64encode(b"s3cret").decode()}))
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("cfg", VolumeKind.CONFIG_MAP, "settings"),
+                 vol("sec", VolumeKind.SECRET, "creds")]
+    mgr.add_pod(p)
+    mgr.reconcile()
+    assert host.pod_dir(p.key())["cfg"]["mode"] == b"fast"
+    # secret files land base64-DECODED (pkg/volume/secret/secret.go)
+    assert host.pod_dir(p.key())["sec"]["token"] == b"s3cret"
+
+
+def test_missing_configmap_is_mount_error_not_crash():
+    api, host, mgr = rig()
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("cfg", VolumeKind.CONFIG_MAP, "nope")]
+    mgr.add_pod(p)
+    mounted, _ = mgr.reconcile()
+    assert mounted == 0
+    with pytest.raises(VolumeError, match="not found"):
+        mgr.wait_for_attach_and_mount(p, timeout=0.05)
+
+
+def test_downward_api_renders_pod_fields():
+    api, host, mgr = rig()
+    p = make_pod("p", cpu=10, memory=Mi, labels={"app": "web"})
+    p.node_name = "n1"
+    p.volumes = [vol("info", driver="DownwardAPI")]
+    mgr.add_pod(p)
+    mgr.reconcile()
+    d = host.pod_dir(p.key())["info"]
+    assert d["metadata.name"] == b"p"
+    assert b'app="web"' in d["metadata.labels"]
+    assert d["spec.nodeName"] == b"n1"
+
+
+def test_projected_merges_sources():
+    api, host, mgr = rig()
+    api.create("ConfigMap", ConfigMap("cm", "default", data={"k1": "v1"}))
+    api.create("Secret", Secret("s", "default", data={
+        "k2": base64.b64encode(b"v2").decode()}))
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("all", driver="Projected",
+                     vid="configmap:cm,secret:s,downwardAPI")]
+    mgr.add_pod(p)
+    mgr.reconcile()
+    d = host.pod_dir(p.key())["all"]
+    assert d["k1"] == b"v1" and d["k2"] == b"v2"
+    assert d["metadata.name"] == b"p"
+
+
+def test_nfs_shared_across_nodes():
+    api1, host1, mgr1 = rig("n1")
+    host2 = VolumeHost(api=api1, node_name="n2")
+    # same shared backend universe (the "network")
+    host2.shared_fs = host1.shared_fs
+    mgr2 = VolumeManager(VolumePluginManager(default_plugins()), host2)
+    p1 = make_pod("p1", cpu=10, memory=Mi)
+    p1.volumes = [vol("data", driver="NFS", vid="fs1:/export")]
+    p2 = make_pod("p2", cpu=10, memory=Mi)
+    p2.volumes = [vol("data", driver="NFS", vid="fs1:/export")]
+    mgr1.add_pod(p1)
+    mgr2.add_pod(p2)
+    mgr1.reconcile()
+    mgr2.reconcile()
+    host1.pod_dir(p1.key())["data"]["shared.txt"] = b"hello"
+    assert host2.pod_dir(p2.key())["data"]["shared.txt"] == b"hello"
+
+
+# ---------------------------------------------------------------- PVC + local
+
+
+def test_pvc_resolution_and_local_node_pinning():
+    api, host, mgr = rig("n1")
+    term = NodeSelectorTerm(match_expressions=[
+        SelectorRequirement("kubernetes.io/hostname", "In", ["n2"])])
+    api.create("PersistentVolume", PersistentVolume(
+        "pv-local", capacity=Mi,
+        source=Volume(name="pv-local", driver="Local", volume_id="/mnt/d1"),
+        node_affinity_terms=[term]))
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "claim", "default", volume_name="pv-local", capacity=Mi))
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("data", VolumeKind.PVC, "claim")]
+    mgr.add_pod(p)
+    mounted, _ = mgr.reconcile()
+    # n1 does not satisfy the PV's node affinity -> mount must fail
+    assert mounted == 0
+    with pytest.raises(VolumeError, match="affinity conflict"):
+        mgr.wait_for_attach_and_mount(p, timeout=0.05)
+    # the right node mounts fine
+    node2 = make_node("n2", cpu=4000, memory=1 << 33)
+    node2.labels["kubernetes.io/hostname"] = "n2"
+    api.create("Node", node2)
+    host2 = VolumeHost(api=api, node_name="n2")
+    mgr2 = VolumeManager(VolumePluginManager(default_plugins()), host2)
+    mgr2.wait_for_attach_and_mount(p, timeout=0.5)
+    assert "data" in host2.pod_dir(p.key())
+
+
+def test_unbound_pvc_is_visible_error():
+    api, host, mgr = rig()
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "loose", "default", capacity=Mi))
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("data", VolumeKind.PVC, "loose")]
+    with pytest.raises(VolumeError, match="not bound"):
+        mgr.add_pod(p)
+
+
+# ------------------------------------------------------- attach-before-mount
+
+
+def test_attachable_mount_waits_for_controller_attach():
+    api, host, mgr = rig()
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("disk", VolumeKind.GCE_PD, "pd-1")]
+    mgr.add_pod(p)
+    mounted, _ = mgr.reconcile()
+    assert mounted == 0  # not attached yet
+    # the attach-detach controller attaches (records on the node)
+    node = api.get("Node", "", "n1")
+    node.annotations[ATTACHED_ANNOTATION] = "GCEPersistentDisk:pd-1"
+    api.update("Node", node)
+    mounted, _ = mgr.reconcile()
+    assert mounted == 1
+    # device content is shared through the backend: remount elsewhere
+    host.pod_dir(p.key())["disk"]["state"] = b"v1"
+    assert host.shared_fs["GCEPersistentDisk:pd-1"]["state"] == b"v1"
+    assert mgr.volumes_in_use() == ["GCEPersistentDisk:pd-1"]
+
+
+def test_in_use_protection_blocks_detach():
+    from kubernetes_tpu.client.informer import SharedInformerFactory
+    from kubernetes_tpu.controllers.cloudctrl import AttachDetachController
+
+    api, host, mgr = rig()
+    factory = SharedInformerFactory(api)
+    ctrl = AttachDetachController(api, factory, record_events=False)
+    factory.start()
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("disk", VolumeKind.GCE_PD, "pd-1")]
+    p.node_name = "n1"
+    api.create("Pod", p)
+    factory.step_all()
+    ctrl.sync("n1")
+    assert "GCEPersistentDisk:pd-1" in api.get(
+        "Node", "", "n1").annotations[ATTACHED_ANNOTATION]
+    mgr.add_pod(p)
+    mgr.reconcile()
+    # pod object deleted but kubelet hasn't unmounted yet: in-use guard
+    api.delete("Pod", "default", "p")
+    node = api.get("Node", "", "n1")
+    node.annotations[IN_USE_ANNOTATION] = ",".join(mgr.volumes_in_use())
+    api.update("Node", node)
+    factory.step_all()
+    ctrl.sync("n1")
+    assert "GCEPersistentDisk:pd-1" in api.get(
+        "Node", "", "n1").annotations[ATTACHED_ANNOTATION]
+    # kubelet unmounts -> in-use clears -> controller detaches
+    mgr.teardown_pod(p.key())
+    node = api.get("Node", "", "n1")
+    node.annotations.pop(IN_USE_ANNOTATION)
+    api.update("Node", node)
+    ctrl.sync("n1")
+    assert api.get("Node", "", "n1").annotations.get(
+        ATTACHED_ANNOTATION, "") == ""
+
+
+# ----------------------------------------------------------- reconciliation
+
+
+def test_reconciler_unmounts_orphans_and_cleans_pod_dir():
+    api, host, mgr = rig()
+    p = make_pod("p", cpu=10, memory=Mi)
+    p.volumes = [vol("a", driver="EmptyDir"), vol("b", driver="EmptyDir")]
+    mgr.add_pod(p)
+    mgr.reconcile()
+    assert mgr.mounted_volumes(p.key()) == {"a", "b"}
+    n = mgr.teardown_pod(p.key())
+    assert n == 2
+    assert p.key() not in host.fs
+
+
+def test_kubelet_syncpod_gates_on_mount():
+    from kubernetes_tpu.nodes.kubelet import HollowKubelet
+
+    api, host, mgr = rig()
+    node = api.get("Node", "", "n1")
+    kubelet = HollowKubelet(api, node, volume_manager=mgr)
+    p = make_pod("web", cpu=10, memory=Mi)
+    p.node_name = "n1"
+    p.volumes = [vol("cfg", VolumeKind.CONFIG_MAP, "missing-cm")]
+    api.create("Pod", p)
+    kubelet.handle_pod(p)
+    kubelet.workers.drain()
+    # mount failed -> pod NOT admitted, FailedMount recorded
+    assert p.key() not in kubelet._admitted
+    assert api.get("Pod", "default", "web").annotations[
+        "kubernetes.io/failure-reason"] == "FailedMount"
+    # operator creates the configmap; next sync succeeds
+    api.create("ConfigMap", ConfigMap("missing-cm", "default",
+                                      data={"k": "v"}))
+    kubelet.handle_pod(p)
+    kubelet.workers.drain()
+    assert p.key() in kubelet._admitted
+    assert host.pod_dir(p.key())["cfg"]["k"] == b"v"
