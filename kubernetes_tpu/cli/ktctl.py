@@ -115,13 +115,109 @@ def table(kind: str, objs: Sequence[Any], wide: bool = False) -> str:
     return "\n".join(lines)
 
 
+def jsonpath_get(doc: Any, path: str) -> List[Any]:
+    """The jsonpath subset kubectl output uses most (pkg/util/jsonpath):
+    dotted fields, [N] indexing, [*] fan-out — '{.items[*].name}'.
+    Returns the list of leaf matches."""
+    path = path.strip()
+    if path.startswith("{") and path.endswith("}"):
+        path = path[1:-1]
+    cur = [doc]
+    for raw in filter(None, path.replace("]", "").split(".")):
+        # a segment may carry an index suffix: "items[*" / "conditions[0"
+        parts = raw.split("[")
+        fieldname, indices = parts[0], parts[1:]
+        nxt: List[Any] = []
+        for c in cur:
+            if fieldname:
+                if not isinstance(c, dict) or fieldname not in c:
+                    continue
+                c = c[fieldname]
+            vals = [c]
+            for idx in indices:
+                stepped: List[Any] = []
+                for v in vals:
+                    if not isinstance(v, list):
+                        continue
+                    if idx == "*":
+                        stepped.extend(v)
+                    else:
+                        try:
+                            i = int(idx)
+                        except ValueError:
+                            # filters/slices are outside the subset —
+                            # fail like every other bad CLI input
+                            raise SystemExit(
+                                f"error: unsupported jsonpath "
+                                f"expression [{idx}] (only [N] and [*] "
+                                f"indexing is supported)") from None
+                        if -len(v) <= i < len(v):
+                            stepped.append(v[i])
+                vals = stepped
+            nxt.extend(vals)
+        cur = nxt
+    return cur
+
+
+def _fmt_cell(v: Any) -> str:
+    if v is None:
+        return "<none>"
+    if isinstance(v, (dict, list)):
+        return json.dumps(v, default=str)
+    return str(v)
+
+
 def render(kind: str, objs: Sequence[Any], output: str,
-           plural: str = "") -> str:
+           plural: str = "", sort_by: str = "") -> str:
+    encoded = None
+    if sort_by or output.startswith(("custom-columns=", "jsonpath=")):
+        encoded = [wire.encode(o, kind=kind) for o in objs]
+    if sort_by:
+        # kubectl --sort-by: a jsonpath over each row (pkg/kubectl/
+        # sorting_printer.go); unkeyed rows sort first, numeric keys
+        # compare numerically (900 before 1000, not lexicographically)
+        def keyf(pair):
+            hits = jsonpath_get(pair[0], sort_by)
+            if not hits:
+                return (0, 0, 0.0, "")
+            v = hits[0]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                try:
+                    return (1, 0, float(v), "")
+                except (TypeError, ValueError):
+                    return (1, 1, 0.0, str(v))
+            return (1, 0, float(v), "")
+        order = sorted(zip(encoded, objs), key=keyf)
+        encoded = [e for e, _ in order]
+        objs = [o for _, o in order]
+    if output.startswith("custom-columns="):
+        # NAME:.path,HEADER:.other.path (pkg/printers/customcolumn.go)
+        cols = []
+        for spec in output[len("custom-columns="):].split(","):
+            header, _, path = spec.partition(":")
+            cols.append((header, path))
+        rows = [[_fmt_cell((jsonpath_get(e, p) or [None])[0])
+                 for _h, p in cols] for e in encoded]
+        headers = [h for h, _p in cols]
+        widths = [max(len(h), *(len(r[i]) for r in rows)) if rows
+                  else len(h) for i, h in enumerate(headers)]
+        lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+        lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths))
+                  for r in rows]
+        return "\n".join(lines)
+    if output.startswith("jsonpath="):
+        # applied to the List document like kubectl ({.items[*].name})
+        doc = {"kind": kind + "List", "items": encoded}
+        hits = jsonpath_get(doc, output[len("jsonpath="):])
+        return " ".join(_fmt_cell(h) for h in hits)
     if output == "json":
-        return json.dumps([wire.encode(o, kind=kind) for o in objs],
-                          indent=2)
+        return json.dumps(
+            encoded if encoded is not None
+            else [wire.encode(o, kind=kind) for o in objs], indent=2)
     if output == "yaml":
-        return yaml.safe_dump([wire.encode(o, kind=kind) for o in objs])
+        return yaml.safe_dump(
+            encoded if encoded is not None
+            else [wire.encode(o, kind=kind) for o in objs])
     if output == "name":
         res = plural or kind_plural(kind)
         return "\n".join(f"{res}/{getattr(o, 'name', '')}" for o in objs)
@@ -141,13 +237,46 @@ def describe(kind: str, obj: Any) -> str:
 
 # --------------------------------------------------------------- the tool
 
+class _BoundApi:
+    """Binds a client credential onto every authenticated verb — the
+    kubeconfig current-context: ktctl code stays credential-agnostic and
+    the secure-port path just works (client-go's rest.Config analog)."""
+
+    _CRED_VERBS = frozenset({
+        "create", "get", "list", "update", "delete", "scale", "evict",
+        "bind", "bind_many", "update_status", "watch_since",
+        "finalize_namespace"})
+
+    def __init__(self, api, cred):
+        self._api = api
+        self._cred = cred
+
+    def __getattr__(self, name):
+        fn = getattr(self._api, name)
+        if name in self._CRED_VERBS:
+            import functools
+            return functools.partial(fn, cred=self._cred)
+        return fn
+
+
 class Ktctl:
     """The CLI against an in-process ApiServer (tests, single binary) or a
     remote REST endpoint (via RestClient below)."""
 
     def __init__(self, api: ApiServer, out=None, federation=None,
-                 federation_contexts=None):
-        self.api = api
+                 federation_contexts=None, cred=None,
+                 kubeconfig: Optional[str] = None):
+        if kubeconfig is not None:
+            # a ktadm-written kubeconfig (cli/ktadm.py phase_kubeconfig):
+            # carry its identity record as the client credential
+            from kubernetes_tpu.auth.authn import Credential
+            with open(kubeconfig) as f:
+                cfg = json.load(f)
+            cred = Credential(cert=cfg["cert"])
+        # only the in-process ApiServer takes per-call credentials; a
+        # RestClient authenticates at the transport (its bearer token)
+        self.api = api if cred is None or not isinstance(api, ApiServer) \
+            else _BoundApi(api, cred)
         self.out = out if out is not None else sys.stdout
         # kubefed mode (cmd_federate): `federation` is a
         # FederationControlPlane, `federation_contexts` maps cluster name ->
@@ -285,7 +414,8 @@ class Ktctl:
         objs = self._objs(kind, ns, pos[1] if len(pos) > 1 else "",
                           flags.get("selector", ""))
         self._print(render(kind, objs, flags.get("output", "table"),
-                           plural=self._plural(kind)))
+                           plural=self._plural(kind),
+                           sort_by=flags.get("sort-by", "")))
 
     def cmd_describe(self, args):
         pos, flags = self._flags(args)
@@ -577,6 +707,17 @@ class Ktctl:
             raise SystemExit("error: federate verb required")
         verb = pos[0]
         plane = self.federation
+
+        def workload_args():
+            """Shared name/namespace/selector/pod-template parsing for the
+            three `federate create` flavors."""
+            name = pos[2]
+            ns = flags.get("namespace", "default")
+            sel = dict(kv.split("=", 1) for kv in
+                       flags.get("selector", f"app={name}").split(","))
+            tmpl_pod = make_pod("", namespace=ns, labels=dict(sel),
+                                cpu=int(flags.get("cpu", 100)))
+            return name, ns, sel, tmpl_pod
         if verb == "join":
             name = pos[1]
             if name not in self.federation_contexts:
@@ -592,12 +733,7 @@ class Ktctl:
                     else "NotReady"
                 self._print(f"{c.name}\t{state}")
         elif verb == "create" and pos[1:2] == ["rs"]:
-            name = pos[2]
-            ns = flags.get("namespace", "default")
-            sel = dict(kv.split("=", 1)
-                       for kv in flags.get("selector", f"app={name}").split(","))
-            tmpl_pod = make_pod("", namespace=ns, labels=dict(sel),
-                                cpu=int(flags.get("cpu", 100)))
+            name, ns, sel, tmpl_pod = workload_args()
             frs = FederatedReplicaSet(
                 name=name, namespace=ns,
                 replicas=int(flags.get("replicas", 1)),
@@ -618,12 +754,71 @@ class Ktctl:
                 expect_rv=cur.resource_version)
             self._print(f"federatedreplicaset/{pos[2]} scaled")
         elif verb == "get":
-            for frs in plane.api.list(FEDERATED_RS_KIND)[0]:
-                self._print(f"{frs.namespace}/{frs.name}\t"
-                            f"replicas={frs.replicas}\t"
-                            f"ready={frs.ready_replicas}")
+            from kubernetes_tpu.federation.controller import (
+                FEDERATED_DEPLOY_KIND,
+            )
+            from kubernetes_tpu.federation.service_dns import (
+                FEDERATED_SERVICE_KIND,
+            )
+            for fkind in (FEDERATED_RS_KIND, FEDERATED_DEPLOY_KIND):
+                for frs in plane.api.list(fkind)[0]:
+                    self._print(f"{fkind.lower()}/{frs.namespace}/"
+                                f"{frs.name}\treplicas={frs.replicas}\t"
+                                f"ready={frs.ready_replicas}")
+            for fsvc in plane.api.list(FEDERATED_SERVICE_KIND)[0]:
+                self._print(
+                    f"federatedservice/{fsvc.namespace}/{fsvc.name}\t"
+                    f"serving={','.join(fsvc.serving_clusters) or '<none>'}")
+        elif verb == "create" and pos[1:2] == ["deploy"]:
+            from kubernetes_tpu.api.workloads import Deployment
+            from kubernetes_tpu.federation.controller import (
+                FEDERATED_DEPLOY_KIND,
+                FederatedDeployment,
+            )
+            name, ns, sel, tmpl_pod = workload_args()
+            fd = FederatedDeployment(
+                name=name, namespace=ns,
+                replicas=int(flags.get("replicas", 1)),
+                template=Deployment(
+                    name=name, namespace=ns,
+                    selector=LabelSelector(match_labels=dict(sel)),
+                    template=tmpl_pod))
+            plane.api.create(FEDERATED_DEPLOY_KIND, fd)
+            self._print(f"federateddeployment/{name} created")
+        elif verb == "create" and pos[1:2] == ["service"]:
+            from kubernetes_tpu.api.workloads import Service, ServicePort
+            from kubernetes_tpu.federation.service_dns import (
+                FEDERATED_SERVICE_KIND,
+                FederatedService,
+            )
+            name, ns, sel, _tmpl = workload_args()
+            plane.api.create(FEDERATED_SERVICE_KIND, FederatedService(
+                name=name, namespace=ns,
+                template=Service(name=name, namespace=ns, selector=sel,
+                                 ports=[ServicePort(
+                                     port=int(flags.get("port", 80)))])))
+            self._print(f"federatedservice/{name} created")
+        elif verb == "dns":
+            # read path for the provider zone: `federate dns [name-substr]`
+            from kubernetes_tpu.federation.service_dns import (
+                FederatedServiceController,
+            )
+            sub = pos[1] if len(pos) > 1 else ""
+            dns = FederatedServiceController(plane).dns
+            for (rname, rtype), rec in sorted(dns.records.items()):
+                if sub and sub not in rname:
+                    continue
+                self._print(f"{rname}\t{rtype}\t{','.join(rec.values)}")
         elif verb == "sync":
+            from kubernetes_tpu.federation.controller import (
+                FederatedDeploymentController,
+            )
+            from kubernetes_tpu.federation.service_dns import (
+                FederatedServiceController,
+            )
             FederatedReplicaSetController(plane).sync_all()
+            FederatedDeploymentController(plane).sync_all()
+            FederatedServiceController(plane).sync_all()
             self._print("synced")
         else:
             raise SystemExit(f"error: unknown federate verb {verb!r}")

@@ -43,6 +43,7 @@ from kubernetes_tpu.server.apiserver_lite import (
 )
 
 FEDERATED_RS_KIND = "FederatedReplicaSet"
+FEDERATED_DEPLOY_KIND = "FederatedDeployment"
 CLUSTER_KIND = "Cluster"
 
 
@@ -50,10 +51,13 @@ CLUSTER_KIND = "Cluster"
 class Cluster:
     """federation Cluster object: name + readiness (types.go Cluster/
     ClusterStatus; readiness is maintained by the cluster controller's
-    healthz probes — here set by join/mark_ready)."""
+    healthz probes — here set by join/mark_ready). zone/region feed the
+    service-DNS record hierarchy (types.go ClusterStatus.Zones/Region)."""
 
     name: str
     ready: bool = True
+    zone: str = ""
+    region: str = ""
     resource_version: int = 0
 
 
@@ -76,19 +80,26 @@ class FederatedReplicaSet:
 
 
 class FederationControlPlane:
-    """The federation-apiserver + cluster registry."""
+    """The federation-apiserver + cluster registry. The DNS provider
+    lives here (one zone per federation, like the reference's dnsprovider
+    config on the federation-controller-manager) so records persist
+    across sync invocations."""
 
     def __init__(self):
         self.api = ApiServerLite()
         self.members: Dict[str, ApiServerLite] = {}
+        from kubernetes_tpu.federation.service_dns import InMemoryDNSProvider
+        self.dns = InMemoryDNSProvider()
 
     # ------------------------------------------------------------ clusters
 
-    def join(self, name: str, api: ApiServerLite) -> None:
+    def join(self, name: str, api: ApiServerLite, zone: str = "",
+             region: str = "") -> None:
         """kubefed join: register a member cluster."""
         self.members[name] = api
         try:
-            self.api.create(CLUSTER_KIND, Cluster(name=name))
+            self.api.create(CLUSTER_KIND,
+                            Cluster(name=name, zone=zone, region=region))
         except Conflict:
             self.mark_ready(name, True)
 
@@ -114,7 +125,14 @@ class FederationControlPlane:
 
 
 class FederatedReplicaSetController:
-    """The sync controller for one federated type (ReplicaSet)."""
+    """The per-type sync controller, ReplicaSet flavor. The class attrs
+    are the federatedtypes adapter surface (federation/pkg/federatedtypes/
+    adapter.go): every replica-carrying federated type shares this sync
+    body and differs only in its kinds — FederatedDeploymentController
+    below is the deployment.go adapter."""
+
+    FED_KIND = FEDERATED_RS_KIND
+    CHILD_KIND = "ReplicaSet"
 
     def __init__(self, plane: FederationControlPlane):
         self.plane = plane
@@ -122,7 +140,7 @@ class FederatedReplicaSetController:
     # ----------------------------------------------------------------- sync
 
     def sync_all(self) -> None:
-        frs_list, _ = self.plane.api.list(FEDERATED_RS_KIND)
+        frs_list, _ = self.plane.api.list(self.FED_KIND)
         for frs in frs_list:
             self.sync(frs)
 
@@ -152,7 +170,7 @@ class FederatedReplicaSetController:
                 # ScheduleAction remove (scheduling.go:141-170)
                 if rs is not None and cname in self.plane.members:
                     try:
-                        api.delete("ReplicaSet", frs.namespace, frs.name)
+                        api.delete(self.CHILD_KIND, frs.namespace, frs.name)
                     except NotFound:
                         pass
                 continue
@@ -161,11 +179,11 @@ class FederatedReplicaSetController:
                     frs.template, name=frs.name, namespace=frs.namespace,
                     replicas=want, resource_version=0)
                 try:
-                    api.create("ReplicaSet", child)
+                    api.create(self.CHILD_KIND, child)
                 except Conflict:
                     pass
             elif rs.replicas != want:
-                api.update("ReplicaSet",
+                api.update(self.CHILD_KIND,
                            dataclasses.replace(rs, replicas=want),
                            expect_rv=rs.resource_version)
             if rs is not None:
@@ -173,10 +191,10 @@ class FederatedReplicaSetController:
         # UpdateFederatedStatus (scheduling.go:172)
         try:
             cur: FederatedReplicaSet = self.plane.api.get(
-                FEDERATED_RS_KIND, frs.namespace, frs.name)
+                self.FED_KIND, frs.namespace, frs.name)
             if cur.ready_replicas != total_ready:
                 self.plane.api.update(
-                    FEDERATED_RS_KIND,
+                    self.FED_KIND,
                     dataclasses.replace(cur, ready_replicas=total_ready),
                     expect_rv=cur.resource_version)
         except (NotFound, Conflict):
@@ -188,6 +206,31 @@ class FederatedReplicaSetController:
         if api is None:
             return None
         try:
-            return api.get("ReplicaSet", frs.namespace, frs.name)
+            return api.get(self.CHILD_KIND, frs.namespace, frs.name)
         except NotFound:
             return None
+
+
+@dataclass
+class FederatedDeployment:
+    """FederatedDeployment (federatedtypes/deployment.go): same shape as
+    the RS flavor with a Deployment template."""
+
+    name: str
+    namespace: str = "default"
+    replicas: int = 0
+    template: object = None
+    annotations: Dict[str, str] = field(default_factory=dict)
+    ready_replicas: int = 0
+    resource_version: int = 0
+
+    def key(self) -> str:
+        return self.namespace + "/" + self.name
+
+
+class FederatedDeploymentController(FederatedReplicaSetController):
+    """federatedtypes/deployment.go: the Deployment adapter over the
+    shared replica-scheduling sync body."""
+
+    FED_KIND = FEDERATED_DEPLOY_KIND
+    CHILD_KIND = "Deployment"

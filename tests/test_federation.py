@@ -240,3 +240,157 @@ def test_ktctl_federate_verbs_end_to_end():
     assert kt.run(["federate", "unjoin", "beta"]) == 0
     assert kt.run(["federate", "sync"]) == 0
     assert a.api.get("ReplicaSet", "default", "web").replicas == 16
+
+
+# ----------------------------------------- federated Deployment + Service DNS
+
+
+def test_federated_deployment_spreads_and_rescales():
+    from kubernetes_tpu.api.workloads import Deployment
+    from kubernetes_tpu.federation.controller import (
+        FEDERATED_DEPLOY_KIND,
+        FederatedDeployment,
+        FederatedDeploymentController,
+    )
+
+    plane = FederationControlPlane()
+    east, west = ApiServerLite(), ApiServerLite()
+    plane.join("east", east, zone="us-east1-a", region="us-east1")
+    plane.join("west", west, zone="us-west1-b", region="us-west1")
+    tmpl = Deployment(name="web", namespace="default",
+                      selector=LabelSelector(match_labels={"app": "web"}),
+                      template=make_pod("", labels={"app": "web"}, cpu=50))
+    plane.api.create(FEDERATED_DEPLOY_KIND, FederatedDeployment(
+        name="web", replicas=6, template=tmpl))
+    ctrl = FederatedDeploymentController(plane)
+    ctrl.sync_all()
+    assert east.get("Deployment", "default", "web").replicas == 3
+    assert west.get("Deployment", "default", "web").replicas == 3
+    # cluster loss: all replicas move to the survivor
+    plane.mark_ready("west", False)
+    ctrl.sync_all()
+    assert east.get("Deployment", "default", "web").replicas == 6
+    import pytest as _pytest
+    from kubernetes_tpu.server.apiserver_lite import NotFound
+    with _pytest.raises(NotFound):
+        west.get("Deployment", "default", "web")
+
+
+def _dns_rig():
+    from kubernetes_tpu.api.workloads import (
+        EndpointAddress,
+        Endpoints,
+        Service,
+        ServicePort,
+    )
+    from kubernetes_tpu.federation.service_dns import (
+        FEDERATED_SERVICE_KIND,
+        FederatedService,
+        FederatedServiceController,
+        InMemoryDNSProvider,
+    )
+
+    plane = FederationControlPlane()
+    east, west = ApiServerLite(), ApiServerLite()
+    plane.join("east", east, zone="us-east1-a", region="us-east1")
+    plane.join("west", west, zone="us-west1-b", region="us-west1")
+    dns = InMemoryDNSProvider()
+    ctrl = FederatedServiceController(plane, dns=dns, federation="fed",
+                                      domain="example.com")
+    fsvc = FederatedService(name="api", template=Service(
+        name="api", selector={"app": "api"},
+        ports=[ServicePort(port=80)]))
+    plane.api.create(FEDERATED_SERVICE_KIND, fsvc)
+    return plane, east, west, dns, ctrl, fsvc
+
+
+def test_federated_service_materializes_and_writes_dns():
+    from kubernetes_tpu.api.workloads import EndpointAddress, Endpoints
+
+    plane, east, west, dns, ctrl, fsvc = _dns_rig()
+    ctrl.sync_all()
+    # services exist in both member clusters
+    assert east.get("Service", "default", "api").name == "api"
+    assert west.get("Service", "default", "api").name == "api"
+    # no endpoints anywhere yet: zone records CNAME up the chain, and the
+    # chain dead-ends (no global A record)
+    zname = "api.default.fed.svc.us-east1-a.us-east1.example.com"
+    assert dns.lookup(zname).rtype == "CNAME"
+    assert dns.resolve(zname) == []
+    # east gains healthy endpoints + an LB ingress IP
+    svc = east.get("Service", "default", "api")
+    svc.load_balancer_ip = "34.1.1.1"
+    east.update("Service", svc)
+    east.create("Endpoints", Endpoints("api", "default", addresses=[
+        EndpointAddress(pod_key="default/p1", node_name="n1")]))
+    ctrl.sync_all()
+    # east zone resolves locally; west zone CNAMEs to region then global,
+    # landing on east's ingress
+    assert dns.resolve(zname) == ["34.1.1.1"]
+    wz = "api.default.fed.svc.us-west1-b.us-west1.example.com"
+    assert dns.lookup(wz).rtype == "CNAME"
+    assert dns.resolve(wz) == ["34.1.1.1"]
+    fed = plane.api.get("FederatedService", "default", "api")
+    assert fed.serving_clusters == ["east"]
+
+
+def test_federated_service_dns_failover_on_cluster_loss():
+    from kubernetes_tpu.api.workloads import EndpointAddress, Endpoints
+
+    plane, east, west, dns, ctrl, fsvc = _dns_rig()
+    for member, ip in ((east, "34.1.1.1"), (west, "35.2.2.2")):
+        ctrl.sync_all()
+        svc = member.get("Service", "default", "api")
+        svc.load_balancer_ip = ip
+        member.update("Service", svc)
+        member.create("Endpoints", Endpoints("api", "default", addresses=[
+            EndpointAddress(pod_key="default/p", node_name="n")]))
+    ctrl.sync_all()
+    gname = "api.default.fed.svc.example.com"
+    assert dns.resolve(gname) == ["34.1.1.1", "35.2.2.2"]
+    # west cluster dies: its zone record fails over through the chain
+    plane.mark_ready("west", False)
+    ctrl.sync_all()
+    wz = "api.default.fed.svc.us-west1-b.us-west1.example.com"
+    assert dns.resolve(wz) == ["34.1.1.1"]
+    assert dns.resolve(gname) == ["34.1.1.1"]
+
+
+def test_federate_cli_dns_persists_and_get_lists_all_kinds():
+    from kubernetes_tpu.api.workloads import (
+        EndpointAddress,
+        Endpoints,
+        Namespace,
+    )
+    from kubernetes_tpu.server.apiserver import ApiServer
+
+    plane = FederationControlPlane()
+    east, west = ApiServerLite(), ApiServerLite()
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    out = io.StringIO()
+    kt = Ktctl(api, out=out, federation=plane,
+               federation_contexts={"east": east, "west": west})
+    assert kt.run(["federate", "join", "east"]) == 0
+    assert kt.run(["federate", "join", "west"]) == 0
+    assert kt.run(["federate", "create", "deploy", "web",
+                   "--replicas", "4"]) == 0
+    assert kt.run(["federate", "create", "service", "api"]) == 0
+    assert kt.run(["federate", "sync"]) == 0
+    # endpoints appear in east; a SECOND sync (fresh controller instance)
+    # must see the same DNS zone — records persist on the plane
+    svc = east.get("Service", "default", "api")
+    svc.load_balancer_ip = "34.9.9.9"
+    east.update("Service", svc)
+    east.create("Endpoints", Endpoints("api", "default", addresses=[
+        EndpointAddress(pod_key="default/p", node_name="n")]))
+    assert kt.run(["federate", "sync"]) == 0
+    out.truncate(0), out.seek(0)
+    assert kt.run(["federate", "dns", "api"]) == 0
+    assert "34.9.9.9" in out.getvalue()
+    out.truncate(0), out.seek(0)
+    assert kt.run(["federate", "get"]) == 0
+    text = out.getvalue()
+    assert "federateddeployment/default/web" in text
+    assert "federatedservice/default/api" in text
+    assert "serving=east" in text

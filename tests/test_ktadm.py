@@ -1,0 +1,246 @@
+"""ktadm (kubeadm analog): init phases, token join, preflight, reset.
+
+Reference: cmd/kubeadm/app/{phases,preflight,discovery}. Pinned here:
+- init runs preflight -> certs -> kubeconfig -> control-plane manifests
+  -> bootstrap-token and yields a working authenticated control plane.
+- the join flow is the TLS bootstrap: token auth -> CSR -> auto-approve
+  -> sign -> register node with the issued identity; a wrong CA hash
+  aborts (discovery token pinning), a bad token is Unauthenticated.
+- the static manifests are loadable by the hollow kubelet's file source
+  (what kubeadm's /etc/kubernetes/manifests is to the real kubelet).
+- bootstrap tokens expire and can be listed/created/deleted.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.api.types import make_pod
+from kubernetes_tpu.auth.authn import Credential, Unauthenticated
+from kubernetes_tpu.auth.authz import Forbidden
+from kubernetes_tpu.cli.ktadm import KtAdm, ca_hash, generate_token
+
+
+def init_cluster(tmp_path, now=None):
+    out = io.StringIO()
+    adm = KtAdm(out=out, **({"now": now} if now else {}))
+    res = adm.init(str(tmp_path / "kt"))
+    return adm, res, out
+
+
+def test_init_phases_and_artifacts(tmp_path):
+    adm, res, out = init_cluster(tmp_path)
+    wd = res.workdir
+    assert os.path.exists(os.path.join(wd, "pki", "ca.key"))
+    for comp in ("admin", "controller-manager", "scheduler"):
+        assert os.path.exists(os.path.join(wd, comp + ".conf"))
+    manifests = sorted(os.listdir(os.path.join(wd, "manifests")))
+    assert manifests == ["kube-apiserver.json",
+                        "kube-controller-manager.json",
+                        "kube-scheduler.json"]
+    assert "initialized successfully" in out.getvalue()
+    # the admin credential really is cluster-admin through the chain
+    res.api.create("Namespace", __import__(
+        "kubernetes_tpu.api.workloads", fromlist=["Namespace"]
+    ).Namespace("prod"), cred=res.admin_cred)
+    # an anonymous request is rejected
+    with pytest.raises(Unauthenticated):
+        res.api.list("Pod", cred=None)
+
+
+def test_preflight_rejects_second_init(tmp_path):
+    adm, res, _ = init_cluster(tmp_path)
+    adm2 = KtAdm(out=io.StringIO())
+    with pytest.raises(SystemExit):
+        adm2.init(res.workdir)
+    # reset clears the artifacts; init works again
+    adm2.reset(res.workdir)
+    adm2.init(res.workdir)
+
+
+def test_token_join_flow(tmp_path):
+    adm, res, _ = init_cluster(tmp_path)
+    node_cred = adm.join(res, "worker-1", res.token,
+                         ca_cert_hash=ca_hash(res.ca_key))
+    node = res.api.get("Node", "", "worker-1", cred=res.admin_cred)
+    assert node.name == "worker-1"
+    # the issued identity is the node's own (system:node:worker-1) —
+    # NodeRestriction-scoped, not admin: it cannot delete other nodes
+    res.api.list("Node", cred=node_cred)
+    with pytest.raises(Forbidden):
+        res.api.create("Namespace", __import__(
+            "kubernetes_tpu.api.workloads", fromlist=["Namespace"]
+        ).Namespace("x"), cred=node_cred)
+
+
+def test_join_rejects_bad_token_and_bad_ca_hash(tmp_path):
+    adm, res, _ = init_cluster(tmp_path)
+    with pytest.raises(Unauthenticated):
+        adm.join(res, "w", "aaaaaa.bbbbbbbbbbbbbbbb")
+    with pytest.raises(SystemExit, match="MITM"):
+        adm.join(res, "w", res.token, ca_cert_hash="sha256:deadbeef")
+
+
+def test_token_expiry_and_lifecycle(tmp_path):
+    t = [2_000_000_000.0]
+    adm, res, out = init_cluster(tmp_path, now=lambda: t[0])
+    assert adm.token_list(res)  # the init token
+    tok2 = adm.token_create(res, ttl=60.0)
+    assert len(adm.token_list(res)) == 2
+    # expiry: advance past ttl; the token no longer authenticates
+    t[0] += 3600.0
+    with pytest.raises(Unauthenticated):
+        adm.join(res, "w", tok2)
+    # delete the init token
+    tid = res.token.split(".")[0]
+    adm.token_delete(res, tid)
+    with pytest.raises(SystemExit):
+        adm.token_delete(res, tid)
+
+
+def test_static_manifests_feed_kubelet_file_source(tmp_path):
+    from kubernetes_tpu.api.types import make_node
+    from kubernetes_tpu.nodes.kubelet import HollowKubelet
+    from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+    adm, res, _ = init_cluster(tmp_path)
+    api = ApiServerLite()
+    node = make_node("cp-1", cpu=8000, memory=1 << 34)
+    api.create("Node", node)
+    kubelet = HollowKubelet(api, node)
+    n = kubelet.load_static_dir(os.path.join(res.workdir, "manifests"))
+    assert n == 3
+    kubelet.workers.drain()
+    # mirror pods surfaced on the apiserver
+    mirrors = [p for p in api.list("Pod")[0]
+               if p.namespace == "kube-system"]
+    assert {p.name for p in mirrors} == {
+        "kube-apiserver", "kube-controller-manager", "kube-scheduler"}
+
+
+def test_generate_token_format():
+    tok = generate_token()
+    tid, _, sec = tok.partition(".")
+    assert len(tid) == 6 and len(sec) == 16
+    assert tok == tok.lower()
+
+
+# ------------------------------------------------- printers (pkg/printers)
+
+
+def _cli_with_nodes():
+    import io
+
+    from kubernetes_tpu.api.types import make_node
+    from kubernetes_tpu.cli.ktctl import Ktctl
+    from kubernetes_tpu.server.apiserver import ApiServer
+    from kubernetes_tpu.api.workloads import Namespace
+
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    for name, cpu in (("n-b", 2000), ("n-a", 4000), ("n-c", 1000)):
+        api.store.create("Node", make_node(name, cpu=cpu, memory=1 << 31))
+    out = io.StringIO()
+    return Ktctl(api, out=out), out
+
+
+def test_custom_columns_output():
+    kt, out = _cli_with_nodes()
+    assert kt.run(["get", "nodes", "-o",
+                   "custom-columns=NAME:.name,CPU:.allocatable.milli_cpu"
+                   ]) == 0
+    text = out.getvalue()
+    lines = text.strip().splitlines()
+    assert lines[0].split() == ["NAME", "CPU"]
+    assert any(ln.split() == ["n-a", "4000"] for ln in lines)
+
+
+def test_jsonpath_output():
+    kt, out = _cli_with_nodes()
+    assert kt.run(["get", "nodes", "-o",
+                   "jsonpath={.items[*].name}"]) == 0
+    assert set(out.getvalue().split()) == {"n-a", "n-b", "n-c"}
+    out.truncate(0), out.seek(0)
+    assert kt.run(["get", "nodes", "-o",
+                   "jsonpath={.items[0].allocatable.milli_cpu}"]) == 0
+    assert out.getvalue().strip() in {"1000", "2000", "4000"}
+
+
+def test_sort_by_orders_rows():
+    kt, out = _cli_with_nodes()
+    assert kt.run(["get", "nodes", "--sort-by",
+                   "{.allocatable.milli_cpu}", "-o",
+                   "custom-columns=NAME:.name"]) == 0
+    names = [ln.strip() for ln in out.getvalue().strip().splitlines()[1:]]
+    assert names == ["n-c", "n-b", "n-a"]
+
+
+def test_ktctl_with_admin_kubeconfig_against_secure_cluster(tmp_path):
+    from kubernetes_tpu.cli.ktctl import Ktctl
+
+    adm, res, _ = init_cluster(tmp_path)
+    adm.join(res, "worker-1", res.token)
+    out = io.StringIO()
+    # kubeconfig written by phase_kubeconfig carries the admin identity
+    kt = Ktctl(res.api, out=out,
+               kubeconfig=os.path.join(res.workdir, "admin.conf"))
+    assert kt.run(["get", "nodes"]) == 0
+    assert "worker-1" in out.getvalue()
+    # a credential-less ktctl against the same secure cluster fails closed
+    kt_anon = Ktctl(res.api, out=io.StringIO())
+    with pytest.raises(Unauthenticated):
+        kt_anon.run(["get", "nodes"])
+
+
+def test_sort_by_numeric_not_lexicographic():
+    import io
+
+    from kubernetes_tpu.api.types import make_node
+    from kubernetes_tpu.api.workloads import Namespace
+    from kubernetes_tpu.cli.ktctl import Ktctl
+    from kubernetes_tpu.server.apiserver import ApiServer
+
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    # 900 vs 1000: lexicographic would put "1000" first
+    for name, cpu in (("big", 1000), ("small", 900)):
+        api.store.create("Node", make_node(name, cpu=cpu, memory=1 << 31))
+    out = io.StringIO()
+    kt = Ktctl(api, out=out)
+    assert kt.run(["get", "nodes", "--sort-by",
+                   "{.allocatable.milli_cpu}", "-o",
+                   "custom-columns=NAME:.name"]) == 0
+    names = [ln.strip() for ln in out.getvalue().strip().splitlines()[1:]]
+    assert names == ["small", "big"]
+
+
+def test_unsupported_jsonpath_fails_cleanly():
+    kt, out = _cli_with_nodes()
+    # filter expressions are outside the subset: clean error, rc=1
+    assert kt.run(["get", "nodes", "-o",
+                   "jsonpath={.items[?(@.ready)].name}"]) == 1
+    assert "unsupported jsonpath" in out.getvalue()
+
+
+def test_kubeconfig_with_rest_client_does_not_crash(tmp_path):
+    from kubernetes_tpu.cli.ktctl import Ktctl
+    from kubernetes_tpu.cli.rest_client import RestClient
+    from kubernetes_tpu.server.rest_http import RestServer
+
+    adm, res, _ = init_cluster(tmp_path)
+    # RestClient authenticates at the transport; the kubeconfig cred must
+    # NOT be partial-applied onto its verbs (they take no cred kwarg)
+    srv = RestServer(res.api)
+    srv.start()
+    try:
+        client = RestClient(f"http://127.0.0.1:{srv.port}")
+        kt = Ktctl(client, out=io.StringIO(),
+                   kubeconfig=os.path.join(res.workdir, "admin.conf"))
+        # auth=True without a transport token -> clean 401, not TypeError
+        with pytest.raises(Exception) as ei:
+            kt.run(["get", "nodes"])
+        assert "TypeError" not in type(ei.value).__name__
+    finally:
+        srv.stop()
