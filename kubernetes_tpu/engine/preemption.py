@@ -1,0 +1,134 @@
+"""Pod priority preemption — the PodPriority long-tail item.
+
+The reference at v1.7 ships only the feature gate
+(pkg/features/kube_features.go:122 PodPriority, alpha) — scheduler
+preemption landed in 1.8 (plugin/pkg/scheduler/core/generic_scheduler.go
+Preempt / pickOneNodeForPreemption / selectVictimsOnNode in that tree).
+This implements that design against the batch engine, TPU-framework
+style: a vectorized host-side pre-filter over ALL nodes (the numpy
+analog of the device fits kernel, over "resources freeable below my
+priority") narrows to candidate nodes, then the exact oracle predicate
+chain verifies each candidate with its victims removed — the same
+over-approximate-then-verify-exact pattern the snapshot kernels use
+(SURVEY §7 hard part (e)).
+
+Semantics kept from the 1.8 scheduler:
+- only pods with LOWER priority than the preemptor are victims;
+- candidate victims are reprieved highest-priority-first while the
+  preemptor still fits (selectVictimsOnNode's reprieve loop);
+- node choice minimizes (highest victim priority, sum of victim
+  priorities, victim count) — pickOneNodeForPreemption's ordering;
+- a node where the preemptor does not fit even with every lower-
+  priority pod gone is infeasible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.ops import oracle
+from kubernetes_tpu.state.node_info import NodeInfo
+
+
+@dataclass
+class PreemptionPlan:
+    node_name: str
+    victims: List[Pod]  # sorted lowest priority first (eviction order)
+
+
+def _candidate_mask(pod: Pod, infos: List[NodeInfo]) -> np.ndarray:
+    """Vectorized pre-filter: could the preemptor fit on node n if every
+    pod with lower priority were evicted? Over-approximates (resources +
+    pod-count only) — exact verification follows per candidate."""
+    need = pod.resource_request()
+    n = len(infos)
+    alloc_cpu = np.empty(n, dtype=np.int64)
+    alloc_mem = np.empty(n, dtype=np.int64)
+    alloc_pods = np.empty(n, dtype=np.int64)
+    used_cpu = np.empty(n, dtype=np.int64)
+    used_mem = np.empty(n, dtype=np.int64)
+    used_count = np.empty(n, dtype=np.int64)
+    free_cpu = np.empty(n, dtype=np.int64)
+    free_mem = np.empty(n, dtype=np.int64)
+    free_count = np.empty(n, dtype=np.int64)
+    for i, info in enumerate(infos):
+        alloc = info.allocatable()
+        alloc_cpu[i] = alloc.milli_cpu
+        alloc_mem[i] = alloc.memory
+        alloc_pods[i] = info.allowed_pod_number()
+        used_cpu[i] = info.requested.milli_cpu
+        used_mem[i] = info.requested.memory
+        used_count[i] = len(info.pods)
+        fc = fm = fn_ = 0
+        for vic in info.pods:
+            if vic.priority < pod.priority:
+                r = vic.resource_request()
+                fc += r.milli_cpu
+                fm += r.memory
+                fn_ += 1
+        free_cpu[i] = fc
+        free_mem[i] = fm
+        free_count[i] = fn_
+    return ((used_cpu - free_cpu + need.milli_cpu <= alloc_cpu)
+            & (used_mem - free_mem + need.memory <= alloc_mem)
+            & (used_count - free_count + 1 <= alloc_pods)
+            & (free_count > 0))  # no victims -> plain unschedulable, not
+                                 # a preemption candidate
+
+
+def _select_victims(pod: Pod, info: NodeInfo,
+                    ctx=None) -> Optional[List[Pod]]:
+    """selectVictimsOnNode: start from all lower-priority pods evicted;
+    if the preemptor fits, reprieve highest-priority victims first while
+    it keeps fitting. Returns the minimal victim set, or None if the
+    node is infeasible even with everything gone."""
+    potential = [p for p in info.pods if p.priority < pod.priority]
+    if not potential:
+        return None
+    keep = [p for p in info.pods if p.priority >= pod.priority]
+    base = NodeInfo(info.node)
+    for p in keep:
+        base.add_pod(p)
+    if not oracle.pod_fits(pod, base, ctx=ctx):
+        return None
+    # reprieve pass: highest priority first (then larger pods last so
+    # small high-priority pods come back first)
+    victims: List[Pod] = []
+    for vic in sorted(potential,
+                      key=lambda p: (-p.priority,
+                                     p.resource_request().milli_cpu)):
+        base.add_pod(vic)
+        if oracle.pod_fits(pod, base, ctx=ctx):
+            continue  # reprieved — stays
+        base.remove_pod(vic)
+        victims.append(vic)
+    return sorted(victims, key=lambda p: p.priority)
+
+
+def pick_preemption(pod: Pod, node_infos: Dict[str, NodeInfo],
+                    ctx=None) -> Optional[PreemptionPlan]:
+    """generic_scheduler.Preempt: pre-filter all nodes vectorized, verify
+    candidates exactly, choose by pickOneNodeForPreemption's ordering."""
+    if pod.priority <= 0:
+        return None
+    names = sorted(node_infos)
+    infos = [node_infos[n] for n in names]
+    mask = _candidate_mask(pod, infos)
+    best: Optional[Tuple[Tuple[int, int, int], str, List[Pod]]] = None
+    for i in np.flatnonzero(mask):
+        info = infos[int(i)]
+        victims = _select_victims(pod, info, ctx=ctx)
+        if victims is None or not victims:
+            continue
+        key = (max(v.priority for v in victims),
+               sum(v.priority for v in victims),
+               len(victims))
+        if best is None or key < best[0]:
+            best = (key, names[int(i)], victims)
+    if best is None:
+        return None
+    return PreemptionPlan(node_name=best[1], victims=best[2])

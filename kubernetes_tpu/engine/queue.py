@@ -17,6 +17,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.utils import features
 
 INITIAL_BACKOFF = 1.0
 MAX_BACKOFF = 60.0
@@ -96,6 +97,12 @@ class SchedulingQueue:
             while True:
                 self._promote_ready()
                 if self._fifo:
+                    if features.enabled("PodPriority"):
+                        # priority queue semantics (1.8's podqueue
+                        # heap ordered by priority): higher priority
+                        # pops first; stable sort keeps FIFO order
+                        # within a priority band
+                        self._fifo.sort(key=lambda p: -p.priority)
                     n = len(self._fifo) if max_n == 0 else min(max_n, len(self._fifo))
                     out = self._fifo[:n]
                     self._fifo = self._fifo[n:]

@@ -35,9 +35,11 @@ from kubernetes_tpu.engine.scheduler_engine import (
 from kubernetes_tpu.ops import priorities as prio
 from kubernetes_tpu.server.apiserver_lite import (
     ApiServerLite,
+    NotFound,
     TooOldResourceVersion,
 )
 from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.utils import features
 from kubernetes_tpu.utils.metrics import SchedulerMetrics
 from kubernetes_tpu.utils.trace import SCHEDULE_TRACE_THRESHOLD_S, Trace
 
@@ -161,7 +163,7 @@ class Scheduler:
         trace.step("informer sync done")
         pods = self.queue.pop_batch(max_n=max_batch, wait=wait)
         stats = {"popped": len(pods), "bound": 0, "unschedulable": 0,
-                 "bind_errors": 0}
+                 "bind_errors": 0, "preemptions": 0}
         # gang (coscheduling) gating: pods in a group schedule atomically
         # once their quorum is in the queue (engine/gang.py); incomplete
         # gangs park in _gang_waiting until members arrive
@@ -243,6 +245,7 @@ class Scheduler:
         # understate the per-pod latency histograms)
         per_pod_alg = t_alg / max(scheduled_count, 1)
         placed = []
+        unschedulable_pods = []
         for r in results:
             if r.node_name is None:
                 stats["unschedulable"] += 1
@@ -250,6 +253,7 @@ class Scheduler:
                 self._event(r.pod, "Warning", "FailedScheduling",
                             f"0/{len(self.engine.snapshot.node_names)} nodes "
                             f"available (fit_count={r.fit_count})")
+                unschedulable_pods.append(r.pod)
                 self.queue.add_backoff(r.pod)
             else:
                 placed.append(r)
@@ -276,6 +280,10 @@ class Scheduler:
                         f"Successfully assigned {r.pod.key()} to {r.node_name}")
         trace.step("bindings written")
         self.cache.finish_bindings_bulk(bound_pods)
+        if unschedulable_pods and features.enabled("PodPriority"):
+            # after the binding pass, so a victim choice can never race a
+            # not-yet-posted Binding from this same round
+            stats["preemptions"] = self._preempt_round(unschedulable_pods)
         n = len(bound_pods)
         self.metrics.scheduled.inc(n)
         self.metrics.algorithm_latency.observe_many(per_pod_alg, n)
@@ -290,10 +298,54 @@ class Scheduler:
                           * max(scheduled_count, 1))
         return stats
 
+    def _preempt_round(self, unschedulable: List[Pod]) -> int:
+        """Preemption pass (1.8 generic_scheduler.Preempt, feature-gated
+        behind PodPriority like kube_features.go:122): for each
+        unschedulable pod, highest priority first, pick a node + minimal
+        victim set (engine/preemption.py) and evict the victims. The
+        preemptor is already requeued; once the victims' DELETED events
+        drain through sync(), the freed capacity places it in a following
+        round (the nominate-then-reschedule flow)."""
+        from kubernetes_tpu.engine import preemption as preemptmod
+        # clones: the victim bookkeeping below must not mutate the live
+        # cache (the DELETED watch events do that authoritatively)
+        infos = self.cache.snapshot_infos()
+        count = 0
+        for pod in sorted(unschedulable, key=lambda p: -p.priority):
+            plan = preemptmod.pick_preemption(pod, infos)
+            if plan is None:
+                continue
+            for vic in plan.victims:
+                try:
+                    self.api.delete("Pod", vic.namespace, vic.name)
+                except NotFound:
+                    pass
+                self._event(vic, "Normal", "Preempted",
+                            f"by {pod.key()} on node {plan.node_name}")
+                # reflect the eviction in the local view immediately so a
+                # second preemptor this round does not double-count the
+                # same victims
+                info = infos.get(plan.node_name)
+                if info is not None:
+                    info.remove_pod(vic)
+            # reserve the freed capacity for THIS preemptor in the local
+            # view (the 1.8 nominated-pod reservation): a second
+            # preemptor this round must not plan into the same hole and
+            # over-evict
+            info = infos.get(plan.node_name)
+            if info is not None:
+                info.add_pod(pod)
+            self._event(pod, "Normal", "TriggeredPreemption",
+                        f"{len(plan.victims)} lower-priority pod(s) on "
+                        f"{plan.node_name} evicted")
+            count += 1
+        return count
+
     def run_until_drained(self, max_rounds: int = 10_000,
                           max_batch: int = 0) -> Dict[str, int]:
         """Bench helper: rounds until queue is empty and no watch events."""
-        total = {"popped": 0, "bound": 0, "unschedulable": 0, "bind_errors": 0}
+        total = {"popped": 0, "bound": 0, "unschedulable": 0,
+                 "bind_errors": 0, "preemptions": 0}
         for _ in range(max_rounds):
             stats = self.schedule_round(max_batch=max_batch)
             for k in total:
