@@ -307,12 +307,24 @@ class Scheduler:
         drain through sync(), the freed capacity places it in a following
         round (the nominate-then-reschedule flow)."""
         from kubernetes_tpu.engine import preemption as preemptmod
+        from kubernetes_tpu.ops.oracle_ext import SchedulingContext
         # clones: the victim bookkeeping below must not mutate the live
         # cache (the DELETED watch events do that authoritatively)
         infos = self.cache.snapshot_infos()
+        # full predicate context: without it the feasibility check would
+        # ignore inter-pod affinity / volumes / policy algorithms and
+        # evict victims that free nothing for the preemptor. Victims stay
+        # in ctx.infos during the check — conservative: a node whose
+        # feasibility depends on a victim's own anti-affinity going away
+        # is skipped rather than over-evicted.
+        ctx = SchedulingContext(
+            infos, self.engine.workloads_provider(),
+            hard_pod_affinity_weight=self.engine.hard_pod_affinity_weight,
+            volume_ctx=self.engine.volume_ctx,
+            policy_algos=self.engine.policy_algos)
         count = 0
         for pod in sorted(unschedulable, key=lambda p: -p.priority):
-            plan = preemptmod.pick_preemption(pod, infos)
+            plan = preemptmod.pick_preemption(pod, infos, ctx=ctx)
             if plan is None:
                 continue
             for vic in plan.victims:

@@ -318,7 +318,7 @@ class HollowKubelet:
     def __init__(self, api: ApiServerLite, node: Node,
                  startup_latency: float = 0.0,
                  now: Callable[[], float] = time.monotonic,
-                 volume_manager=None):
+                 volume_manager=None, checkpointer=None):
         self.api = api
         self.node_name = node.name
         self._template = node
@@ -338,6 +338,14 @@ class HollowKubelet:
         # volumes/manager.py VolumeManager; None keeps the hollow-fleet
         # fast path volume-free (kubemark's hollow kubelet does the same)
         self.volumes = volume_manager
+        # node-local sandbox checkpoints (nodes/checkpoint.py, the
+        # dockershim checkpoint_store analog): restart counters survive a
+        # kubelet restart instead of resetting to zero
+        self.checkpointer = checkpointer
+        # restore_all validates + prunes corrupt blobs in one pass and
+        # never raises — kubelet startup must survive any checkpoint state
+        self._restored: Dict[str, Dict] = {} if checkpointer is None \
+            else checkpointer.restore_all()
 
     # ----------------------------------------------------------- node status
 
@@ -439,6 +447,19 @@ class HollowKubelet:
         self._admitted[key] = pod
         self._starting[key] = self._now() + self.startup_latency
         self.prober.add_pod(pod, self._now())
+        rec = self._restored.pop(key, None)
+        if rec is not None and rec.get("restarts"):
+            # resume the pre-restart counter (docker_checkpoint.go's
+            # sandbox state reconstruction)
+            self._restarts[key] = rec["restarts"]
+        self._checkpoint(key)
+
+    def _checkpoint(self, key: str) -> None:
+        if self.checkpointer is None:
+            return
+        self.checkpointer.checkpoint(key, {
+            "restarts": self._restarts.get(key, 0),
+            "node": self.node_name})
 
     def forget_pod(self, pod: Pod) -> None:
         """Pod deleted from the apiserver (kubelet HandlePodRemoves)."""
@@ -454,6 +475,8 @@ class HollowKubelet:
         self.prober.remove_pod(key)
         if self.volumes is not None:
             self.volumes.teardown_pod(key)
+        if self.checkpointer is not None:
+            self.checkpointer.remove(key)
 
     # ----------------------------------------------------------- static pods
 
@@ -505,6 +528,21 @@ class HollowKubelet:
         now = self._now()
         wrote = 0
         self.workers.drain()
+        # orphaned-checkpoint GC: a restored record whose pod was deleted
+        # (or rebound) while this kubelet was down never gets re-admitted
+        # — without this sweep its file lives forever and a future
+        # same-named pod would inherit a dead pod's restart counter
+        if self.checkpointer is not None and self._restored:
+            for pod_key in list(self._restored):
+                ns, _, name = pod_key.partition("/")
+                try:
+                    cur = self.api.get("Pod", ns, name)
+                    stale = cur.node_name != self.node_name
+                except NotFound:
+                    stale = True
+                if stale:
+                    self._restored.pop(pod_key, None)
+                    self.checkpointer.remove(pod_key)
         for pod in self._static.values():
             self._ensure_mirror(pod)
         for key, ready_at in list(self._starting.items()):
@@ -566,6 +604,7 @@ class HollowKubelet:
             self._forget(key)
             return 1
         self._restarts[key] = self._restarts.get(key, 0) + 1
+        self._checkpoint(key)
         started_at = self._now() + self.startup_latency
         self._starting[key] = started_at
         self.prober.restart(pod, started_at)

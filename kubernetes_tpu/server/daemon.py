@@ -48,6 +48,23 @@ class SchedulerOptions:
     healthz_port: int = 0  # 0 = ephemeral; None disables the server
     batch_mode: str = "wave"
 
+    @classmethod
+    def from_component_config(cls, cfg) -> "SchedulerOptions":
+        """Options from a decoded componentconfig
+        KubeSchedulerConfiguration (api/scheme.py) — the
+        --config/--policy-configmap path of the reference server
+        (KubeSchedulerConfiguration, componentconfig types.go:158)."""
+        host, _, port = cfg.healthz_bind_address.rpartition(":")
+        return cls(
+            scheduler_name=cfg.scheduler_name,
+            algorithm_provider=cfg.algorithm_provider,
+            policy_config_file=cfg.policy_config_file or None,
+            leader_elect=cfg.leader_election.leader_elect,
+            lock_object_namespace=cfg.leader_election.lock_object_namespace,
+            lock_object_name=cfg.leader_election.lock_object_name,
+            healthz_host=host or "127.0.0.1",
+            healthz_port=int(port) if port else 0)
+
 
 class SchedulerDaemon:
     def __init__(self, api: ApiServerLite, identity: str,
@@ -206,6 +223,9 @@ def main(argv=None) -> None:
     ap.add_argument("--nodes", type=int, default=50)
     ap.add_argument("--pods", type=int, default=500)
     ap.add_argument("--policy-config-file", default=None)
+    ap.add_argument("--config", default=None,
+                    help="componentconfig KubeSchedulerConfiguration file "
+                         "(versioned; decoded through api/scheme.py)")
     args = ap.parse_args(argv)
 
     api = ApiServerLite()
@@ -213,7 +233,24 @@ def main(argv=None) -> None:
         api.create("Node", make_node(f"node-{i:03d}"))
     for i in range(args.pods):
         api.create("Pod", make_pod(f"pod-{i:04d}", cpu=100))
-    opts = SchedulerOptions(policy_config_file=args.policy_config_file)
+    if args.config:
+        import json as _json
+
+        from kubernetes_tpu.api.scheme import DEFAULT_SCHEME
+        from kubernetes_tpu.utils import features
+        with open(args.config) as f:
+            cfg = DEFAULT_SCHEME.decode(_json.load(f))
+        for gate, val in cfg.feature_gates.items():
+            features.DEFAULT_FEATURE_GATE.set(gate, val)
+        opts = SchedulerOptions.from_component_config(cfg)
+        if args.policy_config_file:
+            opts.policy_config_file = args.policy_config_file
+        # the demo runs TWO daemons in one process: a fixed healthz port
+        # from the config (default 10251) would EADDRINUSE on the second
+        # — ephemeral ports for both, like the no-config path
+        opts.healthz_port = 0
+    else:
+        opts = SchedulerOptions(policy_config_file=args.policy_config_file)
     a = SchedulerDaemon(api, "daemon-a", opts)
     b = SchedulerDaemon(api, "daemon-b", opts)
     for _ in range(50):

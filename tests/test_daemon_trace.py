@@ -169,3 +169,25 @@ def test_released_lease_acquirable_even_at_small_clock_values():
     assert a.step() is True
     a.release()
     assert b.step() is True, "released lease must be immediately acquirable"
+
+
+def test_daemon_from_component_config(tmp_path):
+    """--config: a versioned KubeSchedulerConfiguration drives the daemon
+    options (the reference's componentconfig path, types.go:158-198)."""
+    import json
+
+    from kubernetes_tpu.api.scheme import DEFAULT_SCHEME
+    from kubernetes_tpu.server.daemon import SchedulerOptions
+
+    cfg = DEFAULT_SCHEME.decode({
+        "apiVersion": "componentconfig/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "schedulerName": "tpu-sched",
+        "healthzBindAddress": "127.0.0.1:0",
+        "leaderElection": {"leaderElect": False,
+                           "lockObjectName": "my-lock"}})
+    opts = SchedulerOptions.from_component_config(cfg)
+    assert opts.scheduler_name == "tpu-sched"
+    assert opts.leader_elect is False
+    assert opts.lock_object_name == "my-lock"
+    assert opts.healthz_port == 0

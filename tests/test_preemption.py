@@ -220,3 +220,44 @@ def test_two_preemptors_do_not_over_evict_same_node(pod_priority):
         _time.sleep(0.05)
     assert len([p for p in api.list("Pod")[0]
                 if p.name.startswith("crit-") and p.node_name]) == 2
+
+
+def test_preemption_respects_anti_affinity(pod_priority):
+    """Finding regression: a preemptor blocked by anti-affinity against a
+    HIGHER-priority pod must not evict lower-priority pods — the eviction
+    would free nothing (pick_preemption now verifies with the full
+    SchedulingContext, not resources alone)."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+    )
+
+    api = ApiServerLite()
+    node = make_node("n1", cpu=2000, memory=8 * Gi)
+    node.labels["kubernetes.io/hostname"] = "n1"
+    api.create("Node", node)
+    sched = Scheduler(api)
+    sched.start()
+    blocker = prio_pod("blocker", 2000, cpu=100)
+    blocker.labels["app"] = "db"
+    api.create("Pod", blocker)
+    for i in range(2):
+        api.create("Pod", prio_pod(f"low-{i}", 1, cpu=900))
+    sched.run_until_drained()
+    assert all(p.node_name for p in api.list("Pod")[0])
+    # preemptor anti-affine to the priority-2000 blocker on the only node
+    pre = prio_pod("pre", 500, cpu=900)
+    pre.affinity = Affinity(pod_anti_affinity=PodAffinity(required_terms=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": "db"}),
+            topology_key="kubernetes.io/hostname")]))
+    api.create("Pod", pre)
+    stats = sched.schedule_round()
+    assert stats["unschedulable"] == 1
+    # NO preemption: evicting low-priority pods cannot cure the
+    # anti-affinity against the higher-priority blocker
+    assert stats["preemptions"] == 0
+    assert len([p for p in api.list("Pod")[0]
+                if p.name.startswith("low-")]) == 2

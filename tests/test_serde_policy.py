@@ -159,3 +159,79 @@ def test_decode_pod_owner_uid():
         "spec": {"containers": []}})
     assert pod.owner_uid == "u-42"
     assert pod.owner_kind == "ReplicaSet"
+
+
+# ------------------------------------------------ scheme (runtime.Scheme)
+
+
+def test_scheme_scheduler_config_roundtrip_and_defaults():
+    from kubernetes_tpu.api.scheme import (
+        DEFAULT_SCHEME,
+        KubeSchedulerConfiguration,
+        SchemeError,
+    )
+
+    # defaults applied at decode (v1alpha1 defaults.go)
+    cfg = DEFAULT_SCHEME.decode({
+        "apiVersion": "componentconfig/v1alpha1",
+        "kind": "KubeSchedulerConfiguration"})
+    assert cfg.scheduler_name == "default-scheduler"
+    assert cfg.leader_election.leader_elect is True
+    assert cfg.leader_election.lease_duration_s == 15.0
+    assert cfg.hard_pod_affinity_symmetric_weight == 1
+    # explicit fields survive a versioned round-trip
+    cfg2 = DEFAULT_SCHEME.decode({
+        "apiVersion": "componentconfig/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "schedulerName": "tpu-scheduler",
+        "policyConfigFile": "/etc/policy.json",
+        "featureGates": "PodPriority=true,AllAlpha=false",
+        "leaderElection": {"leaseDuration": "1m30s",
+                           "leaderElect": False}})
+    assert cfg2.leader_election.lease_duration_s == 90.0
+    assert cfg2.feature_gates == {"PodPriority": True, "AllAlpha": False}
+    wire = DEFAULT_SCHEME.encode(cfg2, "componentconfig/v1alpha1",
+                                 "KubeSchedulerConfiguration")
+    assert wire["apiVersion"] == "componentconfig/v1alpha1"
+    again = DEFAULT_SCHEME.decode(wire)
+    assert again == cfg2
+    # unknown version fails loudly
+    import pytest as _pytest
+    with _pytest.raises(SchemeError):
+        DEFAULT_SCHEME.decode({"apiVersion": "componentconfig/v9",
+                               "kind": "KubeSchedulerConfiguration"})
+    # validation: the weight range check
+    with _pytest.raises(SchemeError):
+        DEFAULT_SCHEME.decode({
+            "apiVersion": "componentconfig/v1alpha1",
+            "kind": "KubeSchedulerConfiguration",
+            "hardPodAffinitySymmetricWeight": 1000})
+
+
+def test_scheme_duration_parsing():
+    from kubernetes_tpu.api.scheme import SchemeError, _seconds
+
+    assert _seconds("15s") == 15.0
+    assert _seconds("1m30s") == 90.0
+    assert _seconds("2h") == 7200.0
+    assert _seconds("250ms") == 0.25
+    assert _seconds(7) == 7.0
+    import pytest as _pytest
+    for bad in ("15", "s", "1x"):
+        with _pytest.raises(SchemeError):
+            _seconds(bad)
+
+
+def test_scheme_policy_v1_decodes_through_parser():
+    from kubernetes_tpu.api.scheme import DEFAULT_SCHEME
+
+    pol = DEFAULT_SCHEME.decode({
+        "apiVersion": "v1", "kind": "Policy",
+        "predicates": [{"name": "PodFitsResources"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}]})
+    assert [p.name for p in pol.predicates] == ["PodFitsResources"]
+    # the unversioned legacy shape (--use-legacy-policy-config) decodes too
+    pol2 = DEFAULT_SCHEME.decode({
+        "apiVersion": "", "kind": "Policy",
+        "predicates": [{"name": "PodFitsHostPorts"}]})
+    assert [p.name for p in pol2.predicates] == ["PodFitsHostPorts"]
