@@ -388,11 +388,28 @@ class Ktctl:
         return kind_plural(kind)
 
     def _objs(self, kind: str, ns: str, name: str = "",
-              selector: str = "") -> List[Any]:
+              selector: str = "", field_selector: str = "") -> List[Any]:
         if name:
+            if selector or field_selector:
+                # kubectl refuses a resource name combined with selectors
+                # — silently ignoring the filter the user typed is worse
+                raise SystemExit(
+                    "error: selectors cannot be combined with a "
+                    "resource name")
             return [self.api.get(kind, ns if not self._cluster_scoped(kind) else "",
                                  name)]
-        objs, _ = self.api.list(kind)
+        try:
+            # field selection runs SERVER-side (the reference pushes
+            # fieldSelector into the list request) for both backends
+            if field_selector:
+                objs, _ = self.api.list(kind,
+                                        field_selector=field_selector)
+            else:
+                objs, _ = self.api.list(kind)
+        except Exception as e:
+            if type(e).__name__ in ("Invalid", "HttpError"):
+                raise SystemExit(f"error: {e}") from None
+            raise
         if not self._cluster_scoped(kind) and ns != "*":
             objs = [o for o in objs if getattr(o, "namespace", "") == ns]
         if selector:
@@ -412,7 +429,8 @@ class Ktctl:
         if "all-namespaces" in flags:
             ns = "*"
         objs = self._objs(kind, ns, pos[1] if len(pos) > 1 else "",
-                          flags.get("selector", ""))
+                          flags.get("selector", ""),
+                          flags.get("field-selector", ""))
         self._print(render(kind, objs, flags.get("output", "table"),
                            plural=self._plural(kind),
                            sort_by=flags.get("sort-by", "")))

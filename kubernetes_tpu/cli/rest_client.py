@@ -86,8 +86,12 @@ class RestClient:
         return wire.decode_any(
             self._do("GET", self._url(kind, namespace, name)), kind=kind)
 
-    def list(self, kind: str) -> Tuple[list, int]:
-        out = self._do("GET", self._url(kind, ""))
+    def list(self, kind: str, field_selector: str = "") -> Tuple[list, int]:
+        url = self._url(kind, "")
+        if field_selector:
+            from urllib.parse import quote
+            url += "?fieldSelector=" + quote(field_selector)
+        out = self._do("GET", url)
         objs = [wire.decode_any(item, kind=kind) for item in out["items"]]
         return objs, out.get("resourceVersion", 0)
 

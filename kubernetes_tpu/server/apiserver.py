@@ -298,10 +298,12 @@ class ApiServer:
         return self._run(cred, "get", kind, namespace, name, do)
 
     def list(self, kind: str, cred: Optional[Credential] = None,
-             namespace: str = ""):
+             namespace: str = "", field_selector: str = ""):
         """namespace="" = cluster-wide list (needs cluster-wide authority);
         a namespace scopes both the RBAC check and the result set, like the
-        namespaced list endpoints."""
+        namespaced list endpoints. field_selector is the apimachinery
+        fields axis ("spec.nodeName=n1,status.phase!=Failed") applied
+        through the per-kind GetAttrs (api/fields.py)."""
 
         def do(user: UserInfo):
             self._serving_info(kind)
@@ -309,6 +311,17 @@ class ApiServer:
             if namespace:
                 objs = [o for o in objs
                         if getattr(o, "namespace", "") == namespace]
+            if field_selector:
+                from kubernetes_tpu.api.fields import (
+                    FieldSelectorError,
+                    filter_objects,
+                    parse_field_selector,
+                )
+                try:
+                    objs = filter_objects(
+                        kind, objs, parse_field_selector(field_selector))
+                except FieldSelectorError as e:
+                    raise Invalid(str(e)) from None
             return objs, rv
 
         return self._run(cred, "list", kind, namespace, "", do)

@@ -215,7 +215,9 @@ class RestServer:
                     obj = api.get(kind, ns, name, cred=cred)
                     return self._send(200, wire.encode(obj, kind=kind))
                 if method == "GET":
-                    objs, rv = api.list(kind, cred=cred, namespace=ns)
+                    objs, rv = api.list(
+                        kind, cred=cred, namespace=ns,
+                        field_selector=q.get("fieldSelector", [""])[0])
                     sel = q.get("labelSelector", [""])[0]
                     if sel:
                         want = dict(kv.split("=", 1)

@@ -1,0 +1,143 @@
+"""Field selectors (apimachinery/pkg/fields + registry GetAttrs).
+
+Pinned reference behaviors:
+- parse: k=v / k==v / k!=v comma-joined, ANDed (fields/selector.go);
+- per-kind selectable sets (pod/strategy.go PodToSelectableFields:
+  metadata.*, spec.nodeName, spec.schedulerName, spec.restartPolicy,
+  status.phase);
+- unsupported field label is an error, not an empty result;
+- served through list on the apiserver, REST (?fieldSelector=), and
+  ktctl --field-selector.
+"""
+
+import io
+
+import pytest
+
+from kubernetes_tpu.api.fields import (
+    FieldSelectorError,
+    filter_objects,
+    parse_field_selector,
+)
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.api.workloads import Namespace
+from kubernetes_tpu.cli.ktctl import Ktctl
+from kubernetes_tpu.server.apiserver import ApiServer, Invalid
+
+Mi = 1 << 20
+
+
+def test_parse_forms_and_errors():
+    sel = parse_field_selector("spec.nodeName=n1,status.phase!=Failed")
+    assert sel.requirements == (("spec.nodeName", "=", "n1"),
+                                ("status.phase", "!=", "Failed"))
+    assert parse_field_selector("a==b").requirements == (("a", "=", "b"),)
+    assert parse_field_selector("").empty
+    for bad in ("nodeName", "=v", ",,=,"):
+        with pytest.raises(FieldSelectorError):
+            parse_field_selector(bad)
+
+
+def make_server():
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    for i, phase in enumerate(("Running", "Pending", "Running")):
+        p = make_pod(f"p{i}", cpu=10, memory=Mi)
+        p.node_name = f"n{i % 2}"
+        p.phase = phase
+        api.store.create("Pod", p)
+    api.store.create("Node", make_node("n0", cpu=1000, memory=1 << 31))
+    n1 = make_node("n1", cpu=1000, memory=1 << 31)
+    n1.unschedulable = True
+    api.store.create("Node", n1)
+    return api
+
+
+def test_list_with_field_selector():
+    api = make_server()
+    objs, _ = api.list("Pod", field_selector="spec.nodeName=n0")
+    assert sorted(o.name for o in objs) == ["p0", "p2"]
+    objs, _ = api.list("Pod",
+                       field_selector="spec.nodeName=n0,"
+                                      "status.phase!=Pending")
+    assert sorted(o.name for o in objs) == ["p0", "p2"]
+    objs, _ = api.list("Pod", field_selector="status.phase=Pending")
+    assert [o.name for o in objs] == ["p1"]
+    objs, _ = api.list("Node", field_selector="spec.unschedulable=true")
+    assert [o.name for o in objs] == ["n1"]
+    objs, _ = api.list("Pod", field_selector="metadata.name=p1")
+    assert [o.name for o in objs] == ["p1"]
+
+
+def test_unsupported_field_label_is_invalid():
+    api = make_server()
+    with pytest.raises(Invalid, match="field label not supported"):
+        api.list("Pod", field_selector="spec.bogus=x")
+
+
+def test_generic_kind_supports_metadata_only():
+    api = make_server()
+    objs, _ = api.list("Namespace", field_selector="metadata.name=default")
+    assert [o.name for o in objs] == ["default"]
+    with pytest.raises(Invalid):
+        api.list("Namespace", field_selector="spec.finalizers=x")
+
+
+def test_field_selector_over_rest_and_cli():
+    from kubernetes_tpu.cli.rest_client import RestClient
+    from kubernetes_tpu.server.rest_http import RestServer
+
+    api = make_server()
+    srv = RestServer(api)
+    srv.start()
+    try:
+        client = RestClient(f"http://127.0.0.1:{srv.port}")
+        objs, _ = client.list("Pod", field_selector="spec.nodeName=n1")
+        assert [o.name for o in objs] == ["p1"]
+        from kubernetes_tpu.cli.rest_client import HttpError
+        with pytest.raises(HttpError):
+            client.list("Pod", field_selector="nope=1")
+    finally:
+        srv.stop()
+    out = io.StringIO()
+    kt = Ktctl(api, out=out)
+    assert kt.run(["get", "pods", "--field-selector",
+                   "status.phase=Running", "-o", "name"]) == 0
+    assert sorted(out.getvalue().split()) == ["pods/p0", "pods/p2"]
+    # bad selector: clean CLI error
+    assert kt.run(["get", "pods", "--field-selector", "bogus"]) == 1
+
+
+def test_filter_objects_direct():
+    pods = []
+    for i in range(4):
+        p = make_pod(f"p{i}", cpu=1, memory=Mi)
+        p.node_name = "nA" if i % 2 == 0 else "nB"
+        pods.append(p)
+    sel = parse_field_selector("spec.nodeName=nA")
+    assert [p.name for p in filter_objects("Pod", pods, sel)] \
+        == ["p0", "p2"]
+
+
+def test_invalid_selector_rejected_even_on_empty_cluster():
+    """Finding regression: validation is unconditional, not per matched
+    object — an empty cluster must not make a bad selector succeed."""
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    with pytest.raises(Invalid, match="field label not supported"):
+        api.list("Pod", field_selector="spec.bogus=x")
+    # short-circuit case: first requirement matches nothing, second is
+    # invalid — still an error
+    api2 = make_server()
+    with pytest.raises(Invalid):
+        api2.list("Pod",
+                  field_selector="status.phase=NoSuch,spec.bogus=x")
+
+
+def test_named_get_with_selector_is_rejected():
+    api = make_server()
+    out = io.StringIO()
+    kt = Ktctl(api, out=out)
+    assert kt.run(["get", "pods", "p0", "--field-selector",
+                   "spec.nodeName=n1"]) == 1
+    assert "cannot be combined" in out.getvalue()

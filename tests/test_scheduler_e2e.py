@@ -158,3 +158,27 @@ def test_foreign_scheduler_pods_ignored():
     assert stats["bound"] == 1
     assert api.get("Pod", "default", "mine").node_name == "n0"
     assert api.get("Pod", "default", "other").node_name == ""
+
+
+def test_density_100_nodes_3k_pods_meets_reference_floor():
+    """TestSchedule100Node3KPods (scheduler_perf/scheduler_test.go:34-39,
+    72-90): 100 nodes / 3,000 pods through the full control plane must
+    sustain >= 30 pods/s (the reference's hard-fail floor; its warn level
+    is 100 pods/s). The CPU test backend clears both by orders of
+    magnitude — the assert pins the reference envelope, not our best."""
+    import time as _time
+
+    from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes, \
+        load_cluster
+
+    api = ApiServerLite(max_log=200_000)
+    load_cluster(api, hollow_nodes(100), PROFILES["density"](3000))
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    t0 = _time.monotonic()
+    totals = sched.run_until_drained()
+    elapsed = _time.monotonic() - t0
+    assert totals["bound"] == 3000
+    assert totals["unschedulable"] == 0
+    pods_per_s = 3000 / elapsed
+    assert pods_per_s >= 30, f"{pods_per_s:.1f} pods/s below the floor"
