@@ -115,12 +115,21 @@ _FIELD_FUNCS = {
 }
 
 _META_KEYS = frozenset({"metadata.name", "metadata.namespace"})
-SELECTABLE_KEYS = {
-    "Pod": _META_KEYS | {"spec.nodeName", "spec.schedulerName",
-                         "spec.restartPolicy", "status.phase"},
-    "Node": _META_KEYS | {"spec.unschedulable"},
-    "Event": _META_KEYS | {"involvedObject.name", "reason", "type"},
+# derived from the field functions themselves (one source of truth: a new
+# key added to pod_fields is immediately selectable, no parallel set to
+# forget updating)
+import types as _types
+
+_STUBS = {
+    "Pod": _types.SimpleNamespace(name="", namespace="", node_name="",
+                                  phase=""),
+    "Node": _types.SimpleNamespace(name="", namespace="",
+                                   unschedulable=False),
+    "Event": _types.SimpleNamespace(name="", namespace="", object_key="",
+                                    reason="", type=""),
 }
+SELECTABLE_KEYS = {kind: frozenset(fn(_STUBS[kind]).keys())
+                   for kind, fn in _FIELD_FUNCS.items()}
 
 
 def selectable_fields(kind: str, obj: Any) -> Dict[str, str]:

@@ -398,18 +398,20 @@ class Ktctl:
                     "resource name")
             return [self.api.get(kind, ns if not self._cluster_scoped(kind) else "",
                                  name)]
+        from kubernetes_tpu.cli.rest_client import HttpError
+        from kubernetes_tpu.server.apiserver import Invalid
         try:
             # field selection runs SERVER-side (the reference pushes
-            # fieldSelector into the list request) for both backends
+            # fieldSelector into the list request); the kwarg is passed
+            # only when set — a bare ApiServerLite backend (kubefed's
+            # member clusters) has no field_selector parameter
             if field_selector:
                 objs, _ = self.api.list(kind,
                                         field_selector=field_selector)
             else:
                 objs, _ = self.api.list(kind)
-        except Exception as e:
-            if type(e).__name__ in ("Invalid", "HttpError"):
-                raise SystemExit(f"error: {e}") from None
-            raise
+        except (Invalid, HttpError) as e:
+            raise SystemExit(f"error: {e}") from None
         if not self._cluster_scoped(kind) and ns != "*":
             objs = [o for o in objs if getattr(o, "namespace", "") == ns]
         if selector:

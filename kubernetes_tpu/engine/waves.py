@@ -71,9 +71,10 @@ _BIG = np.int32(2 ** 31 - 1)
 def _dynamic_fits(cls: Arrays, nodes: Arrays, state: NodeState) -> jnp.ndarray:
     """Capacity-dependent predicate chain vs the wave's frozen state, [C,N].
     Same math as ops/predicates.fits but reading the evolving NodeState."""
+    from kubernetes_tpu.ops.pallas_kernels import resources_fit_fast
     return (
-        preds.resources_fit(cls["req"], cls["zero_req"], nodes["alloc"],
-                            state.requested)
+        resources_fit_fast(cls["req"], cls["zero_req"], nodes["alloc"],
+                           state.requested)
         & preds.pod_count_fit(state.pod_count, nodes["allowed_pods"])[None, :]
         & preds.ports_fit(cls["ports"], state.port_bitmap)
         & preds.no_disk_conflict(cls["vol_hard"], cls["vol_ro"],

@@ -364,9 +364,11 @@ def fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
     i.e. the default provider's registered predicates that are modeled so far
     (volume predicates pending; see SURVEY.md §7 step 7).
     """
+    from kubernetes_tpu.ops.pallas_kernels import resources_fit_fast
     return (
         static_fits(pods, nodes)
-        & resources_fit(pods["req"], pods["zero_req"], nodes["alloc"], nodes["requested"])
+        & resources_fit_fast(pods["req"], pods["zero_req"], nodes["alloc"],
+                             nodes["requested"])
         & pod_count_fit(nodes["pod_count"], nodes["allowed_pods"])[None, :]
         & ports_fit(pods["ports"], nodes["port_bitmap"])
         & no_disk_conflict(pods["vol_hard"], pods["vol_ro"],
