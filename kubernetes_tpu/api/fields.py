@@ -115,20 +115,20 @@ _FIELD_FUNCS = {
 }
 
 _META_KEYS = frozenset({"metadata.name", "metadata.namespace"})
-# derived from the field functions themselves (one source of truth: a new
-# key added to pod_fields is immediately selectable, no parallel set to
-# forget updating)
-import types as _types
 
-_STUBS = {
-    "Pod": _types.SimpleNamespace(name="", namespace="", node_name="",
-                                  phase=""),
-    "Node": _types.SimpleNamespace(name="", namespace="",
-                                   unschedulable=False),
-    "Event": _types.SimpleNamespace(name="", namespace="", object_key="",
-                                    reason="", type=""),
-}
-SELECTABLE_KEYS = {kind: frozenset(fn(_STUBS[kind]).keys())
+
+class _AnyStub:
+    """Answers "" for every attribute — lets the selectable key sets be
+    DERIVED from the field functions themselves (run each fn once against
+    a stub and record the keys it emits), so a new field added to
+    pod_fields is immediately selectable with no parallel set or
+    per-kind stub object to keep in sync."""
+
+    def __getattr__(self, name):
+        return ""
+
+
+SELECTABLE_KEYS = {kind: frozenset(fn(_AnyStub()).keys())
                    for kind, fn in _FIELD_FUNCS.items()}
 
 

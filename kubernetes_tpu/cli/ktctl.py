@@ -265,7 +265,12 @@ class Ktctl:
 
     def __init__(self, api: ApiServer, out=None, federation=None,
                  federation_contexts=None, cred=None,
-                 kubeconfig: Optional[str] = None):
+                 kubeconfig: Optional[str] = None, kubelets=None):
+        # `kubelets`: node name -> kubelet API base URL (nodes/
+        # kubelet_server.py) or in-process HollowKubelet — the routing
+        # table `logs`/`exec` use, the way kubectl reaches kubelets
+        # through the apiserver proxy
+        self.kubelets = kubelets or {}
         if kubeconfig is not None:
             # a ktadm-written kubeconfig (cli/ktadm.py phase_kubeconfig):
             # carry its identity record as the client credential
@@ -842,6 +847,99 @@ class Ktctl:
             self._print("synced")
         else:
             raise SystemExit(f"error: unknown federate verb {verb!r}")
+
+    def _kubelet_for(self, node_name: str):
+        kubelets = getattr(self, "kubelets", None) or {}
+        target = kubelets.get(node_name)
+        if target is None:
+            raise SystemExit(
+                f"error: no kubelet endpoint registered for node "
+                f"{node_name!r}")
+        return target
+
+    def cmd_logs(self, args):
+        """kubectl logs: resolve the pod's node, then read
+        /containerLogs/<ns>/<pod> from that node's kubelet API — the
+        apiserver-proxies-to-kubelet path (pkg/kubelet/server/server.go
+        InstallDebuggingHandlers; kubectl cmd/logs.go)."""
+        import urllib.request
+
+        from kubernetes_tpu.nodes.kubelet_server import KubeletApiError
+        from kubernetes_tpu.server.apiserver_lite import NotFound
+
+        pos, flags = self._flags(args)
+        if not pos:
+            raise SystemExit("error: pod name required")
+        ns = flags.get("namespace", "default")
+        try:
+            pod = self.api.get("Pod", ns, pos[0])
+        except NotFound as e:
+            raise SystemExit(f"error: {e}") from None
+        if not pod.node_name:
+            raise SystemExit(f"error: pod {pos[0]!r} is not scheduled yet")
+        target = self._kubelet_for(pod.node_name)
+        tail = flags.get("tail")
+        if isinstance(target, str):  # kubelet API base URL
+            q = f"?tailLines={tail}" if tail is not None else ""
+            try:
+                with urllib.request.urlopen(
+                        f"{target}/containerLogs/{ns}/{pos[0]}{q}") as r:
+                    self._print(r.read().decode().rstrip("\n"))
+            except urllib.error.HTTPError as e:
+                raise SystemExit(
+                    f"error: logs failed: {e.read().decode() or e}"
+                ) from None
+            return
+        # in-process HollowKubelet: the SAME serve_logs the HTTP server
+        # routes through — one implementation of the log semantics
+        try:
+            self._print(target.serve_logs(ns, pos[0], tail=tail))
+        except KubeletApiError as e:
+            raise SystemExit(f"error: {e}") from None
+
+    def cmd_exec(self, args):
+        """kubectl exec (non-streaming form): POST the command to the
+        node's kubelet /exec endpoint."""
+        import urllib.request
+        from urllib.parse import quote
+
+        # everything after "--" is the command verbatim (kubectl exec's
+        # arg contract) — it must never reach the flag parser
+        args = list(args)
+        if "--" in args:
+            split = args.index("--")
+            args, cmd_args = args[:split], args[split + 1:]
+        else:
+            cmd_args = []
+        pos, flags = self._flags(args)
+        if not pos or not cmd_args:
+            raise SystemExit("error: usage: exec POD -- COMMAND")
+        ns = flags.get("namespace", "default")
+        from kubernetes_tpu.nodes.kubelet_server import KubeletApiError
+        from kubernetes_tpu.server.apiserver_lite import NotFound
+
+        name, cmd = pos[0], " ".join(cmd_args)
+        try:
+            pod = self.api.get("Pod", ns, name)
+        except NotFound as e:
+            raise SystemExit(f"error: {e}") from None
+        if not pod.node_name:
+            raise SystemExit(f"error: pod {name!r} is not scheduled yet")
+        target = self._kubelet_for(pod.node_name)
+        if isinstance(target, str):
+            req = urllib.request.Request(
+                f"{target}/exec/{ns}/{name}?command={quote(cmd)}",
+                data=b"", method="POST")
+            try:
+                with urllib.request.urlopen(req) as r:
+                    self._print(r.read().decode().rstrip("\n"))
+            except Exception as e:
+                raise SystemExit(f"error: exec failed: {e}") from None
+            return
+        try:
+            self._print(target.serve_exec(ns, name, cmd))
+        except KubeletApiError as e:
+            raise SystemExit(f"error: {e}") from None
 
     def cmd_version(self, args):
         self._print("Client Version: v1.7.0-tpu.0")

@@ -478,6 +478,73 @@ class HollowKubelet:
         if self.checkpointer is not None:
             self.checkpointer.remove(key)
 
+    # ---------------------------------------------------- kubelet API serving
+
+    def serve_pods(self) -> list:
+        """/pods: the admitted pod set (server.go InstallDefaultHandlers).
+        dict() snapshot: handler threads must not iterate the live dict
+        while the sync loop mutates it."""
+        admitted = dict(self._admitted)
+        restarts = dict(self._restarts)
+        return [{"name": p.name, "namespace": p.namespace,
+                 "phase": p.phase, "restartCount": restarts.get(key, 0)}
+                for key, p in sorted(admitted.items())]
+
+    def serve_stats(self) -> dict:
+        """/stats/summary: the cadvisor summary shape."""
+        cpu = mem = count = 0
+        for p in dict(self._admitted).values():
+            r = p.resource_request()
+            cpu += r.milli_cpu
+            mem += r.memory
+            count += 1
+        return {"node": {"nodeName": self.node_name,
+                         "cpu": {"usageMilli": cpu},
+                         "memory": {"workingSetBytes": mem}},
+                "pods": count}
+
+    def serve_logs(self, namespace: str, name: str,
+                   tail=None) -> str:
+        """/containerLogs/<ns>/<pod>: the one source of truth for the
+        hollow log semantics (both the HTTP server and in-process ktctl
+        route here). tail=0 prints nothing, like kubectl --tail=0."""
+        from kubernetes_tpu.nodes.kubelet_server import (
+            KubeletApiError,
+            LOG_LINES_ANNOTATION,
+        )
+        pod = self._admitted.get(namespace + "/" + name)
+        if pod is None:
+            raise KubeletApiError(
+                404, f'pod "{namespace}/{name}" is not running on node '
+                     f'"{self.node_name}"')
+        lines = pod.annotations.get(LOG_LINES_ANNOTATION, "").split("\n")
+        if tail is not None:
+            try:
+                n = int(tail)
+            except (TypeError, ValueError):
+                raise KubeletApiError(
+                    400, f"invalid tailLines {tail!r}") from None
+            lines = lines[-n:] if n > 0 else []
+        return "\n".join(lines)
+
+    def serve_exec(self, namespace: str, name: str, cmd: str) -> str:
+        """POST /exec/<ns>/<pod>?command=...: canned hollow outputs."""
+        from kubernetes_tpu.nodes.kubelet_server import (
+            EXEC_PREFIX_ANNOTATION,
+            KubeletApiError,
+        )
+        pod = self._admitted.get(namespace + "/" + name)
+        if pod is None:
+            raise KubeletApiError(
+                404, f'pod "{namespace}/{name}" is not running on node '
+                     f'"{self.node_name}"')
+        out = pod.annotations.get(EXEC_PREFIX_ANNOTATION + cmd)
+        if out is None:
+            raise KubeletApiError(
+                501, f"no handler for command {cmd!r} in the hollow "
+                     f"runtime")
+        return out
+
     # ----------------------------------------------------------- static pods
 
     def add_static_pod(self, pod: Pod) -> None:

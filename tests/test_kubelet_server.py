@@ -1,0 +1,187 @@
+"""The kubelet API server (pkg/kubelet/server) + ktctl logs/exec.
+
+Pinned: the URL layout and status semantics of the reference kubelet's
+read-only/debugging handlers — /healthz, /pods, /stats/summary,
+/containerLogs/<ns>/<pod> (tailLines honored, 404 for a pod not running
+on this node), POST /exec (canned hollow-runtime outputs, 501 for
+commands the runtime has no handler for) — and the kubectl verbs that
+consume them end-to-end in both in-process and HTTP modes.
+"""
+
+import io
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.cli.ktctl import Ktctl
+from kubernetes_tpu.nodes.kubelet import HollowKubelet
+from kubernetes_tpu.nodes.kubelet_server import KubeletServer
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+Mi = 1 << 20
+Gi = 1 << 30
+
+
+def rig():
+    api = ApiServerLite()
+    node = make_node("n1", cpu=4000, memory=8 * Gi)
+    api.create("Node", node)
+    kubelet = HollowKubelet(api, node)
+    pod = make_pod("web", cpu=50, memory=Mi)
+    pod.node_name = "n1"
+    pod.annotations["bench/log-lines"] = "line1\nline2\nline3"
+    pod.annotations["bench/exec-cat /etc/hostname"] = "web-host"
+    api.create("Pod", pod)
+    kubelet.handle_pod(pod)
+    kubelet.workers.drain()
+    assert pod.key() in kubelet._admitted
+    return api, kubelet, pod
+
+
+def test_kubelet_server_endpoints():
+    api, kubelet, pod = rig()
+    srv = KubeletServer(kubelet)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz") as r:
+            assert r.read() == b"ok"
+        with urllib.request.urlopen(base + "/pods") as r:
+            items = json.loads(r.read())["items"]
+            assert [(i["name"], i["namespace"]) for i in items] \
+                == [("web", "default")]
+        with urllib.request.urlopen(base + "/stats/summary") as r:
+            stats = json.loads(r.read())
+            assert stats["node"]["cpu"]["usageMilli"] == 50
+            assert stats["pods"] == 1
+        with urllib.request.urlopen(
+                base + "/containerLogs/default/web") as r:
+            assert r.read().decode() == "line1\nline2\nline3"
+        with urllib.request.urlopen(
+                base + "/containerLogs/default/web?tailLines=1") as r:
+            assert r.read().decode() == "line3"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/containerLogs/default/ghost")
+        assert ei.value.code == 404
+        req = urllib.request.Request(
+            base + "/exec/default/web?command=cat%20/etc/hostname",
+            data=b"", method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert r.read().decode() == "web-host"
+        req = urllib.request.Request(
+            base + "/exec/default/web?command=rm%20-rf",
+            data=b"", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 501
+    finally:
+        srv.stop()
+
+
+def test_ktctl_logs_and_exec_in_process():
+    api, kubelet, pod = rig()
+    out = io.StringIO()
+    kt = Ktctl(api, out=out, kubelets={"n1": kubelet})
+    assert kt.run(["logs", "web"]) == 0
+    assert "line2" in out.getvalue()
+    out.truncate(0), out.seek(0)
+    assert kt.run(["logs", "web", "--tail", "1"]) == 0
+    assert out.getvalue().strip() == "line3"
+    out.truncate(0), out.seek(0)
+    assert kt.run(["exec", "web", "--", "cat", "/etc/hostname"]) == 0
+    assert out.getvalue().strip() == "web-host"
+    # unknown command and unknown node fail cleanly
+    assert kt.run(["exec", "web", "--", "reboot"]) == 1
+    p2 = make_pod("pending", cpu=10, memory=Mi)
+    api.create("Pod", p2)
+    assert kt.run(["logs", "pending"]) == 1
+
+
+def test_ktctl_logs_over_http():
+    api, kubelet, pod = rig()
+    srv = KubeletServer(kubelet)
+    srv.start()
+    try:
+        out = io.StringIO()
+        kt = Ktctl(api, out=out,
+                   kubelets={"n1": f"http://127.0.0.1:{srv.port}"})
+        assert kt.run(["logs", "web", "--tail", "2"]) == 0
+        assert out.getvalue().strip() == "line2\nline3"
+        out.truncate(0), out.seek(0)
+        assert kt.run(["exec", "web", "--", "cat", "/etc/hostname"]) == 0
+        assert out.getvalue().strip() == "web-host"
+    finally:
+        srv.stop()
+
+
+def test_tail_zero_and_bad_tail():
+    """kubectl --tail=0 prints nothing; a non-numeric tail is a 400, not
+    a traceback (review-finding regression)."""
+    api, kubelet, pod = rig()
+    assert kubelet.serve_logs("default", "web", tail="0") == ""
+    out = io.StringIO()
+    kt = Ktctl(api, out=out, kubelets={"n1": kubelet})
+    assert kt.run(["logs", "web", "--tail", "0"]) == 0
+    assert out.getvalue().strip() == ""
+    assert kt.run(["logs", "web", "--tail", "xyz"]) == 1
+    assert kt.run(["logs", "no-such-pod"]) == 1  # clean error, rc=1
+    srv = KubeletServer(kubelet)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(
+                base + "/containerLogs/default/web?tailLines=0") as r:
+            assert r.read() == b""
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                base + "/containerLogs/default/web?tailLines=abc")
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_concurrent_pods_requests_during_churn():
+    """/pods iterates a snapshot, never the live dict — concurrent reads
+    during admit/evict churn must not 500 (review-finding regression)."""
+    import threading
+
+    api, kubelet, pod = rig()
+    srv = KubeletServer(kubelet)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(base + "/pods", timeout=5) as r:
+                    json.loads(r.read())
+                with urllib.request.urlopen(base + "/stats/summary",
+                                            timeout=5) as r:
+                    json.loads(r.read())
+            except Exception as e:  # any failure is a real defect
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(60):
+            p = make_pod(f"churn-{i}", cpu=1, memory=Mi)
+            p.node_name = "n1"
+            api.create("Pod", p)
+            kubelet.handle_pod(p)
+            kubelet.workers.drain()
+            kubelet.forget_pod(p)
+            kubelet.workers.drain()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        srv.stop()
+    assert not errors, errors[:1]

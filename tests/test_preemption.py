@@ -261,3 +261,49 @@ def test_preemption_respects_anti_affinity(pod_priority):
     assert stats["preemptions"] == 0
     assert len([p for p in api.list("Pod")[0]
                 if p.name.startswith("low-")]) == 2
+
+
+def test_preemption_fuzz_invariants(pod_priority):
+    """Randomized clusters; invariants that must hold on every trial:
+    - no victim ever has priority >= its preemptor's;
+    - a planned node really fits the preemptor once victims leave
+      (verified against the exact oracle);
+    - the victim set is minimal: removing any single victim from the
+      eviction leaves the preemptor unfittable (no over-eviction)."""
+    import numpy as np
+
+    from kubernetes_tpu.ops import oracle
+
+    rng = np.random.default_rng(42)
+    for trial in range(15):
+        n_nodes = int(rng.integers(2, 8))
+        infos = {}
+        for i in range(n_nodes):
+            node = make_node(f"n{i}", cpu=int(rng.integers(500, 2000)),
+                             memory=8 * Gi)
+            info = NodeInfo(node)
+            for j in range(int(rng.integers(0, 5))):
+                info.add_pod(prio_pod(
+                    f"v{i}-{j}", int(rng.integers(0, 100)),
+                    cpu=int(rng.integers(50, 600)), node_name=f"n{i}"))
+            infos[f"n{i}"] = info
+        pre = prio_pod("pre", int(rng.integers(1, 200)),
+                       cpu=int(rng.integers(100, 1200)))
+        plan = pick_preemption(pre, infos)
+        if plan is None:
+            continue
+        assert all(v.priority < pre.priority for v in plan.victims), trial
+        info = infos[plan.node_name]
+        victims = {v.key() for v in plan.victims}
+
+        def fits_without(excluded):
+            base = NodeInfo(info.node)
+            for p in info.pods:
+                if p.key() not in excluded:
+                    base.add_pod(p)
+            return oracle.pod_fits(pre, base)
+
+        assert fits_without(victims), f"trial {trial}: plan does not fit"
+        for v in plan.victims:
+            assert not fits_without(victims - {v.key()}), \
+                f"trial {trial}: victim {v.name} was unnecessary"
