@@ -80,6 +80,33 @@ class CloudProvider:
     def delete_route(self, name: str) -> None:
         raise NotImplementedError
 
+    # -- Disks (the volume-attacher surface: providers/{gce,aws,azure}
+    # AttachDisk/DetachDisk/DisksAreAttached, consumed by
+    # volumes/plugins.py Attacher and the attach-detach controller)
+
+    def has_disks(self) -> bool:
+        return False  # capability flag: absent sub-interface = False,
+        # like has_instances/has_zones/has_load_balancer/has_routes
+
+    def create_disk(self, volume_id: str, size_gb: int = 10) -> None:
+        raise NotImplementedError
+
+    def delete_disk(self, volume_id: str) -> None:
+        raise NotImplementedError
+
+    def attach_disk(self, volume_id: str, node_name: str) -> None:
+        raise NotImplementedError
+
+    def detach_disk(self, volume_id: str, node_name: str) -> None:
+        raise NotImplementedError
+
+    def disks_attached(self, node_name: str) -> List[str]:
+        raise NotImplementedError
+
+
+class DiskError(Exception):
+    """Attach/detach failure (multi-attach, unknown disk, node limit)."""
+
 
 class FakeCloud(CloudProvider):
     """pkg/cloudprovider/providers/fake: records calls, serves canned data."""
@@ -95,6 +122,11 @@ class FakeCloud(CloudProvider):
         self.routes: Dict[str, Route] = {}
         self.calls: List[str] = []
         self._next_ip = 1
+        self.disks: Dict[str, int] = {}  # volume_id -> size_gb
+        self.attachments: Dict[str, str] = {}  # volume_id -> node
+        # per-node attachable-disk ceiling (the cloud-side analog of the
+        # MaxPDVolumeCount predicate defaults)
+        self.max_disks_per_node = 16
 
     # Instances
     def has_instances(self) -> bool:
@@ -161,6 +193,56 @@ class FakeCloud(CloudProvider):
         self.calls.append("delete-route")
         self.routes.pop(name, None)
 
+    # Disks
+    def has_disks(self) -> bool:
+        return True
+
+    def create_disk(self, volume_id: str, size_gb: int = 10) -> None:
+        with self._lock:
+            self.disks[volume_id] = size_gb
+
+    def delete_disk(self, volume_id: str) -> None:
+        with self._lock:
+            if volume_id in self.attachments:
+                raise DiskError(
+                    f"disk {volume_id!r} is attached to "
+                    f"{self.attachments[volume_id]!r}")
+            self.disks.pop(volume_id, None)
+
+    def _validate_attach_locked(self, volume_id: str) -> None:
+        """Flavor hook, called UNDER self._lock so existence checks cannot
+        race delete_disk (OpenStack's no-lazy-provisioning rule)."""
+
+    def attach_disk(self, volume_id: str, node_name: str) -> None:
+        """Single-writer attach: attaching a disk already on another node
+        fails (the multi-attach error every block-store cloud raises);
+        re-attach to the same node is idempotent."""
+        with self._lock:
+            self.calls.append("attach-disk")
+            self._validate_attach_locked(volume_id)
+            self.disks.setdefault(volume_id, 10)  # lazily provisioned
+            cur = self.attachments.get(volume_id)
+            if cur is not None and cur != node_name:
+                raise DiskError(
+                    f"disk {volume_id!r} is already attached to {cur!r}")
+            if cur is None and sum(
+                    1 for n in self.attachments.values()
+                    if n == node_name) >= self.max_disks_per_node:
+                raise DiskError(
+                    f"node {node_name!r} is at its attachable-disk limit")
+            self.attachments[volume_id] = node_name
+
+    def detach_disk(self, volume_id: str, node_name: str) -> None:
+        with self._lock:
+            self.calls.append("detach-disk")
+            if self.attachments.get(volume_id) == node_name:
+                del self.attachments[volume_id]
+
+    def disks_attached(self, node_name: str) -> List[str]:
+        with self._lock:
+            return sorted(v for v, n in self.attachments.items()
+                          if n == node_name)
+
 
 class GCELikeCloud(FakeCloud):
     """GCE-shaped behavior (providers/gce): per-zone instance groups, LB IPs
@@ -195,10 +277,63 @@ class AWSLikeCloud(FakeCloud):
         return st
 
 
+class AzureLikeCloud(FakeCloud):
+    """Azure-shaped behavior (providers/azure): LB frontend IPs from a
+    resource-group pool, tight default disk-per-node limit (the DS-series
+    data-disk caps the AzureDisk MaxPD filter mirrors)."""
+
+    provider_name = "azure-like"
+
+    def __init__(self, resource_group: str = "ktpu-rg"):
+        super().__init__()
+        self.resource_group = resource_group
+        self.max_disks_per_node = 8
+
+    def ensure_load_balancer(self, service_key, node_names):
+        st = super().ensure_load_balancer(service_key, node_names)
+        st.ingress_ip = "20.0.0." + st.ingress_ip.rsplit(".", 1)[1]
+        return st
+
+
+class OpenStackLikeCloud(FakeCloud):
+    """OpenStack-shaped behavior (providers/openstack): Cinder volumes
+    must be created before attach (no lazy provisioning), Neutron-style
+    floating IPs."""
+
+    provider_name = "openstack-like"
+
+    def _validate_attach_locked(self, volume_id: str) -> None:
+        if volume_id not in self.disks:
+            raise DiskError(
+                f"cinder volume {volume_id!r} does not exist")
+
+    def ensure_load_balancer(self, service_key, node_names):
+        st = super().ensure_load_balancer(service_key, node_names)
+        st.ingress_ip = "10.250.0." + st.ingress_ip.rsplit(".", 1)[1]
+        return st
+
+
+class VSphereLikeCloud(FakeCloud):
+    """vSphere-shaped behavior (providers/vsphere): no cloud
+    load-balancer or routes — instances/zones/disks only, like the
+    reference driver."""
+
+    provider_name = "vsphere-like"
+
+    def has_load_balancer(self) -> bool:
+        return False
+
+    def has_routes(self) -> bool:
+        return False
+
+
 _REGISTRY: Dict[str, Callable[[], CloudProvider]] = {
     "fake": FakeCloud,
     "gce-like": GCELikeCloud,
     "aws-like": AWSLikeCloud,
+    "azure-like": AzureLikeCloud,
+    "openstack-like": OpenStackLikeCloud,
+    "vsphere-like": VSphereLikeCloud,
 }
 
 

@@ -175,8 +175,12 @@ class AttachDetachController(Controller):
     name = "attachdetach-controller"
 
     def __init__(self, api: ApiServerLite, factory: SharedInformerFactory,
-                 record_events: bool = True):
+                 record_events: bool = True, cloud=None):
         super().__init__(api, record_events=record_events)
+        # optional cloud: real AttachDisk/DetachDisk calls ride along with
+        # the node-annotation record (the reference's operation executor
+        # calling the volume plugin attacher, which calls the cloud)
+        self.cloud = cloud
         self.pod_informer = factory.informer("Pod")
         self.pod_informer.add_event_handler(
             on_add=lambda o: o.node_name and self.enqueue(o.node_name),
@@ -211,6 +215,36 @@ class AttachDetachController(Controller):
         in_use = set(filter(None, node.annotations.get(
             IN_USE_ANNOTATION, "").split(",")))
         want |= current & in_use
+        attach_failures = []
         if want != current:
-            node.annotations[ATTACHED_ANNOTATION] = ",".join(sorted(want))
-            self.api.update("Node", node, expect_rv=node.resource_version)
+            if self.cloud is not None and self.cloud.has_disks():
+                from kubernetes_tpu.cloud.provider import DiskError
+
+                def vol_id(dev: str) -> str:
+                    # tolerant of colon-less entries, like the volume
+                    # plugins' Detacher parse
+                    return dev.partition(":")[2] or dev
+
+                for dev in sorted(want - current):
+                    try:
+                        self.cloud.attach_disk(vol_id(dev), key)
+                    except DiskError as e:
+                        # multi-attach / node limit: leave it un-recorded
+                        # so the kubelet keeps waiting
+                        self.event("Node", key, "Warning",
+                                   "FailedAttachVolume", str(e))
+                        attach_failures.append(str(e))
+                        want.discard(dev)
+                for dev in sorted(current - want):
+                    self.cloud.detach_disk(vol_id(dev), key)
+            if want != current:
+                node.annotations[ATTACHED_ANNOTATION] = ",".join(sorted(want))
+                self.api.update("Node", node,
+                                expect_rv=node.resource_version)
+        if attach_failures:
+            # successful work above is committed; raising hands the key
+            # back to the rate-limited queue so a refused attach is
+            # RETRIED (the cloud state it lost to — e.g. a detach on the
+            # other node — changes without any event landing on this one)
+            raise RuntimeError(
+                f"attach failures on {key}: " + "; ".join(attach_failures))

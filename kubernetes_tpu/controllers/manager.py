@@ -93,7 +93,8 @@ class ControllerManager:
             "route": RouteController(api, self.factory, self.cloud, **kw),
             "persistentvolume-binder": PersistentVolumeBinder(
                 api, self.factory, **kw),
-            "attachdetach": AttachDetachController(api, self.factory, **kw),
+            "attachdetach": AttachDetachController(api, self.factory,
+                                                   cloud=self.cloud, **kw),
             "csrapproving": CSRApprovingController(api, self.factory, **kw),
             "csrsigning": CSRSigningController(api, self.factory, ca, **kw),
         }
