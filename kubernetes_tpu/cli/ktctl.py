@@ -392,8 +392,18 @@ class Ktctl:
                 return r["name"]
         return kind_plural(kind)
 
+    def _objs_rv(self, kind: str, ns: str, name: str = "",
+                 selector: str = "", field_selector: str = ""):
+        """_objs plus the list resourceVersion — the watch path needs the
+        rv of the SAME snapshot the table rendered, or events landing
+        between two lists are lost."""
+        objs = self._objs(kind, ns, name, selector, field_selector,
+                          _rv_box=(box := []))
+        return objs, (box[0] if box else 0)
+
     def _objs(self, kind: str, ns: str, name: str = "",
-              selector: str = "", field_selector: str = "") -> List[Any]:
+              selector: str = "", field_selector: str = "",
+              _rv_box=None) -> List[Any]:
         if name:
             if selector or field_selector:
                 # kubectl refuses a resource name combined with selectors
@@ -411,10 +421,12 @@ class Ktctl:
             # only when set — a bare ApiServerLite backend (kubefed's
             # member clusters) has no field_selector parameter
             if field_selector:
-                objs, _ = self.api.list(kind,
-                                        field_selector=field_selector)
+                objs, rv = self.api.list(kind,
+                                         field_selector=field_selector)
             else:
-                objs, _ = self.api.list(kind)
+                objs, rv = self.api.list(kind)
+            if _rv_box is not None:
+                _rv_box.append(rv)
         except (Invalid, HttpError) as e:
             raise SystemExit(f"error: {e}") from None
         if not self._cluster_scoped(kind) and ns != "*":
@@ -435,12 +447,80 @@ class Ktctl:
         ns = flags.get("namespace", "default")
         if "all-namespaces" in flags:
             ns = "*"
-        objs = self._objs(kind, ns, pos[1] if len(pos) > 1 else "",
-                          flags.get("selector", ""),
-                          flags.get("field-selector", ""))
-        self._print(render(kind, objs, flags.get("output", "table"),
+        name = pos[1] if len(pos) > 1 else ""
+        sel = flags.get("selector", "")
+        fsel = flags.get("field-selector", "")
+        output = flags.get("output", "table")
+        objs, list_rv = self._objs_rv(kind, ns, name, sel, fsel)
+        self._print(render(kind, objs, output,
                            plural=self._plural(kind),
                            sort_by=flags.get("sort-by", "")))
+        if "watch" in flags:
+            # kubectl get --watch: stream subsequent changes as rows
+            # (cmd/get.go watch path), scoped by the SAME name/label/field
+            # filters as the table and resumed from the table's own rv so
+            # no intervening event is lost. Bounded by --watch-timeout
+            # (default 2s) — the library/test harness cannot block
+            # forever the way an interactive kubectl does.
+            try:
+                timeout = float(flags.get("watch-timeout") or 2.0)
+            except ValueError:
+                raise SystemExit(
+                    f"error: invalid --watch-timeout "
+                    f"{flags['watch-timeout']!r}") from None
+            self._watch_loop(kind, ns, name, sel, fsel, output,
+                             list_rv, timeout)
+
+    def _event_matches(self, kind: str, obj, ns: str, name: str,
+                       selector: str, field_selector: str) -> bool:
+        if name and getattr(obj, "name", "") != name:
+            return False
+        if ns != "*" and not self._cluster_scoped(kind) \
+                and getattr(obj, "namespace", "") != ns:
+            return False
+        if selector:
+            want = dict(kv.split("=", 1) for kv in selector.split(",")
+                        if "=" in kv)
+            if not all(getattr(obj, "labels", {}).get(k) == v
+                       for k, v in want.items()):
+                return False
+        if field_selector:
+            from kubernetes_tpu.api.fields import (
+                filter_objects,
+                parse_field_selector,
+            )
+            if not filter_objects(kind, [obj],
+                                  parse_field_selector(field_selector)):
+                return False
+        return True
+
+    def _watch_loop(self, kind, ns, name, sel, fsel, output, rv,
+                    timeout) -> None:
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return
+            try:
+                evs = self.api.watch_since((kind,), rv,
+                                           timeout=min(remaining, 0.25))
+            except Exception as e:
+                # HttpError in REST mode, TooOldResourceVersion on log
+                # compaction: the CLI contract is error + exit 1
+                raise SystemExit(
+                    f"error: watch failed: {e} (relist and re-watch)"
+                ) from None
+            for ev in evs:
+                rv = max(rv, ev.rv)
+                if not self._event_matches(kind, ev.obj, ns, name, sel,
+                                           fsel):
+                    continue
+                row = render(kind, [ev.obj], output,
+                             plural=self._plural(kind))
+                if output in ("table", "wide"):
+                    row = row.splitlines()[-1]  # drop the repeated header
+                self._print(f"{ev.type}\t{row}")
 
     def cmd_describe(self, args):
         pos, flags = self._flags(args)

@@ -147,7 +147,18 @@ class RestClient:
         return self._do("PUT", url, {"replicas": replicas})["replicas"]
 
     def watch_since(self, kinds, from_rv: int, timeout=None):
-        res = [KIND_INFO[k][0] for k in kinds if k in KIND_INFO]
+        res = []
+        for k in kinds:
+            if k in KIND_INFO:
+                res.append(KIND_INFO[k][0])
+                continue
+            # CRD-defined kind: resolve through discovery; dropping it
+            # silently would make the server fall back to ALL kinds
+            row = next((r for r in self.discovery()["resources"]
+                        if r["kind"] == k), None)
+            if row is None:
+                raise NotFound(f"unknown kind {k!r}")
+            res.append(row["name"])
         q = "&".join(["resourceVersion=" + str(from_rv)]
                      + [f"resource={r}" for r in res]
                      + ([f"timeout={timeout}"] if timeout else []))

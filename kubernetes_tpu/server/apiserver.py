@@ -121,6 +121,43 @@ class AuditEvent:
     name: str
     code: int
     ts: float = 0.0
+    level: str = "Metadata"
+
+
+@dataclass
+class AuditRule:
+    """One policy rule (apiserver/pkg/apis/audit Policy.Rules): first
+    match wins; empty selector lists match everything."""
+
+    level: str  # "None" | "Metadata" | "Request"
+    users: List[str] = field(default_factory=list)
+    verbs: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+    namespaces: List[str] = field(default_factory=list)
+
+    def matches(self, user: str, verb: str, resource: str,
+                namespace: str) -> bool:
+        return ((not self.users or user in self.users)
+                and (not self.verbs or verb in self.verbs)
+                and (not self.resources or resource in self.resources)
+                and (not self.namespaces or namespace in self.namespaces))
+
+
+@dataclass
+class AuditPolicy:
+    """Policy-driven auditing (apiserver/pkg/audit/policy): the level for
+    a request is the FIRST matching rule's; no match falls through to
+    `default_level`. Level None suppresses the entry entirely."""
+
+    rules: List[AuditRule] = field(default_factory=list)
+    default_level: str = "Metadata"
+
+    def level_for(self, user: str, verb: str, resource: str,
+                  namespace: str) -> str:
+        for rule in self.rules:
+            if rule.matches(user, verb, resource, namespace):
+                return rule.level
+        return self.default_level
 
 
 class ApiServer:
@@ -136,6 +173,7 @@ class ApiServer:
                  auth: bool = False,
                  admission: Optional[AdmissionChain] = None,
                  max_audit: int = 10_000,
+                 audit_policy: Optional[AuditPolicy] = None,
                  now=time.time):
         self.store = store if store is not None else ApiServerLite()
         self.auth_enabled = auth
@@ -146,6 +184,8 @@ class ApiServer:
             [NodeAuthorizer(self.store), RBACAuthorizer(self.store)])
         self.audit_log: List[AuditEvent] = []
         self._max_audit = max_audit
+        self.audit_policy = audit_policy if audit_policy is not None \
+            else AuditPolicy()
         self._now = now
         self._audit_lock = threading.Lock()
         self._inflight = threading.Semaphore(400)  # --max-requests-inflight
@@ -211,10 +251,16 @@ class ApiServer:
     def _audit(self, user: UserInfo, verb: str, kind: str, namespace: str,
                name: str, code: int) -> None:
         resource, _ = KIND_INFO.get(kind, (kind.lower() + "s", False))
+        # policy decides the level per request; None drops the entry
+        # (audit/policy checker.go LevelForRequest)
+        level = self.audit_policy.level_for(user.name, verb, resource,
+                                            namespace)
+        if level == "None":
+            return
         with self._audit_lock:
             self.audit_log.append(AuditEvent(
                 user.name, verb, resource, namespace, name, code,
-                ts=self._now()))
+                ts=self._now(), level=level))
             if len(self.audit_log) > self._max_audit:
                 del self.audit_log[: len(self.audit_log) - self._max_audit]
 

@@ -140,9 +140,18 @@ class RestServer:
                         and parts[2] == "watch":
                     from_rv = int(q.get("resourceVersion", ["0"])[0])
                     timeout = float(q.get("timeout", ["0"])[0])
-                    kinds = tuple(RESOURCE_TO_KIND[r]
-                                  for r in q.get("resource", [])
-                                  if r in RESOURCE_TO_KIND) \
+                    def _watch_kind(res):
+                        if res in RESOURCE_TO_KIND:
+                            return RESOURCE_TO_KIND[res]
+                        for crd in api.store.list(
+                                "CustomResourceDefinition")[0]:
+                            if crd.names.plural == res and crd.established:
+                                return crd.names.kind
+                        return None
+                    kinds = tuple(
+                        k for k in (_watch_kind(r)
+                                    for r in q.get("resource", []))
+                        if k is not None) \
                         or tuple(RESOURCE_TO_KIND.values())
                     evs = api.watch_since(kinds, from_rv, timeout=timeout,
                                           cred=cred)

@@ -458,3 +458,34 @@ def test_ktctl_prints_real_plural_for_custom_kinds():
                    "-o", "name"]) == 0
     assert "tputopologies/ring0" in out.getvalue()
     assert "tputopologys" not in out.getvalue()
+
+
+def test_crd_watch_over_rest():
+    """Finding regression: watching a CRD kind over REST resolves through
+    discovery (not silently widened to all built-in kinds)."""
+    import threading
+    import time as _time
+
+    from kubernetes_tpu.cli.rest_client import RestClient
+    from kubernetes_tpu.server.rest_http import RestServer
+
+    api = make_server()
+    srv = RestServer(api)
+    srv.start()
+    try:
+        client = RestClient(f"http://127.0.0.1:{srv.port}")
+        client.create("CustomResourceDefinition", make_crd())
+        rv = client.list("TpuTopology")[1]
+        api.create("TpuTopology", CustomResource(
+            "TpuTopology", "ring1", namespace="default",
+            spec={"chips": 16}))
+        # a built-in write must NOT leak into the CRD-scoped watch
+        from kubernetes_tpu.api.types import make_node
+        api.store.create("Node", make_node("noise", cpu=1, memory=1 << 20))
+        evs = client.watch_since(("TpuTopology",), rv, timeout=1)
+        assert [e.obj.name for e in evs] == ["ring1"]
+        assert all(e.kind == "TpuTopology" for e in evs)
+        with pytest.raises(NotFound):
+            client.watch_since(("NoSuchKind",), rv, timeout=0.1)
+    finally:
+        srv.stop()

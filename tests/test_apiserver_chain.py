@@ -470,3 +470,35 @@ def test_csr_identity_immutable_after_create():
     flip.approved = True
     with pytest.raises(Forbidden):  # no …/approval permission
         api.update("CertificateSigningRequest", flip, cred=cred)
+
+
+def test_audit_policy_levels_and_suppression():
+    """Policy-driven auditing (apiserver/pkg/audit/policy): first match
+    wins, level None suppresses, no-match falls to the default."""
+    from kubernetes_tpu.server.apiserver import AuditPolicy, AuditRule
+
+    policy = AuditPolicy(rules=[
+        # the classic noise rule: don't log the healthcheck user's reads
+        AuditRule(level="None", users=["system:kube-proxy"],
+                  verbs=["list", "get"]),
+        AuditRule(level="Request", resources=["secrets"]),
+        AuditRule(level="Metadata", verbs=["list"]),
+    ], default_level="Metadata")
+    api = ApiServer(audit_policy=policy)
+    api.store.create("Namespace", Namespace("default"))
+    from kubernetes_tpu.api.cluster import Secret
+
+    api.create("Secret", Secret("s1", "default", data={}))
+    api.list("Pod")
+    entries = {(e.resource, e.verb): e.level for e in api.audit_log}
+    assert entries[("secrets", "create")] == "Request"
+    assert entries[("pods", "list")] == "Metadata"
+    # suppressed: the proxy user's list never lands in the log
+    before = len(api.audit_log)
+    from kubernetes_tpu.api.rbac import UserInfo as _UI
+
+    api._audit(_UI("system:kube-proxy"), "list", "Endpoints", "", "", 200)
+    assert len(api.audit_log) == before
+    # same user's WRITE is not matched by the None rule -> default level
+    api._audit(_UI("system:kube-proxy"), "update", "Endpoints", "", "", 200)
+    assert api.audit_log[-1].level == "Metadata"

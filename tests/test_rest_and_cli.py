@@ -273,3 +273,30 @@ def test_ktctl_expose_and_set_image():
     assert kt.run(["set", "image", "rs", "web", "c0=nginx:1.13"]) == 0
     rs = api.get("ReplicaSet", "default", "web")
     assert rs.template.containers[0].image == "nginx:1.13"
+
+
+def test_ktctl_get_watch_streams_changes():
+    """kubectl get --watch: after the initial table, subsequent writes
+    stream as ADDED/MODIFIED/DELETED rows until --watch-timeout."""
+    import threading
+    import time as _time
+
+    api, kt, out = make_cli()
+    api.store.create("Pod", make_pod("p0", cpu=10, memory=1 << 20))
+
+    def mutate():
+        _time.sleep(0.15)
+        api.store.create("Pod", make_pod("p1", cpu=10, memory=1 << 20))
+        _time.sleep(0.1)
+        api.store.delete("Pod", "default", "p0")
+
+    t = threading.Thread(target=mutate)
+    t.start()
+    rc = kt.run(["get", "pods", "--watch", "--watch-timeout", "1"])
+    t.join()
+    assert rc == 0
+    text = out.getvalue()
+    assert "ADDED" in text and "p1" in text
+    assert "DELETED" in text
+    # bad timeout: clean error
+    assert kt.run(["get", "pods", "--watch", "--watch-timeout", "x"]) == 1
