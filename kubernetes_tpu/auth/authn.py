@@ -38,6 +38,11 @@ class Credential:
 
     token: str = ""
     cert: Optional[dict] = None  # {"cn":..., "orgs": [...], "sig": ...}
+    # Impersonate-User / Impersonate-Group headers (apiserver/pkg/
+    # endpoints/filters/impersonation.go): acted on AFTER authentication,
+    # gated by the "impersonate" verb on users/groups
+    impersonate_user: str = ""
+    impersonate_groups: tuple = ()
 
 
 class TokenAuthenticator:

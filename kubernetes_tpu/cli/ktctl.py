@@ -303,12 +303,53 @@ class Ktctl:
         if fn is None:
             self._print(f"error: unknown command {cmd!r}")
             return 1
+        # kubectl --as / --as-group: rebind this invocation's credential
+        # with impersonation headers (the server's impersonation filter
+        # authorizes the REAL user for the impersonate verb)
+        restore = None
         try:
+            if cmd != "auth" and ("--as" in rest or "--as-group" in rest):
+                # (`auth can-i --as` consumes the flag itself — it runs a
+                # SubjectAccessReview about the target, not as them)
+                if not isinstance(self.api, _BoundApi):
+                    # silently running at the caller's full privilege
+                    # would make "can X do this?" probes lie
+                    raise SystemExit(
+                        "error: --as requires an authenticated "
+                        "in-process backend (credential-bound)")
+                import dataclasses as _dc
+                rest = list(rest)
+                as_user, as_groups = "", []
+                while "--as" in rest:
+                    i = rest.index("--as")
+                    if i + 1 >= len(rest):
+                        raise SystemExit(
+                            "error: flag --as needs an argument")
+                    as_user = rest[i + 1]
+                    del rest[i:i + 2]
+                while "--as-group" in rest:
+                    i = rest.index("--as-group")
+                    if i + 1 >= len(rest):
+                        raise SystemExit(
+                            "error: flag --as-group needs an argument")
+                    as_groups.append(rest[i + 1])
+                    del rest[i:i + 2]
+                if as_groups and not as_user:
+                    raise SystemExit(
+                        "error: --as-group requires --as (kubectl "
+                        "rejects group-only impersonation)")
+                restore = self.api
+                self.api = _BoundApi(restore._api, _dc.replace(
+                    restore._cred, impersonate_user=as_user,
+                    impersonate_groups=tuple(as_groups)))
             fn(rest)
             return 0
         except SystemExit as e:
             self._print(str(e))
             return 1
+        finally:
+            if restore is not None:
+                self.api = restore
 
     # flags that never take a value (boolean presence flags)
     BOOL_FLAGS = frozenset({"all-namespaces", "watch", "wide", "force",
@@ -415,22 +456,50 @@ class Ktctl:
                                  name)]
         from kubernetes_tpu.cli.rest_client import HttpError
         from kubernetes_tpu.server.apiserver import Invalid
+        # field AND namespace selection run SERVER-side (the reference's
+        # namespaced list endpoints scope the RBAC check too — a user
+        # with only a namespaced Role must be able to `get pods -n ns`);
+        # kwargs are passed only when set so a bare ApiServerLite backend
+        # (kubefed's member clusters) keeps working
+        kwargs = {}
+        if field_selector:
+            kwargs["field_selector"] = field_selector
+        namespaced = not self._cluster_scoped(kind) and ns != "*"
+        if namespaced:
+            kwargs["namespace"] = ns
+        if kwargs:
+            # signature check, NOT try/except TypeError: a TypeError
+            # raised inside a supporting backend must surface, not
+            # silently retry with the user's filters stripped
+            import inspect
+            try:
+                params = inspect.signature(self.api.list).parameters
+                supported = all(k in params for k in kwargs)
+            except (TypeError, ValueError):
+                supported = False
+            if not supported:
+                kwargs = {}
         try:
-            # field selection runs SERVER-side (the reference pushes
-            # fieldSelector into the list request); the kwarg is passed
-            # only when set — a bare ApiServerLite backend (kubefed's
-            # member clusters) has no field_selector parameter
-            if field_selector:
-                objs, rv = self.api.list(kind,
-                                         field_selector=field_selector)
-            else:
-                objs, rv = self.api.list(kind)
+            objs, rv = self.api.list(kind, **kwargs)
             if _rv_box is not None:
                 _rv_box.append(rv)
         except (Invalid, HttpError) as e:
             raise SystemExit(f"error: {e}") from None
-        if not self._cluster_scoped(kind) and ns != "*":
+        if namespaced and "namespace" not in kwargs:
             objs = [o for o in objs if getattr(o, "namespace", "") == ns]
+        if field_selector and "field_selector" not in kwargs:
+            # fallback backend: apply the fields axis client-side so the
+            # output is FILTERED either way, never silently unfiltered
+            from kubernetes_tpu.api.fields import (
+                FieldSelectorError,
+                filter_objects,
+                parse_field_selector,
+            )
+            try:
+                objs = filter_objects(kind, objs,
+                                      parse_field_selector(field_selector))
+            except FieldSelectorError as e:
+                raise SystemExit(f"error: {e}") from None
         if selector:
             want = dict(kv.split("=", 1) for kv in selector.split(",")
                         if "=" in kv)

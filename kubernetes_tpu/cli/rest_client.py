@@ -86,8 +86,9 @@ class RestClient:
         return wire.decode_any(
             self._do("GET", self._url(kind, namespace, name)), kind=kind)
 
-    def list(self, kind: str, field_selector: str = "") -> Tuple[list, int]:
-        url = self._url(kind, "")
+    def list(self, kind: str, field_selector: str = "",
+             namespace: str = "") -> Tuple[list, int]:
+        url = self._url(kind, namespace)
         if field_selector:
             from urllib.parse import quote
             url += "?fieldSelector=" + quote(field_selector)

@@ -211,7 +211,36 @@ class ApiServer:
             return UserInfo("system:admin", groups=["system:masters"])
         if cred is None or self.authenticator is None:
             raise Unauthenticated("no credentials provided")
-        return self.authenticator.authenticate(cred)
+        user = self.authenticator.authenticate(cred)
+        return self._impersonate(user, cred)
+
+    def _impersonate(self, user: UserInfo,
+                     cred: Optional[Credential]) -> UserInfo:
+        """The impersonation filter (endpoints/filters/impersonation.go):
+        the AUTHENTICATED user needs the "impersonate" verb on users (and
+        on groups for each requested group); the rest of the chain then
+        sees the impersonated identity, with the real one recorded for
+        audit attribution."""
+        if cred is None or not cred.impersonate_user:
+            return user
+        checks = [("users", cred.impersonate_user)] + \
+            [("groups", g) for g in cred.impersonate_groups]
+        for resource, name in checks:
+            attrs = Attributes(user=user, verb="impersonate",
+                               resource=resource, namespace="", name=name)
+            if self.authorizer.authorize(attrs) != ALLOW:
+                raise Forbidden(
+                    f'User "{user.name}" cannot impersonate '
+                    f'{resource[:-1]} "{name}"')
+        groups = list(cred.impersonate_groups)
+        if "system:authenticated" not in groups:
+            # every non-anonymous identity carries system:authenticated
+            # (UnionAuthenticator appends it to real logins; the
+            # impersonation filter must preserve the invariant or --as
+            # stops previewing the impersonated user's real permissions)
+            groups.append("system:authenticated")
+        return UserInfo(cred.impersonate_user, groups=groups,
+                        extra={"impersonated-by": user.name})
 
     def _serving_info(self, kind: str, for_write: bool = False):
         """Dynamic discovery: (plural, cluster_scoped, crd-or-None) for a
