@@ -308,7 +308,10 @@ class Ktctl:
         # authorizes the REAL user for the impersonate verb)
         restore = None
         try:
-            if cmd != "auth" and ("--as" in rest or "--as-group" in rest):
+            has_as = any(a == "--as" or a.startswith("--as=")
+                         or a == "--as-group"
+                         or a.startswith("--as-group=") for a in rest)
+            if cmd != "auth" and has_as:
                 # (`auth can-i --as` consumes the flag itself — it runs a
                 # SubjectAccessReview about the target, not as them)
                 if not isinstance(self.api, _BoundApi):
@@ -318,7 +321,17 @@ class Ktctl:
                         "error: --as requires an authenticated "
                         "in-process backend (credential-bound)")
                 import dataclasses as _dc
-                rest = list(rest)
+                # normalize the equals form kubectl users routinely type
+                # (--as=user) so it cannot slip past as an ordinary flag
+                norm = []
+                for a in rest:
+                    if a.startswith("--as="):
+                        norm += ["--as", a.split("=", 1)[1]]
+                    elif a.startswith("--as-group="):
+                        norm += ["--as-group", a.split("=", 1)[1]]
+                    else:
+                        norm.append(a)
+                rest = norm
                 as_user, as_groups = "", []
                 while "--as" in rest:
                     i = rest.index("--as")
@@ -1091,8 +1104,10 @@ class Ktctl:
             raise SystemExit(f"error: {e}") from None
 
     def cmd_version(self, args):
-        self._print("Client Version: v1.7.0-tpu.0")
-        self._print("Server Version: v1.7.0-tpu.0")
+        from kubernetes_tpu.server.rest_http import VERSION
+        v = VERSION["gitVersion"]
+        self._print(f"Client Version: {v}")
+        self._print(f"Server Version: {v}")
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

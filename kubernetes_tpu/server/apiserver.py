@@ -207,12 +207,14 @@ class ApiServer:
     # ------------------------------------------------------------- the chain
 
     def _authn(self, cred: Optional[Credential]) -> UserInfo:
+        return self._impersonate(self._authn_base(cred), cred)
+
+    def _authn_base(self, cred: Optional[Credential]) -> UserInfo:
         if not self.auth_enabled:
             return UserInfo("system:admin", groups=["system:masters"])
         if cred is None or self.authenticator is None:
             raise Unauthenticated("no credentials provided")
-        user = self.authenticator.authenticate(cred)
-        return self._impersonate(user, cred)
+        return self.authenticator.authenticate(cred)
 
     def _impersonate(self, user: UserInfo,
                      cred: Optional[Credential]) -> UserInfo:
@@ -296,9 +298,13 @@ class ApiServer:
     def _run(self, cred, verb, kind, namespace, name, fn, subresource=""):
         """panic-recovery + authn + authz + audit around fn()."""
         with self._inflight:
-            user = self._authn(cred)
+            user = self._authn_base(cred)
             code = 200
             try:
+                # impersonation INSIDE the audited span: a denied
+                # escalation attempt must land in the audit log,
+                # attributed to the REAL user with code 403
+                user = self._impersonate(user, cred)
                 self._authz(user, verb, kind, namespace, name, subresource)
                 return fn(user)
             except Unauthenticated:

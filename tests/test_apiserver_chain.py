@@ -591,3 +591,26 @@ def test_ktctl_as_flag_impersonates():
     assert "p" in out.getvalue()
     with pytest.raises(Forbidden):
         kt.run(["get", "pods"])
+
+
+def test_denied_impersonation_is_audited_and_equals_form_caught():
+    """Review regressions: a 403 impersonation attempt lands in the audit
+    log attributed to the REAL user; the --as=value equals form cannot
+    slip past as an ordinary flag."""
+    import io
+
+    from kubernetes_tpu.cli.ktctl import Ktctl
+
+    api = make_server(auth=True, tokens={
+        "admin": UserInfo("root", groups=["system:masters"]),
+        "dev": UserInfo("dev-user")})
+    with pytest.raises(Forbidden):
+        api.list("Pod", cred=Credential(token="dev",
+                                        impersonate_user="root"))
+    denied = [e for e in api.audit_log if e.code == 403]
+    assert denied and denied[-1].user == "dev-user"
+    # equals form: same Forbidden as the space form, never full privilege
+    out = io.StringIO()
+    kt = Ktctl(api, out=out, cred=Credential(token="dev"))
+    with pytest.raises(Forbidden):
+        kt.run(["get", "pods", "--as=root"])

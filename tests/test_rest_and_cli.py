@@ -300,3 +300,21 @@ def test_ktctl_get_watch_streams_changes():
     assert "DELETED" in text
     # bad timeout: clean error
     assert kt.run(["get", "pods", "--watch", "--watch-timeout", "x"]) == 1
+
+
+def test_hyperkube_dispatcher(tmp_path, capsys):
+    """cmd/hyperkube analog: one entrypoint, component picked by the
+    first argument."""
+    from kubernetes_tpu.__main__ import main
+
+    assert main(["version"]) == 0
+    assert "v1.7.0-tpu" in capsys.readouterr().out
+    assert main([]) == 0  # usage
+    assert main(["no-such-thing"]) == 1
+    assert main(["apiserver", "--nodes", "3", "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "listening on http://127.0.0.1:" in out
+    assert main(["ktadm", "preflight", "--workdir",
+                 str(tmp_path / "c")]) == 0
+    assert main(["ktadm", "init", "--workdir", str(tmp_path / "c")]) == 0
+    assert main(["ktadm", "reset", "--workdir", str(tmp_path / "c")]) == 0
