@@ -1105,9 +1105,19 @@ class Ktctl:
 
     def cmd_version(self, args):
         from kubernetes_tpu.server.rest_http import VERSION
-        v = VERSION["gitVersion"]
-        self._print(f"Client Version: {v}")
-        self._print(f"Server Version: {v}")
+        self._print(f"Client Version: {VERSION['gitVersion']}")
+        # ask the CONNECTED backend when it can answer (kubectl prints
+        # both precisely to diagnose client/server skew)
+        server_v = VERSION["gitVersion"]
+        version_fn = getattr(self.api, "version", None)
+        if callable(version_fn):
+            try:
+                server_v = version_fn().get("gitVersion", server_v)
+            except Exception as e:
+                raise SystemExit(
+                    f"error: could not fetch server version: {e}"
+                ) from None
+        self._print(f"Server Version: {server_v}")
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

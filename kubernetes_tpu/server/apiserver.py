@@ -476,11 +476,23 @@ class ApiServer:
 
     def watch_since(self, kinds, from_rv, timeout=None,
                     cred: Optional[Credential] = None):
-        user = self._authn(cred)
+        user = self._audited_authn(cred, "watch",
+                                   kinds[0] if kinds else "")
         if self.auth_enabled:
             for k in kinds:
                 self._authz(user, "watch", k, "", "")
         return self.store.watch_since(kinds, from_rv, timeout=timeout)
+
+    def _audited_authn(self, cred, verb: str, kind: str) -> UserInfo:
+        """authn + impersonation for the paths that bypass _run: a DENIED
+        impersonation must land in the audit log attributed to the real
+        user (code 403) on every entry point, not just the CRUD verbs."""
+        user = self._authn_base(cred)
+        try:
+            return self._impersonate(user, cred)
+        except Forbidden:
+            self._audit(user, verb, kind, "", "", 403)
+            raise
 
     # ----------------------------------------------------------- subresources
 
@@ -498,7 +510,7 @@ class ApiServer:
         a single aggregated audit entry for the batch."""
         if not bindings:
             return []
-        user = self._authn(cred)
+        user = self._audited_authn(cred, "create", "Pod")
         if self.auth_enabled:
             try:
                 for ns in {b.pod_namespace for b in bindings}:

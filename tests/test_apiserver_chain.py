@@ -614,3 +614,25 @@ def test_denied_impersonation_is_audited_and_equals_form_caught():
     kt = Ktctl(api, out=out, cred=Credential(token="dev"))
     with pytest.raises(Forbidden):
         kt.run(["get", "pods", "--as=root"])
+
+
+def test_denied_impersonation_audited_on_watch_and_bind_many():
+    """The audit invariant holds on the non-_run entry points too."""
+    from kubernetes_tpu.api.types import Binding
+
+    api = make_server(auth=True, tokens={
+        "dev": UserInfo("dev-user")})
+    for call in (
+        lambda: api.watch_since(("Pod",), 0, timeout=0.01,
+                                cred=Credential(token="dev",
+                                                impersonate_user="root")),
+        lambda: api.bind_many(
+            [Binding("p", "default", "default/p", "n1")],
+            cred=Credential(token="dev", impersonate_user="root")),
+    ):
+        before = len(api.audit_log)
+        with pytest.raises(Forbidden):
+            call()
+        assert len(api.audit_log) == before + 1
+        assert api.audit_log[-1].code == 403
+        assert api.audit_log[-1].user == "dev-user"

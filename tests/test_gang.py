@@ -201,3 +201,53 @@ def test_gang_completing_in_timeout_round_schedules():
     assert len(bound) == 2, [p.name for p in bound]
     assert not any(e.reason == "FailedScheduling" and "below quorum"
                    in e.message for e in sched.events)
+
+
+def test_gang_fuzz_all_or_nothing_invariant():
+    """Randomized gang mixes; the hard invariant per trial: every gang is
+    either FULLY placed (>= quorum members bound) or left with ZERO
+    residue (no member bound, no assumed capacity leaked) — the partial-
+    placement failure mode gang scheduling exists to prevent."""
+    import numpy as np
+
+    rng = np.random.default_rng(1234)
+    for trial in range(8):
+        n_nodes = int(rng.integers(2, 6))
+        node_cpu = 1000
+        api = ApiServerLite()
+        for i in range(n_nodes):
+            api.create("Node", make_node(f"n{i}", cpu=node_cpu,
+                                         memory=8 * Gi))
+        sched = Scheduler(api)
+        sched.start()
+        gangs = {}
+        for g in range(int(rng.integers(1, 4))):
+            size = int(rng.integers(1, 5))
+            quorum = int(rng.integers(1, size + 1))
+            cpu = int(rng.integers(100, 700))
+            gangs[f"g{g}"] = (size, quorum, cpu)
+            for m in range(size):
+                api.create("Pod", _gang_pod(f"g{g}-{m}", f"g{g}", quorum,
+                                            cpu=cpu))
+        # a few plain pods competing for the same capacity
+        for p in range(int(rng.integers(0, 4))):
+            api.create("Pod", make_pod(f"plain-{p}",
+                                       cpu=int(rng.integers(50, 400)),
+                                       memory=64 * Mi))
+        sched.run_until_drained(max_rounds=50)
+        pods = api.list("Pod")[0]
+        for gname, (size, quorum, cpu) in gangs.items():
+            bound = [p for p in pods
+                     if p.name.startswith(gname + "-") and p.node_name]
+            assert len(bound) == 0 or len(bound) >= quorum, \
+                f"trial {trial}: gang {gname} partially placed " \
+                f"({len(bound)}/{size}, quorum {quorum})"
+        # no node over capacity (assumed-capacity leak check)
+        per_node = {}
+        for p in pods:
+            if p.node_name:
+                per_node[p.node_name] = per_node.get(p.node_name, 0) \
+                    + p.resource_request().milli_cpu
+        for node_name, used in per_node.items():
+            assert used <= node_cpu, \
+                f"trial {trial}: {node_name} over capacity ({used})"
