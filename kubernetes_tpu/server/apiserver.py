@@ -480,7 +480,12 @@ class ApiServer:
                                    kinds[0] if kinds else "")
         if self.auth_enabled:
             for k in kinds:
-                self._authz(user, "watch", k, "", "")
+                try:
+                    self._authz(user, "watch", k, "", "")
+                except Forbidden:
+                    # a denied watch is audited like every other denial
+                    self._audit(user, "watch", k, "", "", 403)
+                    raise
         return self.store.watch_since(kinds, from_rv, timeout=timeout)
 
     def _audited_authn(self, cred, verb: str, kind: str) -> UserInfo:

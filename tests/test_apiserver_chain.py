@@ -636,3 +636,14 @@ def test_denied_impersonation_audited_on_watch_and_bind_many():
         assert len(api.audit_log) == before + 1
         assert api.audit_log[-1].code == 403
         assert api.audit_log[-1].user == "dev-user"
+
+
+def test_denied_watch_is_audited():
+    api = make_server(auth=True, tokens={"dev": UserInfo("dev-user")})
+    before = len(api.audit_log)
+    with pytest.raises(Forbidden):
+        api.watch_since(("Node",), 0, timeout=0.01,
+                        cred=Credential(token="dev"))
+    assert len(api.audit_log) == before + 1
+    assert api.audit_log[-1].code == 403
+    assert api.audit_log[-1].verb == "watch"

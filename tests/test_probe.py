@@ -1,0 +1,93 @@
+"""pkg/probe executors against the framework's OWN HTTP surfaces.
+
+The probers are exercised the way the reference's are: HTTP probes of
+live endpoints (kubelet API /healthz, REST apiserver /healthz), TCP
+probes of their listeners, failure on dead ports/4xx/5xx, and the
+exec prober's Success/Failure/Unknown mapping (exec.go maps
+infrastructure errors to Unknown, not Failure).
+"""
+
+import socket
+
+from kubernetes_tpu.api.types import make_node
+from kubernetes_tpu.api.workloads import Namespace
+from kubernetes_tpu.nodes.kubelet import HollowKubelet
+from kubernetes_tpu.nodes.kubelet_server import KubeletServer
+from kubernetes_tpu.server.apiserver import ApiServer
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.server.rest_http import RestServer
+from kubernetes_tpu.utils.probe import (
+    FAILURE,
+    SUCCESS,
+    UNKNOWN,
+    probe_exec,
+    probe_http,
+    probe_tcp,
+)
+
+
+def test_http_probe_against_live_surfaces():
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    rest = RestServer(api)
+    rest.start()
+    lite = ApiServerLite()
+    node = make_node("n1", cpu=1000, memory=1 << 31)
+    lite.create("Node", node)
+    ks = KubeletServer(HollowKubelet(lite, node))
+    ks.start()
+    try:
+        for port, path in ((rest.port, "/healthz"), (ks.port, "/healthz")):
+            result, msg = probe_http(f"http://127.0.0.1:{port}{path}")
+            assert result == SUCCESS, msg
+        # 404 is a FAILED probe, not an error
+        result, msg = probe_http(f"http://127.0.0.1:{ks.port}/nope")
+        assert result == FAILURE and "404" in msg
+        # TCP connect succeeds on a live listener
+        assert probe_tcp("127.0.0.1", rest.port)[0] == SUCCESS
+    finally:
+        rest.stop()
+        ks.stop()
+
+
+def test_probe_failures_on_dead_endpoints():
+    # grab a port nobody is listening on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    result, msg = probe_http(f"http://127.0.0.1:{port}/healthz",
+                             timeout=0.3)
+    assert result == FAILURE  # refused connection = failed probe
+    assert probe_tcp("127.0.0.1", port, timeout=0.3)[0] == FAILURE
+
+
+def test_exec_probe_result_mapping():
+    assert probe_exec(lambda: (0, "ok")) == (SUCCESS, "ok")
+    assert probe_exec(lambda: (2, "bad")) == (FAILURE, "bad")
+    # infrastructure error -> Unknown, like exec.go
+    def boom():
+        raise RuntimeError("runtime unavailable")
+    result, msg = probe_exec(boom)
+    assert result == UNKNOWN and "unavailable" in msg
+
+
+def test_probe_daemon_healthz_lifecycle():
+    """The prober against the scheduler daemon's healthz — alive while
+    running, FAILED after stop (the liveness signal an operator's probe
+    would consume, server.go's healthz story)."""
+    from kubernetes_tpu.api.types import make_pod
+    from kubernetes_tpu.server.daemon import SchedulerDaemon, \
+        SchedulerOptions
+
+    api = ApiServerLite()
+    api.create("Node", make_node("n1", cpu=4000, memory=1 << 33))
+    api.create("Pod", make_pod("p", cpu=100))
+    d = SchedulerDaemon(api, "probe-d",
+                        SchedulerOptions(leader_elect=False))
+    d.step()
+    url = f"http://127.0.0.1:{d.healthz_port}/healthz"
+    result, msg = probe_http(url)
+    assert result == SUCCESS, msg
+    d.stop()
+    assert probe_http(url, timeout=0.3)[0] == FAILURE
