@@ -100,6 +100,23 @@ class PodDisruptionBudget:
 
 
 @dataclass
+class StorageClass:
+    """storage.k8s.io/v1 StorageClass (staging/src/k8s.io/api/storage/v1/
+    types.go): the provisioner + parameters the PV dynamic-provisioning
+    story keys off; cluster-scoped."""
+
+    name: str
+    provisioner: str = "kubernetes.io/no-provisioner"
+    parameters: Dict[str, str] = field(default_factory=dict)
+    reclaim_policy: str = "Delete"  # Delete | Retain
+    # the is-default-class marker (the beta annotation in v1.7) the
+    # StorageClassDefault admission plugin keys on
+    is_default: bool = False
+    namespace: str = ""  # cluster-scoped; kept for store uniformity
+    resource_version: int = 0
+
+
+@dataclass
 class Eviction:
     """The pods/eviction subresource body."""
 

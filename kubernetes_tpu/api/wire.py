@@ -49,6 +49,7 @@ KIND_REGISTRY: Dict[str, Type] = {
     "ConfigMap": cluster_mod.ConfigMap,
     "PodDisruptionBudget": cluster_mod.PodDisruptionBudget,
     "CertificateSigningRequest": cluster_mod.CertificateSigningRequest,
+    "StorageClass": cluster_mod.StorageClass,
     "HorizontalPodAutoscaler": wl.HorizontalPodAutoscaler,
     "Role": rbac_mod.Role,
     "ClusterRole": rbac_mod.ClusterRole,

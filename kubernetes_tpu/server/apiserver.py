@@ -486,6 +486,10 @@ class ApiServer:
                     # a denied watch is audited like every other denial
                     self._audit(user, "watch", k, "", "", 403)
                     raise
+        # allowed watches audit too: data exposure must be as visible in
+        # the trail as the denials (every other entry point logs its 200)
+        for k in kinds:
+            self._audit(user, "watch", k, "", "", 200)
         return self.store.watch_since(kinds, from_rv, timeout=timeout)
 
     def _audited_authn(self, cred, verb: str, kind: str) -> UserInfo:

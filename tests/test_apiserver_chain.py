@@ -647,3 +647,18 @@ def test_denied_watch_is_audited():
     assert len(api.audit_log) == before + 1
     assert api.audit_log[-1].code == 403
     assert api.audit_log[-1].verb == "watch"
+
+
+def test_allowed_watch_and_default_storageclass_field():
+    api = make_server(auth=True, tokens={
+        "admin": UserInfo("root", groups=["system:masters"])})
+    api.watch_since(("Pod",), 0, timeout=0.01,
+                    cred=Credential(token="admin"))
+    assert any(e.verb == "watch" and e.code == 200 for e in api.audit_log)
+    # StorageClass carries the is-default marker the admission plugin reads
+    from kubernetes_tpu.api.cluster import StorageClass
+
+    sc = StorageClass("fast", provisioner="gce-pd", is_default=True)
+    api.create("StorageClass", sc, cred=Credential(token="admin"))
+    got = api.get("StorageClass", "", "fast", cred=Credential(token="admin"))
+    assert got.is_default is True
