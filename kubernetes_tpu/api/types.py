@@ -335,6 +335,10 @@ class PersistentVolumeClaim:
     volume_name: str = ""  # bound PV name; empty = unbound
     capacity: int = 0  # requested bytes (spec.resources.requests.storage)
     access_modes: List[str] = field(default_factory=list)
+    # class selection rides the v1.7 beta annotation
+    # (volume.beta.kubernetes.io/storage-class), set by the user or the
+    # StorageClassDefault admission plugin
+    annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
 
 

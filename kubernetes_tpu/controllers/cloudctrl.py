@@ -113,9 +113,26 @@ class RouteController(Controller):
                 self.cloud.delete_route(r.name)
 
 
+CLASS_ANNOTATION = "volume.beta.kubernetes.io/storage-class"
+PROVISIONED_BY_ANNOTATION = "pv.kubernetes.io/provisioned-by"
+CLAIM_ANNOTATION = "pv.kubernetes.io/claim"
+RECLAIM_ANNOTATION = "pv.kubernetes.io/reclaim-policy"
+
+# provisioner name -> the volume-source kind the provisioned PV carries
+_PROVISIONER_KINDS = {
+    "kubernetes.io/gce-pd": VolumeKind.GCE_PD,
+    "kubernetes.io/aws-ebs": VolumeKind.AWS_EBS,
+    "kubernetes.io/azure-disk": VolumeKind.AZURE_DISK,
+}
+
+
 class PersistentVolumeBinder(Controller):
     """Bind unbound PVCs to available PVs: smallest PV whose capacity covers
-    the claim (pv_controller.go findBestMatchForClaim ordering)."""
+    the claim (pv_controller.go findBestMatchForClaim ordering). Claims
+    carrying a storage-class annotation bind only same-class PVs, and when
+    none exists the class's provisioner dynamically creates one
+    (pv_controller.go provisionClaim); on claim deletion a provisioned PV
+    with reclaim policy Delete is removed (reclaimVolume)."""
 
     name = "persistentvolume-binder"
 
@@ -124,7 +141,9 @@ class PersistentVolumeBinder(Controller):
         super().__init__(api, record_events=record_events)
         factory.informer("PersistentVolumeClaim").add_event_handler(
             on_add=lambda o: self.enqueue(o.namespace + "/" + o.name),
-            on_update=lambda o, n: self.enqueue(n.namespace + "/" + n.name))
+            on_update=lambda o, n: self.enqueue(n.namespace + "/" + n.name),
+            on_delete=lambda o: self.enqueue(
+                "reclaim:" + o.namespace + "/" + o.name))
         factory.informer("PersistentVolume").add_event_handler(
             on_add=lambda o: self._requeue_pending(),
             on_update=lambda o, n: self._requeue_pending())
@@ -135,6 +154,8 @@ class PersistentVolumeBinder(Controller):
                 self.enqueue(pvc.namespace + "/" + pvc.name)
 
     def sync(self, key: str) -> None:
+        if key.startswith("reclaim:"):
+            return self._reclaim(key[len("reclaim:"):])
         namespace, name = key.split("/", 1)
         try:
             pvc = self.api.get("PersistentVolumeClaim", namespace, name)
@@ -147,9 +168,15 @@ class PersistentVolumeBinder(Controller):
                            if c.volume_name}
         request = pvc.capacity
         want_modes = set(pvc.access_modes)
+        want_class = getattr(pvc, "annotations", {}).get(
+            CLASS_ANNOTATION, "")
         candidates = []
         for pv in self.api.list("PersistentVolume")[0]:
             if pv.name in bound:
+                continue
+            # class match: a classed claim binds only same-class PVs and
+            # vice versa (pv_controller findMatchingVolume class check)
+            if pv.annotations.get(CLASS_ANNOTATION, "") != want_class:
                 continue
             # access modes: the PV must offer every mode the claim asks for
             # (pv_controller checkAccessModes)
@@ -158,6 +185,8 @@ class PersistentVolumeBinder(Controller):
             if pv.capacity >= request:
                 candidates.append((pv.capacity, pv.name))
         if not candidates:
+            if want_class:
+                self._provision(pvc, want_class)
             return
         candidates.sort()
         pvc.volume_name = candidates[0][1]
@@ -165,6 +194,80 @@ class PersistentVolumeBinder(Controller):
                         expect_rv=pvc.resource_version)
         self.event("PersistentVolumeClaim", key, "Normal", "Bound",
                    f"bound to {pvc.volume_name}")
+
+    def _provision(self, pvc, class_name: str) -> None:
+        """provisionClaim: the class's provisioner mints a PV sized to the
+        request; it binds on the requeue its ADDED event triggers."""
+        from kubernetes_tpu.api.types import PersistentVolume, Volume
+        try:
+            sc = self.api.get("StorageClass", "", class_name)
+        except NotFound:
+            self.event("PersistentVolumeClaim",
+                       pvc.namespace + "/" + pvc.name, "Warning",
+                       "ProvisioningFailed",
+                       f'storageclass "{class_name}" not found')
+            return
+        kind = _PROVISIONER_KINDS.get(sc.provisioner)
+        if kind is None:  # no-provisioner classes wait for manual PVs
+            return
+        import zlib
+        claim_key = pvc.namespace + "/" + pvc.name
+        # hashed name: "pvc-a-b"+"c" and "pvc-a"+"b-c" must not collide
+        # (upstream avoids this with the claim UID)
+        pv_name = (f"pvc-{zlib.adler32(claim_key.encode()) & 0xffffffff:08x}"
+                   f"-{pvc.name[:40]}")
+        try:
+            existing = self.api.get("PersistentVolume", "", pv_name)
+            if existing.annotations.get(CLAIM_ANNOTATION) == claim_key \
+                    and existing.capacity >= pvc.capacity:
+                return  # already provisioned; binding follows
+            bound = {c.volume_name for c in self.api.list(
+                "PersistentVolumeClaim")[0] if c.volume_name}
+            if pv_name in bound:
+                self.event("PersistentVolumeClaim", claim_key, "Warning",
+                           "ProvisioningFailed",
+                           f"volume {pv_name} exists and is bound "
+                           f"elsewhere")
+                return
+            # stale (e.g. the claim was recreated larger): replace it
+            self.api.delete("PersistentVolume", "", pv_name)
+        except NotFound:
+            pass
+        self.api.create("PersistentVolume", PersistentVolume(
+            pv_name, capacity=pvc.capacity,
+            access_modes=list(pvc.access_modes),
+            source=Volume(name=pv_name, kind=kind, volume_id=pv_name),
+            annotations={
+                CLASS_ANNOTATION: class_name,
+                PROVISIONED_BY_ANNOTATION: sc.provisioner,
+                CLAIM_ANNOTATION: pvc.namespace + "/" + pvc.name,
+                RECLAIM_ANNOTATION: sc.reclaim_policy,
+            }))
+        self.event("PersistentVolumeClaim",
+                   pvc.namespace + "/" + pvc.name, "Normal",
+                   "ProvisioningSucceeded",
+                   f"provisioned volume {pv_name}")
+
+    def _reclaim(self, claim_key: str) -> None:
+        """reclaimVolume: a dynamically provisioned PV whose claim is gone
+        is deleted under reclaim policy Delete (Retain keeps it)."""
+        live_bound = {c.volume_name for c in self.api.list(
+            "PersistentVolumeClaim")[0] if c.volume_name}
+        for pv in self.api.list("PersistentVolume")[0]:
+            if pv.annotations.get(CLAIM_ANNOTATION) != claim_key:
+                continue
+            if pv.name in live_bound:
+                # another (or a recreated) claim bound this PV between the
+                # delete and this reclaim pass — deleting now would leave
+                # a live claim dangling (pv_controller's bound/UID guard)
+                continue
+            if pv.annotations.get(RECLAIM_ANNOTATION, "Delete") == "Delete":
+                try:
+                    self.api.delete("PersistentVolume", "", pv.name)
+                except NotFound:
+                    pass
+                self.event("PersistentVolume", pv.name, "Normal",
+                           "VolumeDeleted", "reclaim policy Delete")
 
 
 class AttachDetachController(Controller):

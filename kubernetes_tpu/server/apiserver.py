@@ -486,10 +486,14 @@ class ApiServer:
                     # a denied watch is audited like every other denial
                     self._audit(user, "watch", k, "", "", 403)
                     raise
-        # allowed watches audit too: data exposure must be as visible in
-        # the trail as the denials (every other entry point logs its 200)
-        for k in kinds:
-            self._audit(user, "watch", k, "", "", 200)
+        if self.auth_enabled:
+            # allowed watches audit too (secure port only: the in-process
+            # insecure path is the scheduler/informer sync loop, whose
+            # sub-second polls would flood the 10k ring and evict the 403
+            # entries that matter; per-rule suppression via AuditPolicy
+            # remains available for noisy authenticated watchers)
+            for k in kinds:
+                self._audit(user, "watch", k, "", "", 200)
         return self.store.watch_since(kinds, from_rv, timeout=timeout)
 
     def _audited_authn(self, cred, verb: str, kind: str) -> UserInfo:

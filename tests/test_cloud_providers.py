@@ -176,3 +176,137 @@ def test_refused_attach_retries_through_the_queue():
             break
         _time.sleep(0.02)
     assert cloud.disks_attached("n2") == ["pd-shared"]
+
+
+def _pv_rig():
+    from kubernetes_tpu.api.cluster import StorageClass
+    from kubernetes_tpu.client.informer import SharedInformerFactory
+    from kubernetes_tpu.controllers.cloudctrl import PersistentVolumeBinder
+    from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+    api = ApiServerLite()
+    api.create("StorageClass", StorageClass(
+        "fast", provisioner="kubernetes.io/gce-pd", is_default=True))
+    api.create("StorageClass", StorageClass(
+        "manual", provisioner="kubernetes.io/no-provisioner",
+        reclaim_policy="Retain"))
+    factory = SharedInformerFactory(api)
+    binder = PersistentVolumeBinder(api, factory, record_events=False)
+    factory.start()
+    return api, factory, binder
+
+
+def test_dynamic_provisioning_and_reclaim():
+    """pv_controller provisionClaim + reclaimVolume: a classed claim with
+    no matching PV gets one minted by the class's provisioner, binds on
+    the requeue, and the PV is deleted when the claim goes away."""
+    from kubernetes_tpu.api.types import PersistentVolumeClaim
+    from kubernetes_tpu.controllers.cloudctrl import CLASS_ANNOTATION
+
+    api, factory, binder = _pv_rig()
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "data", "default", capacity=1 << 30,
+        annotations={CLASS_ANNOTATION: "fast"}))
+    factory.step_all()
+    binder.pump()
+    factory.step_all()
+    binder.pump()  # the provisioned PV's ADDED event requeues the claim
+    pvc = api.get("PersistentVolumeClaim", "default", "data")
+    assert pvc.volume_name == "pvc-1eb304af-data"
+    pv = api.get("PersistentVolume", "", "pvc-1eb304af-data")
+    assert pv.capacity == 1 << 30
+    assert pv.annotations[CLASS_ANNOTATION] == "fast"
+    assert pv.source.kind.value == "GCEPersistentDisk"
+    # claim deleted -> reclaim Delete removes the provisioned PV
+    api.delete("PersistentVolumeClaim", "default", "data")
+    factory.step_all()
+    binder.pump()
+    import pytest as _pytest
+
+    from kubernetes_tpu.server.apiserver_lite import NotFound
+    with _pytest.raises(NotFound):
+        api.get("PersistentVolume", "", "pvc-1eb304af-data")
+
+
+def test_class_matching_and_no_provisioner():
+    """A classed claim must not bind a classless PV; no-provisioner
+    classes wait for a manually created same-class PV (and Retain keeps
+    the PV on claim deletion)."""
+    from kubernetes_tpu.api.types import (
+        PersistentVolume,
+        PersistentVolumeClaim,
+        Volume,
+    )
+    from kubernetes_tpu.controllers.cloudctrl import CLASS_ANNOTATION
+
+    api, factory, binder = _pv_rig()
+    # a classless PV big enough for the claim — must NOT be taken
+    api.create("PersistentVolume", PersistentVolume(
+        "classless", capacity=10 << 30, source=Volume(name="classless")))
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "data", "default", capacity=1 << 30,
+        annotations={CLASS_ANNOTATION: "manual"}))
+    factory.step_all()
+    binder.pump()
+    pvc = api.get("PersistentVolumeClaim", "default", "data")
+    assert pvc.volume_name == ""  # no same-class PV, no provisioner
+    # operator creates a manual-class PV: the claim binds it
+    api.create("PersistentVolume", PersistentVolume(
+        "manual-1", capacity=2 << 30, source=Volume(name="manual-1"),
+        annotations={CLASS_ANNOTATION: "manual"}))
+    factory.step_all()
+    binder.pump()
+    assert api.get("PersistentVolumeClaim", "default",
+                   "data").volume_name == "manual-1"
+    # Retain: claim deletion keeps the PV
+    api.delete("PersistentVolumeClaim", "default", "data")
+    factory.step_all()
+    binder.pump()
+    assert api.get("PersistentVolume", "", "manual-1").name == "manual-1"
+
+
+def test_default_class_admission_annotates_pvc():
+    """The StorageClassDefault plugin (now that PVCs carry annotations):
+    a class-less claim created through the chain gets the default class
+    and dynamic provisioning kicks in."""
+    from kubernetes_tpu.api.cluster import StorageClass
+    from kubernetes_tpu.api.types import PersistentVolumeClaim
+    from kubernetes_tpu.api.workloads import Namespace
+    from kubernetes_tpu.controllers.cloudctrl import CLASS_ANNOTATION
+    from kubernetes_tpu.server.apiserver import ApiServer
+
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    api.store.create("StorageClass", StorageClass(
+        "fast", provisioner="kubernetes.io/gce-pd", is_default=True))
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "data", "default", capacity=1 << 20))
+    got = api.get("PersistentVolumeClaim", "default", "data")
+    assert got.annotations[CLASS_ANNOTATION] == "fast"
+
+
+def test_reclaim_spares_rebound_pv():
+    """Finding regression: a PV rebound by another claim between the
+    delete and the reclaim pass must NOT be deleted."""
+    from kubernetes_tpu.api.types import PersistentVolumeClaim
+    from kubernetes_tpu.controllers.cloudctrl import CLASS_ANNOTATION
+
+    api, factory, binder = _pv_rig()
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "a", "default", capacity=1 << 20,
+        annotations={CLASS_ANNOTATION: "fast"}))
+    factory.step_all(); binder.pump()
+    factory.step_all(); binder.pump()
+    pv_name = api.get("PersistentVolumeClaim", "default", "a").volume_name
+    assert pv_name
+    # claim a deleted; claim b binds the same PV BEFORE the reclaim runs
+    api.delete("PersistentVolumeClaim", "default", "a")
+    api.create("PersistentVolumeClaim", PersistentVolumeClaim(
+        "b", "default", capacity=1 << 20,
+        annotations={CLASS_ANNOTATION: "fast"}))
+    factory.step_all()
+    binder.sync("default/b")          # b binds the freed PV
+    assert api.get("PersistentVolumeClaim", "default",
+                   "b").volume_name == pv_name
+    binder.pump()                     # the queued reclaim:default/a runs
+    assert api.get("PersistentVolume", "", pv_name).name == pv_name

@@ -66,10 +66,6 @@ def test_wire_registry_covers_served_kinds():
     from kubernetes_tpu.server.apiserver import KIND_INFO
 
     # kinds the apiserver serves but the wire codec cannot carry would
-    # break the REST facade on first touch; Binding/Event ride subpaths
-    missing = [k for k in KIND_INFO
-               if k not in KIND_REGISTRY
-               and k not in ("Namespace",)]  # Namespace: workloads type
-    from kubernetes_tpu.api.workloads import Namespace  # noqa: F401
-    assert "Namespace" in KIND_REGISTRY or True
-    assert not [k for k in missing], missing
+    # break the REST facade on first touch
+    missing = [k for k in KIND_INFO if k not in KIND_REGISTRY]
+    assert not missing, missing
