@@ -234,3 +234,86 @@ class FederatedDeploymentController(FederatedReplicaSetController):
 
     FED_KIND = FEDERATED_DEPLOY_KIND
     CHILD_KIND = "Deployment"
+
+
+PROPAGATED_KINDS = ("ConfigMap", "Secret")
+
+
+MANAGED_ANNOTATION = "federation.kubernetes.io/managed"
+
+
+class FederatedPropagationController:
+    """The non-scheduled federated types (federatedtypes/{configmap,
+    secret}.go): objects stored in the federation apiserver under the
+    federated kind are copied verbatim into every READY member cluster
+    and kept in sync — create where missing, overwrite on drift (data,
+    annotations, and Secret type alike), delete from members when the
+    federated object goes away. Ownership rides an ANNOTATION, the
+    payload is untouched, and a pre-existing member-local object of the
+    same name is never adopted or overwritten (a propagation conflict is
+    surfaced, not silently resolved by destroying local data)."""
+
+    def __init__(self, plane: FederationControlPlane):
+        self.plane = plane
+        self.conflicts: List[str] = []  # "<cluster>/<kind>/<ns>/<name>"
+
+    def sync_all(self) -> None:
+        ready = set(self.plane.ready_clusters())
+        self.conflicts = []
+        for kind in PROPAGATED_KINDS:
+            fed_objs, _ = self.plane.api.list("Federated" + kind)
+            fed_keys = {(o.namespace, o.name) for o in fed_objs}
+            for cname, api in list(self.plane.members.items()):
+                if cname not in ready:
+                    continue
+                for obj in fed_objs:
+                    self._ensure(cname, api, kind, obj)
+                # remove member copies whose federated parent is gone —
+                # only ones this controller owns (the managed annotation)
+                for existing in api.list(kind)[0]:
+                    if (existing.namespace, existing.name) in fed_keys:
+                        continue
+                    if getattr(existing, "annotations", {}).get(
+                            MANAGED_ANNOTATION) == "true":
+                        try:
+                            api.delete(kind, existing.namespace,
+                                       existing.name)
+                        except NotFound:
+                            pass
+
+    def _want(self, obj):
+        want = dataclasses.replace(obj, resource_version=0)
+        want.data = dict(obj.data)  # payload copied VERBATIM, no marker
+        want.annotations = {**getattr(obj, "annotations", {}),
+                            MANAGED_ANNOTATION: "true"}
+        return want
+
+    def _ensure(self, cname: str, api: ApiServerLite, kind: str,
+                obj) -> None:
+        want = self._want(obj)
+        try:
+            cur = api.get(kind, obj.namespace, obj.name)
+        except NotFound:
+            try:
+                api.create(kind, want)
+            except Conflict:
+                pass
+            return
+        if getattr(cur, "annotations", {}).get(MANAGED_ANNOTATION) \
+                != "true":
+            # member-local object of the same name: NEVER adopt it — an
+            # overwrite here would later be deleted as "managed",
+            # destroying data federation never owned
+            self.conflicts.append(
+                f"{cname}/{kind}/{obj.namespace}/{obj.name}")
+            return
+        # drift on ANY mutable field (data, annotations, Secret type):
+        # compare the full wire form modulo resourceVersion
+        from kubernetes_tpu.api import wire
+        want_enc = wire.encode(want)
+        cur_enc = wire.encode(cur)
+        want_enc.pop("resource_version", None)
+        cur_enc.pop("resource_version", None)
+        if cur_enc != want_enc:
+            api.update(kind, dataclasses.replace(
+                want, resource_version=cur.resource_version))

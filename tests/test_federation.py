@@ -394,3 +394,61 @@ def test_federate_cli_dns_persists_and_get_lists_all_kinds():
     assert "federateddeployment/default/web" in text
     assert "federatedservice/default/api" in text
     assert "serving=east" in text
+
+
+def test_federated_configmap_secret_propagation():
+    """federatedtypes/{configmap,secret}.go: federated config objects are
+    copied into every ready member, drift is overwritten, deletion
+    removes managed member copies, and a cluster joining late converges."""
+    from kubernetes_tpu.api.cluster import ConfigMap, Secret
+    from kubernetes_tpu.federation.controller import (
+        FederatedPropagationController,
+    )
+
+    plane = FederationControlPlane()
+    east, west = ApiServerLite(), ApiServerLite()
+    plane.join("east", east)
+    ctrl = FederatedPropagationController(plane)
+    plane.api.create("FederatedConfigMap",
+                     ConfigMap("settings", "default", data={"mode": "on"}))
+    plane.api.create("FederatedSecret",
+                     Secret("creds", "default", data={"t": "c2VjcmV0"}))
+    ctrl.sync_all()
+    cm = east.get("ConfigMap", "default", "settings")
+    # payload copied VERBATIM (no marker key injected into data)
+    assert cm.data == {"mode": "on"}
+    assert cm.annotations["federation.kubernetes.io/managed"] == "true"
+    assert east.get("Secret", "default", "creds").data == {"t": "c2VjcmV0"}
+    # drift in a member is overwritten on the next sync
+    drifted = east.get("ConfigMap", "default", "settings")
+    drifted.data = {"mode": "tampered"}
+    east.update("ConfigMap", drifted)
+    ctrl.sync_all()
+    assert east.get("ConfigMap", "default", "settings").data["mode"] == "on"
+    # late joiner converges
+    plane.join("west", west)
+    ctrl.sync_all()
+    assert west.get("ConfigMap", "default", "settings").data["mode"] == "on"
+    # a member-local object colliding with a federated one is NEVER
+    # adopted: it survives untouched and surfaces as a conflict
+    west.create("ConfigMap", ConfigMap("collide", "default",
+                                       data={"local": "data"}))
+    plane.api.create("FederatedConfigMap",
+                     ConfigMap("collide", "default", data={"fed": "x"}))
+    ctrl.sync_all()
+    assert west.get("ConfigMap", "default", "collide").data \
+        == {"local": "data"}
+    assert any("west/ConfigMap/default/collide" == c
+               for c in ctrl.conflicts)
+    # an unmanaged member-local configmap survives; the managed copy goes
+    # when the federated parent is deleted
+    east.create("ConfigMap", ConfigMap("local-only", "default",
+                                       data={"k": "v"}))
+    plane.api.delete("FederatedConfigMap", "default", "settings")
+    ctrl.sync_all()
+    import pytest as _pytest
+
+    from kubernetes_tpu.server.apiserver_lite import NotFound
+    with _pytest.raises(NotFound):
+        east.get("ConfigMap", "default", "settings")
+    assert east.get("ConfigMap", "default", "local-only").data["k"] == "v"
