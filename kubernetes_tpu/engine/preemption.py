@@ -34,50 +34,101 @@ from kubernetes_tpu.ops import oracle
 from kubernetes_tpu.state.node_info import NodeInfo
 
 
+# exact-verification budget per preemptor (the percentageOfNodesToScore
+# idea): past this many candidate nodes, verify only the most promising
+MAX_VERIFIED_CANDIDATES = 128
+
+
 @dataclass
 class PreemptionPlan:
     node_name: str
     victims: List[Pod]  # sorted lowest priority first (eviction order)
 
 
-def _candidate_mask(pod: Pod, infos: List[NodeInfo]) -> np.ndarray:
-    """Vectorized pre-filter: could the preemptor fit on node n if every
-    pod with lower priority were evicted? Over-approximates (resources +
-    pod-count only) — exact verification follows per candidate."""
-    need = pod.resource_request()
-    n = len(infos)
-    alloc_cpu = np.empty(n, dtype=np.int64)
-    alloc_mem = np.empty(n, dtype=np.int64)
-    alloc_pods = np.empty(n, dtype=np.int64)
-    used_cpu = np.empty(n, dtype=np.int64)
-    used_mem = np.empty(n, dtype=np.int64)
-    used_count = np.empty(n, dtype=np.int64)
-    free_cpu = np.empty(n, dtype=np.int64)
-    free_mem = np.empty(n, dtype=np.int64)
-    free_count = np.empty(n, dtype=np.int64)
-    for i, info in enumerate(infos):
-        alloc = info.allocatable()
-        alloc_cpu[i] = alloc.milli_cpu
-        alloc_mem[i] = alloc.memory
-        alloc_pods[i] = info.allowed_pod_number()
-        used_cpu[i] = info.requested.milli_cpu
-        used_mem[i] = info.requested.memory
-        used_count[i] = len(info.pods)
-        fc = fm = fn_ = 0
-        for vic in info.pods:
-            if vic.priority < pod.priority:
+class PreemptionState:
+    """Round-scoped arrays for the candidate pre-filter: built ONCE from
+    the NodeInfo map (O(total pods) Python attribute access), then each
+    preemptor's mask is pure numpy (bincount segment sums over the pod
+    axis) and plan effects apply incrementally — a 200-preemptor burst
+    costs one array build, not 200 (measured 80 ms/preemptor without
+    this at 1k nodes / 4k pods)."""
+
+    def __init__(self, infos: Dict[str, NodeInfo]):
+        self.names = sorted(infos)
+        self.infos = [infos[n] for n in self.names]
+        n = len(self.infos)
+        self.alloc_cpu = np.empty(n, dtype=np.int64)
+        self.alloc_mem = np.empty(n, dtype=np.int64)
+        self.alloc_pods = np.empty(n, dtype=np.int64)
+        self.used_cpu = np.empty(n, dtype=np.int64)
+        self.used_mem = np.empty(n, dtype=np.int64)
+        self.used_count = np.empty(n, dtype=np.int64)
+        node_idx, prio, cpu, mem = [], [], [], []
+        keys = []
+        for i, info in enumerate(self.infos):
+            alloc = info.allocatable()
+            self.alloc_cpu[i] = alloc.milli_cpu
+            self.alloc_mem[i] = alloc.memory
+            self.alloc_pods[i] = info.allowed_pod_number()
+            self.used_cpu[i] = info.requested.milli_cpu
+            self.used_mem[i] = info.requested.memory
+            self.used_count[i] = len(info.pods)
+            for vic in info.pods:
                 r = vic.resource_request()
-                fc += r.milli_cpu
-                fm += r.memory
-                fn_ += 1
-        free_cpu[i] = fc
-        free_mem[i] = fm
-        free_count[i] = fn_
-    return ((used_cpu - free_cpu + need.milli_cpu <= alloc_cpu)
-            & (used_mem - free_mem + need.memory <= alloc_mem)
-            & (used_count - free_count + 1 <= alloc_pods)
-            & (free_count > 0))  # no victims -> plain unschedulable, not
-                                 # a preemption candidate
+                node_idx.append(i)
+                prio.append(vic.priority)
+                cpu.append(r.milli_cpu)
+                mem.append(r.memory)
+                keys.append(vic.key())
+        self.n = n
+        self.pod_node = np.asarray(node_idx, dtype=np.int64)
+        self.pod_prio = np.asarray(prio, dtype=np.int64)
+        self.pod_cpu = np.asarray(cpu, dtype=np.int64)
+        self.pod_mem = np.asarray(mem, dtype=np.int64)
+        self.pod_keys = keys
+        self.alive = np.ones(len(node_idx), dtype=bool)
+        self._name_index = {name: i for i, name in enumerate(self.names)}
+
+    def candidate_mask(self, pod: Pod) -> np.ndarray:
+        need = pod.resource_request()
+        below = self.alive & (self.pod_prio < pod.priority)
+        idx = self.pod_node[below]
+        free_cpu = np.bincount(idx, weights=self.pod_cpu[below],
+                               minlength=self.n)
+        free_mem = np.bincount(idx, weights=self.pod_mem[below],
+                               minlength=self.n)
+        free_count = np.bincount(idx, minlength=self.n)
+        return ((self.used_cpu - free_cpu + need.milli_cpu
+                 <= self.alloc_cpu)
+                & (self.used_mem - free_mem + need.memory
+                   <= self.alloc_mem)
+                & (self.used_count - free_count + 1 <= self.alloc_pods)
+                & (free_count > 0))  # no victims -> plain unschedulable,
+                                     # not a preemption candidate
+
+    def apply_plan(self, plan: "PreemptionPlan", pod: Pod) -> None:
+        """Reflect a committed plan: victims leave the arrays (and the
+        node totals), the preemptor's request is reserved. The preemptor
+        itself is NOT added to the pod arrays: later preemptors in the
+        round have lower priority (sorted desc), so it can never be
+        their victim — its reservation lives only in used_*."""
+        node_i = self._name_index[plan.node_name]
+        victim_keys = {v.key() for v in plan.victims}
+        for v in plan.victims:
+            r = v.resource_request()
+            self.used_cpu[node_i] -= r.milli_cpu
+            self.used_mem[node_i] -= r.memory
+            self.used_count[node_i] -= 1
+        # mark victim entries dead by key — order-independent, so
+        # multiple plans against the same node stay consistent even as
+        # the caller mutates the NodeInfo between them
+        for j in np.flatnonzero(self.pod_node == node_i):
+            if self.pod_keys[int(j)] in victim_keys:
+                self.alive[int(j)] = False
+        need = pod.resource_request()
+        self.used_cpu[node_i] += need.milli_cpu
+        self.used_mem[node_i] += need.memory
+        self.used_count[node_i] += 1
 
 
 def _select_victims(pod: Pod, info: NodeInfo,
@@ -110,17 +161,38 @@ def _select_victims(pod: Pod, info: NodeInfo,
 
 
 def pick_preemption(pod: Pod, node_infos: Dict[str, NodeInfo],
-                    ctx=None) -> Optional[PreemptionPlan]:
+                    ctx=None,
+                    state: Optional[PreemptionState] = None
+                    ) -> Optional[PreemptionPlan]:
     """generic_scheduler.Preempt: pre-filter all nodes vectorized, verify
-    candidates exactly, choose by pickOneNodeForPreemption's ordering."""
+    candidates exactly, choose by pickOneNodeForPreemption's ordering.
+    Pass a round-scoped PreemptionState to amortize the array build over
+    many preemptors (the caller then applies plans via
+    state.apply_plan)."""
     if pod.priority <= 0:
         return None
-    names = sorted(node_infos)
-    infos = [node_infos[n] for n in names]
-    mask = _candidate_mask(pod, infos)
+    if state is None:
+        state = PreemptionState(node_infos)
+    mask = state.candidate_mask(pod)
+    candidates = np.flatnonzero(mask)
+    if len(candidates) > MAX_VERIFIED_CANDIDATES:
+        # bound the exact phase the way the newer reference bounds
+        # scoring (percentageOfNodesToScore): verify the nodes whose
+        # below-priority pods have the LOWEST max priority first — the
+        # choice key compares max victim priority first, so these are
+        # where the cheapest evictions live
+        below = state.alive & (state.pod_prio < pod.priority)
+        # min-fill so the max-reduction can actually register: nodes with
+        # no below-priority pods keep INT64_MIN... but those are already
+        # excluded by the mask's free_count>0, so sort order is safe
+        seg_max = np.full(state.n, np.iinfo(np.int64).min, dtype=np.int64)
+        np.maximum.at(seg_max, state.pod_node[below],
+                      state.pod_prio[below])
+        order = np.argsort(seg_max[candidates], kind="stable")
+        candidates = candidates[order][:MAX_VERIFIED_CANDIDATES]
     best: Optional[Tuple[Tuple[int, int, int], str, List[Pod]]] = None
-    for i in np.flatnonzero(mask):
-        info = infos[int(i)]
+    for i in candidates:
+        info = state.infos[int(i)]
         victims = _select_victims(pod, info, ctx=ctx)
         if victims is None or not victims:
             continue
@@ -128,7 +200,7 @@ def pick_preemption(pod: Pod, node_infos: Dict[str, NodeInfo],
                sum(v.priority for v in victims),
                len(victims))
         if best is None or key < best[0]:
-            best = (key, names[int(i)], victims)
+            best = (key, state.names[int(i)], victims)
     if best is None:
         return None
     return PreemptionPlan(node_name=best[1], victims=best[2])

@@ -323,8 +323,16 @@ class Scheduler:
             volume_ctx=self.engine.volume_ctx,
             policy_algos=self.engine.policy_algos)
         count = 0
+        # lazy: a round whose unschedulable pods are all priority 0 (the
+        # default) must not pay the O(total pods) array build
+        state = None
         for pod in sorted(unschedulable, key=lambda p: -p.priority):
-            plan = preemptmod.pick_preemption(pod, infos, ctx=ctx)
+            if pod.priority <= 0:
+                break  # sorted desc: nothing below can preempt either
+            if state is None:
+                state = preemptmod.PreemptionState(infos)
+            plan = preemptmod.pick_preemption(pod, infos, ctx=ctx,
+                                              state=state)
             if plan is None:
                 continue
             for vic in plan.victims:
@@ -347,6 +355,7 @@ class Scheduler:
             info = infos.get(plan.node_name)
             if info is not None:
                 info.add_pod(pod)
+            state.apply_plan(plan, pod)
             self._event(pod, "Normal", "TriggeredPreemption",
                         f"{len(plan.victims)} lower-priority pod(s) on "
                         f"{plan.node_name} evicted")

@@ -307,3 +307,30 @@ def test_preemption_fuzz_invariants(pod_priority):
         for v in plan.victims:
             assert not fits_without(victims - {v.key()}), \
                 f"trial {trial}: victim {v.name} was unnecessary"
+
+
+def test_bounded_candidates_prefer_cheapest_victims(pod_priority):
+    """Finding regression: with more candidates than the verification
+    budget, the kept subset must be the LOWEST-max-victim-priority nodes
+    (the seg_max ordering), not the first N by name."""
+    import numpy as np
+
+    from kubernetes_tpu.engine import preemption as pm
+
+    old = pm.MAX_VERIFIED_CANDIDATES
+    pm.MAX_VERIFIED_CANDIDATES = 2
+    try:
+        infos = {}
+        # names sort so the EXPENSIVE nodes come first alphabetically
+        for i, prio in enumerate([90, 90, 90, 1, 1]):
+            node = make_node(f"n{i}", cpu=1000, memory=8 * Gi)
+            info = NodeInfo(node)
+            info.add_pod(prio_pod(f"v{i}", prio, cpu=900,
+                                  node_name=f"n{i}"))
+            infos[f"n{i}"] = info
+        plan = pick_preemption(prio_pod("pre", 100, cpu=500), infos)
+        assert plan is not None
+        # must land on a priority-1 victim node despite the budget of 2
+        assert plan.victims[0].priority == 1, plan
+    finally:
+        pm.MAX_VERIFIED_CANDIDATES = old
