@@ -734,6 +734,10 @@ class Ktctl:
 
     def cmd_rollout(self, args):
         pos, flags = self._flags(args)
+        if len(pos) < 3:
+            raise SystemExit(
+                "error: usage: rollout "
+                "{status|history|undo|pause|resume} KIND NAME")
         sub, kind_arg, name = pos[0], pos[1], pos[2]
         kind = self._resolve_kind(kind_arg)
         ns = flags.get("namespace", "default")
@@ -757,6 +761,25 @@ class Ktctl:
             obj.template = hist[-1]
             self.api.update(kind, obj)
             self._print(f"{self._plural(kind)}/{name} rolled back")
+        elif sub in ("pause", "resume"):
+            # kubectl rollout pause/resume (cmd/rollout_pause.go): the
+            # deployment controller skips paused deployments, freezing
+            # the rollout without touching the spec
+            if not hasattr(obj, "paused"):
+                raise SystemExit(
+                    f"error: {kind} does not support pausing")
+            want = sub == "pause"
+            if obj.paused == want:
+                # kubectl's exact wording (cmd/rollout_pause.go /
+                # rollout_resume.go)
+                raise SystemExit(
+                    f"error: {self._plural(kind)}/{name} is "
+                    f"{'already paused' if want else 'not paused'}")
+            obj.paused = want
+            self.api.update(kind, obj)
+            self._print(f"{self._plural(kind)}/{name} {sub}d")
+        else:
+            raise SystemExit(f"error: unknown rollout subcommand {sub!r}")
 
     def cmd_top(self, args):
         pos, _ = self._flags(args)

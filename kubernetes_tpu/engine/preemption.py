@@ -182,13 +182,16 @@ def pick_preemption(pod: Pod, node_infos: Dict[str, NodeInfo],
         # choice key compares max victim priority first, so these are
         # where the cheapest evictions live
         below = state.alive & (state.pod_prio < pod.priority)
-        # min-fill so the max-reduction can actually register: nodes with
-        # no below-priority pods keep INT64_MIN... but those are already
-        # excluded by the mask's free_count>0, so sort order is safe
-        seg_max = np.full(state.n, np.iinfo(np.int64).min, dtype=np.int64)
-        np.maximum.at(seg_max, state.pod_node[below],
+        # rank by the per-node MIN below-priority pod priority — the
+        # FLOOR of the achievable choice key on that node (the minimal
+        # victim set's max priority can be as low as the smallest
+        # below-priority pod, e.g. when that one pod suffices). Ranking
+        # by the max instead systematically truncates mixed-priority
+        # nodes whose cheapest eviction is actually the best plan.
+        seg_min = np.full(state.n, np.iinfo(np.int64).max, dtype=np.int64)
+        np.minimum.at(seg_min, state.pod_node[below],
                       state.pod_prio[below])
-        order = np.argsort(seg_max[candidates], kind="stable")
+        order = np.argsort(seg_min[candidates], kind="stable")
         candidates = candidates[order][:MAX_VERIFIED_CANDIDATES]
     best: Optional[Tuple[Tuple[int, int, int], str, List[Pod]]] = None
     for i in candidates:

@@ -334,3 +334,35 @@ def test_bounded_candidates_prefer_cheapest_victims(pod_priority):
         assert plan.victims[0].priority == 1, plan
     finally:
         pm.MAX_VERIFIED_CANDIDATES = old
+
+
+def test_truncation_keeps_mixed_priority_node_with_cheapest_victim(
+        pod_priority):
+    """Finding regression: a node holding BOTH a high- and a low-priority
+    pod (where only the low one needs evicting) must survive truncation —
+    ranking is by the per-node MIN below-priority pod, the floor of the
+    achievable choice key."""
+    from kubernetes_tpu.engine import preemption as pm
+
+    old = pm.MAX_VERIFIED_CANDIDATES
+    pm.MAX_VERIFIED_CANDIDATES = 2
+    try:
+        infos = {}
+        # node "a-mixed": prio-89 pod (500m) + prio-1 pod (500m); evicting
+        # just the prio-1 pod fits the 400m preemptor -> best key max=1
+        node = make_node("a-mixed", cpu=1000, memory=8 * Gi)
+        info = NodeInfo(node)
+        info.add_pod(prio_pod("hi", 89, cpu=500, node_name="a-mixed"))
+        info.add_pod(prio_pod("cheap", 1, cpu=500, node_name="a-mixed"))
+        infos["a-mixed"] = info
+        # filler nodes each with one prio-50 victim
+        for i in range(4):
+            n = make_node(f"b{i}", cpu=1000, memory=8 * Gi)
+            fi = NodeInfo(n)
+            fi.add_pod(prio_pod(f"mid{i}", 50, cpu=900, node_name=f"b{i}"))
+            infos[f"b{i}"] = fi
+        plan = pick_preemption(prio_pod("pre", 100, cpu=400), infos)
+        assert plan is not None and plan.node_name == "a-mixed"
+        assert [v.name for v in plan.victims] == ["cheap"]
+    finally:
+        pm.MAX_VERIFIED_CANDIDATES = old

@@ -318,3 +318,36 @@ def test_hyperkube_dispatcher(tmp_path, capsys):
                  str(tmp_path / "c")]) == 0
     assert main(["ktadm", "init", "--workdir", str(tmp_path / "c")]) == 0
     assert main(["ktadm", "reset", "--workdir", str(tmp_path / "c")]) == 0
+
+
+def test_rollout_pause_resume_freezes_controller():
+    """kubectl rollout pause/resume: a paused deployment's rollout
+    freezes (the controller skips it) and resumes where it left off."""
+    from kubernetes_tpu.api.types import LabelSelector
+    from kubernetes_tpu.api.workloads import Deployment
+    from kubernetes_tpu.client.informer import SharedInformerFactory
+    from kubernetes_tpu.controllers.deployment import DeploymentController
+
+    api, kt, out = make_cli()
+    factory = SharedInformerFactory(api.store)
+    ctrl = DeploymentController(api.store, factory, record_events=False)
+    factory.start()
+    dep = Deployment("web", replicas=3,
+                     selector=LabelSelector(match_labels={"app": "web"}),
+                     template=make_pod("", labels={"app": "web"}, cpu=10))
+    api.store.create("Deployment", dep)
+    assert kt.run(["rollout", "pause", "deploy", "web"]) == 0
+    assert "paused" in out.getvalue()
+    factory.step_all()
+    ctrl.pump()
+    # controller skipped the paused deployment: no child RS created
+    assert api.store.list("ReplicaSet")[0] == []
+    # pausing twice is an error, like kubectl
+    assert kt.run(["rollout", "pause", "deploy", "web"]) == 1
+    assert kt.run(["rollout", "resume", "deploy", "web"]) == 0
+    factory.step_all()
+    ctrl.pump()
+    rs = api.store.list("ReplicaSet")[0]
+    assert len(rs) == 1 and rs[0].replicas == 3
+    # unknown subcommand errors cleanly
+    assert kt.run(["rollout", "restart", "deploy", "web"]) == 1
