@@ -608,8 +608,29 @@ class Ktctl:
         pos, flags = self._flags(args)
         kind = self._resolve_kind(pos[0])
         ns = flags.get("namespace", "default")
+        try:
+            events, _ = self.api.list("Event")  # one fetch for all objects
+        except Exception:
+            events = []
         for obj in self._objs(kind, ns, pos[1] if len(pos) > 1 else ""):
             self._print(describe(kind, obj))
+            # the Events section kubectl describe ends with
+            # (pkg/printers/internalversion/describe.go DescribeEvents):
+            # recorder convention — namespaced objects key as <ns>/<name>
+            # (obj.key()), cluster-scoped ones by bare name (Node/PV
+            # events would never match a "/name" key)
+            key = obj.key() if hasattr(obj, "key") else (
+                (getattr(obj, "namespace", "") + "/" + obj.name)
+                if getattr(obj, "namespace", "") else obj.name)
+            rows = [e for e in events
+                    if getattr(e, "involved_key", "") == key
+                    and getattr(e, "involved_kind", kind) == kind]
+            if rows:
+                self._print("Events:")
+                self._print("  TYPE\tREASON\tCOUNT\tMESSAGE")
+                for e in rows:
+                    self._print(f"  {e.type}\t{e.reason}\t"
+                                f"{getattr(e, 'count', 1)}\t{e.message}")
 
     def _load_manifests(self, flags) -> List[Any]:
         text = open(flags["filename"]).read() \

@@ -88,6 +88,54 @@ class PreemptionState:
         self.pod_keys = keys
         self.alive = np.ones(len(node_idx), dtype=bool)
         self._name_index = {name: i for i, name in enumerate(self.names)}
+        # flat pod arrays sorted by (node, priority) + segment offsets —
+        # the vectorized tight-bound pass reads priority-ordered prefixes
+        # of every node at once (built lazily on first truncation)
+        self._s_perm: Optional[np.ndarray] = None
+
+    def _ensure_sorted(self) -> None:
+        if self._s_perm is not None:
+            return
+        perm = np.lexsort((self.pod_prio, self.pod_node))
+        self._s_perm = perm
+        self._s_node = self.pod_node[perm]
+        self._s_prio = self.pod_prio[perm]
+        self._s_cpu = self.pod_cpu[perm]
+        self._s_mem = self.pod_mem[perm]
+        # first flat position of each node's segment
+        self._seg_start = np.searchsorted(self._s_node, np.arange(self.n))
+
+    def tight_bounds(self, pod: Pod) -> np.ndarray:
+        """Per-node EXACT minimal max-victim-priority under the
+        resources-only relaxation: evict pods ascending by priority until
+        the preemptor fits; the bound is that prefix's max priority. A
+        true achievable-key floor — neither the optimistic per-node MIN
+        (a tiny pod that frees nothing ranks a node too well) nor the
+        pessimistic MAX (one high-priority pod hides a cheap
+        single-victim plan). One vectorized pass over the flat
+        (node, priority)-sorted arrays; INT64_MAX = infeasible."""
+        self._ensure_sorted()
+        need = pod.resource_request()
+        below = self.alive[self._s_perm] & (self._s_prio < pod.priority)
+        freed_cpu = np.cumsum(np.where(below, self._s_cpu, 0))
+        freed_mem = np.cumsum(np.where(below, self._s_mem, 0))
+        # per-segment cumulative = global cumsum minus the segment base
+        base_cpu = np.concatenate(([0], freed_cpu))[self._seg_start]
+        base_mem = np.concatenate(([0], freed_mem))[self._seg_start]
+        spare_cpu = (self.alloc_cpu - self.used_cpu)[self._s_node]
+        spare_mem = (self.alloc_mem - self.used_mem)[self._s_node]
+        ok = ((spare_cpu + freed_cpu - base_cpu[self._s_node]
+               >= need.milli_cpu)
+              & (spare_mem + freed_mem - base_mem[self._s_node]
+                 >= need.memory) & below)
+        big = np.iinfo(np.int64).max
+        first_ok = np.full(self.n, len(ok), dtype=np.int64)
+        flat_pos = np.flatnonzero(ok)
+        np.minimum.at(first_ok, self._s_node[flat_pos], flat_pos)
+        bounds = np.full(self.n, big, dtype=np.int64)
+        has = first_ok < len(ok)
+        bounds[has] = self._s_prio[first_ok[has]]
+        return bounds
 
     def candidate_mask(self, pod: Pod) -> np.ndarray:
         need = pod.resource_request()
@@ -177,21 +225,14 @@ def pick_preemption(pod: Pod, node_infos: Dict[str, NodeInfo],
     candidates = np.flatnonzero(mask)
     if len(candidates) > MAX_VERIFIED_CANDIDATES:
         # bound the exact phase the way the newer reference bounds
-        # scoring (percentageOfNodesToScore): verify the nodes whose
-        # below-priority pods have the LOWEST max priority first — the
-        # choice key compares max victim priority first, so these are
-        # where the cheapest evictions live
-        below = state.alive & (state.pod_prio < pod.priority)
-        # rank by the per-node MIN below-priority pod priority — the
-        # FLOOR of the achievable choice key on that node (the minimal
-        # victim set's max priority can be as low as the smallest
-        # below-priority pod, e.g. when that one pod suffices). Ranking
-        # by the max instead systematically truncates mixed-priority
-        # nodes whose cheapest eviction is actually the best plan.
-        seg_min = np.full(state.n, np.iinfo(np.int64).max, dtype=np.int64)
-        np.minimum.at(seg_min, state.pod_node[below],
-                      state.pod_prio[below])
-        order = np.argsort(seg_min[candidates], kind="stable")
+        # scoring (percentageOfNodesToScore), ranked by the TIGHT bound
+        # (tight_bounds): the minimal max-victim-priority that actually
+        # frees enough resources. This avoids both truncation
+        # pathologies — a MAX ranking hides cheap single-victim plans on
+        # mixed nodes, a bare MIN ranking promotes nodes whose tiny
+        # low-priority pod frees nothing.
+        bounds = state.tight_bounds(pod)
+        order = np.argsort(bounds[candidates], kind="stable")
         candidates = candidates[order][:MAX_VERIFIED_CANDIDATES]
     best: Optional[Tuple[Tuple[int, int, int], str, List[Pod]]] = None
     for i in candidates:

@@ -366,3 +366,33 @@ def test_truncation_keeps_mixed_priority_node_with_cheapest_victim(
         assert [v.name for v in plan.victims] == ["cheap"]
     finally:
         pm.MAX_VERIFIED_CANDIDATES = old
+
+
+def test_truncation_tight_bound_skips_useless_tiny_victims(pod_priority):
+    """Dual-failure regression: a node whose tiny prio-1 pod cannot free
+    enough space must NOT crowd out a node with a real cheap plan — the
+    tight bound (prefix sums until the preemptor fits) sees through it."""
+    from kubernetes_tpu.engine import preemption as pm
+
+    old = pm.MAX_VERIFIED_CANDIDATES
+    pm.MAX_VERIFIED_CANDIDATES = 2
+    try:
+        infos = {}
+        # A-nodes: a 10m prio-1 pod (useless) + a 900m prio-90 pod; any
+        # valid eviction must include the prio-90 pod -> true key 90
+        for i in range(8):
+            n = make_node(f"a{i}", cpu=1000, memory=8 * Gi)
+            fi = NodeInfo(n)
+            fi.add_pod(prio_pod(f"tiny{i}", 1, cpu=10, node_name=f"a{i}"))
+            fi.add_pod(prio_pod(f"big{i}", 90, cpu=900, node_name=f"a{i}"))
+            infos[f"a{i}"] = fi
+        # node z: a single prio-50 victim frees everything -> true key 50
+        n = make_node("z", cpu=1000, memory=8 * Gi)
+        fi = NodeInfo(n)
+        fi.add_pod(prio_pod("mid", 50, cpu=900, node_name="z"))
+        infos["z"] = fi
+        plan = pick_preemption(prio_pod("pre", 100, cpu=800), infos)
+        assert plan is not None and plan.node_name == "z", plan
+        assert [v.priority for v in plan.victims] == [50]
+    finally:
+        pm.MAX_VERIFIED_CANDIDATES = old

@@ -351,3 +351,41 @@ def test_rollout_pause_resume_freezes_controller():
     assert len(rs) == 1 and rs[0].replicas == 3
     # unknown subcommand errors cleanly
     assert kt.run(["rollout", "restart", "deploy", "web"]) == 1
+
+
+def test_describe_shows_events_section():
+    """kubectl describe ends with the object's events
+    (describe.go DescribeEvents) — recorder events for the object key
+    render as an Events: section; objects without events get none."""
+    from kubernetes_tpu.client.record import EventRecorder
+
+    api, kt, out = make_cli()
+    api.store.create("Pod", make_pod("web", cpu=10, memory=1 << 20))
+    rec = EventRecorder(api.store, source="scheduler")
+    rec.event("Pod", "default/web", "Warning", "FailedScheduling",
+              "0/0 nodes available")
+    rec.event("Pod", "default/web", "Warning", "FailedScheduling",
+              "0/0 nodes available")  # dedup -> count 2
+    assert kt.run(["describe", "pod", "web"]) == 0
+    text = out.getvalue()
+    assert "Events:" in text and "FailedScheduling" in text
+    assert "\t2\t" in text  # correlated count
+    out.truncate(0), out.seek(0)
+    api.store.create("Pod", make_pod("quiet", cpu=10, memory=1 << 20))
+    assert kt.run(["describe", "pod", "quiet"]) == 0
+    assert "Events:" not in out.getvalue()
+
+
+def test_describe_node_shows_cluster_scoped_events():
+    """Cluster-scoped objects (Node) key their events by bare name —
+    describe must match that convention, not '/name'."""
+    from kubernetes_tpu.client.record import EventRecorder
+
+    api, kt, out = make_cli()
+    api.store.create("Node", make_node("n1", cpu=1000, memory=1 << 31))
+    rec = EventRecorder(api.store, source="nodelifecycle")
+    rec.event("Node", "n1", "Warning", "NodeNotReady",
+              "Node n1 status is now NotReady")
+    assert kt.run(["describe", "node", "n1"]) == 0
+    text = out.getvalue()
+    assert "Events:" in text and "NodeNotReady" in text
