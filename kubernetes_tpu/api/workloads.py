@@ -180,6 +180,7 @@ class DaemonSet:
     namespace: str = "default"
     selector: LabelSelector = field(default_factory=LabelSelector)
     template: Pod = field(default_factory=lambda: Pod(name=""))
+    annotations: Dict[str, str] = field(default_factory=dict)
     # status
     desired_scheduled: int = 0
     current_scheduled: int = 0

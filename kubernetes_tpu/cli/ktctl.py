@@ -803,7 +803,7 @@ class Ktctl:
             raise SystemExit(f"error: unknown rollout subcommand {sub!r}")
 
     def cmd_top(self, args):
-        pos, _ = self._flags(args)
+        pos, flags = self._flags(args)
         if pos and pos[0] in ("node", "nodes", "no"):
             pods, _ = self.api.list("Pod")
             nodes, _ = self.api.list("Node")
@@ -818,6 +818,26 @@ class Ktctl:
             for n in nodes:
                 u = usage.get(n.name, [0, 0])
                 self._print(f"{n.name}  {u[0]}m  {u[1]}")
+            return
+        if pos and pos[0] in ("pod", "pods", "po"):
+            # kubectl top pod (metrics-server path): per-pod usage — the
+            # hollow runtime's actual-usage annotations when scripted
+            # (the cadvisor stand-in), requests otherwise
+            from kubernetes_tpu.nodes.kubelet import ACTUAL_MEM_ANNOTATION
+            ns = flags.get("namespace", "default")
+            pods, _ = self.api.list("Pod")
+            self._print("NAME  CPU(cores)  MEMORY(bytes)")
+            for p in pods:
+                if p.namespace != ns and "all-namespaces" not in flags:
+                    continue
+                if not p.node_name:
+                    continue  # metrics exist only for running pods
+                r = p.resource_request()
+                mem = int(p.annotations.get(ACTUAL_MEM_ANNOTATION,
+                                            r.memory))
+                self._print(f"{p.name}  {r.milli_cpu}m  {mem}")
+            return
+        raise SystemExit("error: usage: top {node|pod} [...]")
 
     def cmd_api_resources(self, args):
         self._print("NAME  APIGROUP  KIND  NAMESPACED")

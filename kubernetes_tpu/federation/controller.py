@@ -237,6 +237,89 @@ class FederatedDeploymentController(FederatedReplicaSetController):
 
 
 PROPAGATED_KINDS = ("ConfigMap", "Secret")
+FEDERATED_DS_KIND = "FederatedDaemonSet"
+
+
+def propagate_kind(plane: FederationControlPlane, conflicts: List[str],
+                   fed_kind: str, child_kind: str,
+                   status_fields: tuple = ()) -> None:
+    """The ONE sync body for every non-scheduled federated type: create
+    where missing, overwrite drift (comparing the wire form minus
+    resourceVersion and the member-owned status fields), never adopt a
+    member-local object of the same name (surfaced via `conflicts`
+    instead of destroying data federation never owned), and delete
+    managed copies whose federated parent is gone."""
+    import copy as _copy
+
+    from kubernetes_tpu.api import wire
+    ready = set(plane.ready_clusters())
+    fed_objs, _ = plane.api.list(fed_kind)
+    fed_keys = {(o.namespace, o.name) for o in fed_objs}
+    wants = []  # desired state computed ONCE, reused for every member
+    for obj in fed_objs:
+        want = _copy.deepcopy(obj)
+        want.resource_version = 0
+        want.annotations = {**getattr(obj, "annotations", {}),
+                            MANAGED_ANNOTATION: "true"}
+        enc = wire.encode(want)
+        enc.pop("resource_version", None)
+        for f in status_fields:
+            enc.pop(f, None)
+        wants.append((obj, want, enc))
+    for cname, api in list(plane.members.items()):
+        if cname not in ready:
+            continue
+        for obj, want, want_enc in wants:
+            try:
+                cur = api.get(child_kind, obj.namespace, obj.name)
+            except NotFound:
+                try:
+                    api.create(child_kind, _copy.deepcopy(want))
+                except Conflict:
+                    pass
+                continue
+            if getattr(cur, "annotations", {}).get(MANAGED_ANNOTATION) \
+                    != "true":
+                conflicts.append(
+                    f"{cname}/{child_kind}/{obj.namespace}/{obj.name}")
+                continue
+            cur_enc = wire.encode(cur)
+            cur_enc.pop("resource_version", None)
+            for f in status_fields:
+                cur_enc.pop(f, None)
+            if cur_enc != want_enc:
+                fresh = _copy.deepcopy(want)
+                fresh.resource_version = cur.resource_version
+                api.update(child_kind, fresh)
+        for existing in api.list(child_kind)[0]:
+            if (existing.namespace, existing.name) in fed_keys:
+                continue
+            if getattr(existing, "annotations", {}).get(
+                    MANAGED_ANNOTATION) == "true":
+                try:
+                    api.delete(child_kind, existing.namespace,
+                               existing.name)
+                except NotFound:
+                    pass
+
+
+class FederatedDaemonSetController:
+    """federatedtypes/daemonset.go: no replica planning — the DaemonSet
+    lands verbatim in EVERY ready member cluster (each cluster's own
+    DaemonSet controller then runs one pod per node); the shared
+    propagation body supplies the conflict guard and orphan cleanup,
+    with the member-owned status fields excluded from drift."""
+
+    def __init__(self, plane: FederationControlPlane):
+        self.plane = plane
+        self.conflicts: List[str] = []
+
+    def sync_all(self) -> None:
+        self.conflicts = []
+        propagate_kind(self.plane, self.conflicts, FEDERATED_DS_KIND,
+                       "DaemonSet",
+                       status_fields=("desired_scheduled",
+                                      "current_scheduled"))
 
 
 MANAGED_ANNOTATION = "federation.kubernetes.io/managed"
@@ -258,62 +341,7 @@ class FederatedPropagationController:
         self.conflicts: List[str] = []  # "<cluster>/<kind>/<ns>/<name>"
 
     def sync_all(self) -> None:
-        ready = set(self.plane.ready_clusters())
         self.conflicts = []
         for kind in PROPAGATED_KINDS:
-            fed_objs, _ = self.plane.api.list("Federated" + kind)
-            fed_keys = {(o.namespace, o.name) for o in fed_objs}
-            for cname, api in list(self.plane.members.items()):
-                if cname not in ready:
-                    continue
-                for obj in fed_objs:
-                    self._ensure(cname, api, kind, obj)
-                # remove member copies whose federated parent is gone —
-                # only ones this controller owns (the managed annotation)
-                for existing in api.list(kind)[0]:
-                    if (existing.namespace, existing.name) in fed_keys:
-                        continue
-                    if getattr(existing, "annotations", {}).get(
-                            MANAGED_ANNOTATION) == "true":
-                        try:
-                            api.delete(kind, existing.namespace,
-                                       existing.name)
-                        except NotFound:
-                            pass
-
-    def _want(self, obj):
-        want = dataclasses.replace(obj, resource_version=0)
-        want.data = dict(obj.data)  # payload copied VERBATIM, no marker
-        want.annotations = {**getattr(obj, "annotations", {}),
-                            MANAGED_ANNOTATION: "true"}
-        return want
-
-    def _ensure(self, cname: str, api: ApiServerLite, kind: str,
-                obj) -> None:
-        want = self._want(obj)
-        try:
-            cur = api.get(kind, obj.namespace, obj.name)
-        except NotFound:
-            try:
-                api.create(kind, want)
-            except Conflict:
-                pass
-            return
-        if getattr(cur, "annotations", {}).get(MANAGED_ANNOTATION) \
-                != "true":
-            # member-local object of the same name: NEVER adopt it — an
-            # overwrite here would later be deleted as "managed",
-            # destroying data federation never owned
-            self.conflicts.append(
-                f"{cname}/{kind}/{obj.namespace}/{obj.name}")
-            return
-        # drift on ANY mutable field (data, annotations, Secret type):
-        # compare the full wire form modulo resourceVersion
-        from kubernetes_tpu.api import wire
-        want_enc = wire.encode(want)
-        cur_enc = wire.encode(cur)
-        want_enc.pop("resource_version", None)
-        cur_enc.pop("resource_version", None)
-        if cur_enc != want_enc:
-            api.update(kind, dataclasses.replace(
-                want, resource_version=cur.resource_version))
+            propagate_kind(self.plane, self.conflicts,
+                           "Federated" + kind, kind)

@@ -452,3 +452,76 @@ def test_federated_configmap_secret_propagation():
     with _pytest.raises(NotFound):
         east.get("ConfigMap", "default", "settings")
     assert east.get("ConfigMap", "default", "local-only").data["k"] == "v"
+
+
+def test_federated_daemonset_everywhere():
+    """federatedtypes/daemonset.go: no replica planning — the DaemonSet
+    lands in every ready cluster, drift reconciles, managed copies go
+    with the parent."""
+    from kubernetes_tpu.api.types import make_pod
+    from kubernetes_tpu.api.workloads import DaemonSet
+    from kubernetes_tpu.federation.controller import (
+        FEDERATED_DS_KIND,
+        FederatedDaemonSetController,
+    )
+
+    plane = FederationControlPlane()
+    east, west = ApiServerLite(), ApiServerLite()
+    plane.join("east", east)
+    plane.join("west", west)
+    ds = DaemonSet("logger", "default",
+                   template=make_pod("", labels={"app": "log"}, cpu=10))
+    plane.api.create(FEDERATED_DS_KIND, ds)
+    ctrl = FederatedDaemonSetController(plane)
+    ctrl.sync_all()
+    for member in (east, west):
+        got = member.get("DaemonSet", "default", "logger")
+        assert got.annotations["federation.kubernetes.io/managed"] == "true"
+    # member status fields do NOT count as drift
+    cur = east.get("DaemonSet", "default", "logger")
+    cur.desired_scheduled = 5
+    east.update("DaemonSet", cur)
+    rv_before = east.get("DaemonSet", "default", "logger").resource_version
+    ctrl.sync_all()
+    assert east.get("DaemonSet", "default",
+                    "logger").resource_version == rv_before
+    # parent deletion removes managed copies
+    plane.api.delete(FEDERATED_DS_KIND, "default", "logger")
+    ctrl.sync_all()
+    import pytest as _pytest
+
+    from kubernetes_tpu.server.apiserver_lite import NotFound
+    with _pytest.raises(NotFound):
+        east.get("DaemonSet", "default", "logger")
+
+
+def test_federated_daemonset_never_adopts_local():
+    """The shared propagation body's conflict guard applies to DaemonSets
+    too: a member-local DaemonSet colliding with a federated one is
+    neither overwritten nor later deleted."""
+    from kubernetes_tpu.api.types import make_pod
+    from kubernetes_tpu.api.workloads import DaemonSet
+    from kubernetes_tpu.federation.controller import (
+        FEDERATED_DS_KIND,
+        FederatedDaemonSetController,
+    )
+
+    plane = FederationControlPlane()
+    east = ApiServerLite()
+    plane.join("east", east)
+    east.create("DaemonSet", DaemonSet(
+        "logger", "default",
+        template=make_pod("", labels={"local": "yes"}, cpu=5)))
+    plane.api.create(FEDERATED_DS_KIND, DaemonSet(
+        "logger", "default",
+        template=make_pod("", labels={"fed": "yes"}, cpu=10)))
+    ctrl = FederatedDaemonSetController(plane)
+    ctrl.sync_all()
+    local = east.get("DaemonSet", "default", "logger")
+    assert local.template.labels == {"local": "yes"}  # untouched
+    assert "east/DaemonSet/default/logger" in ctrl.conflicts
+    plane.api.delete(FEDERATED_DS_KIND, "default", "logger")
+    ctrl.sync_all()
+    # local object survives the parent deletion
+    assert east.get("DaemonSet", "default", "logger").template.labels \
+        == {"local": "yes"}

@@ -389,3 +389,17 @@ def test_describe_node_shows_cluster_scoped_events():
     assert kt.run(["describe", "node", "n1"]) == 0
     text = out.getvalue()
     assert "Events:" in text and "NodeNotReady" in text
+
+
+def test_top_pods():
+    api, kt, out = make_cli()
+    p = make_pod("web", cpu=150, memory=1 << 20)
+    p.node_name = "n1"
+    p.annotations["bench/actual-mem"] = str(64 << 20)
+    api.store.create("Pod", p)
+    api.store.create("Pod", make_pod("pending", cpu=10, memory=1 << 20))
+    assert kt.run(["top", "pods"]) == 0
+    text = out.getvalue()
+    assert "web  150m" in text and str(64 << 20) in text
+    assert "pending" not in text  # no metrics for unscheduled pods
+    assert kt.run(["top", "bogus"]) == 1
