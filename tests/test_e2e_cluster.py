@@ -1,0 +1,131 @@
+"""The cluster-in-a-box e2e: every layer in one scenario.
+
+The shape of test/e2e (framework.go creates the env, specs drive user
+workflows): bootstrap with ktadm, join nodes over the token flow, run
+the controller manager + scheduler + hollow kubelets, then act as a
+user — apply a Deployment through ktctl, watch pods go Running, scale,
+read logs through the kubelet API, and tear down — asserting the state
+every layer should converge to.
+"""
+
+import io
+import os
+
+from kubernetes_tpu.api.types import make_node
+from kubernetes_tpu.auth.authn import Credential
+from kubernetes_tpu.cli.ktadm import KtAdm, ca_hash
+from kubernetes_tpu.cli.ktctl import Ktctl
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.nodes.kubelet import HollowKubelet
+
+Mi = 1 << 20
+Gi = 1 << 30
+
+
+def test_cluster_in_a_box(tmp_path):
+    # ---- control plane bootstrap (ktadm init) --------------------------
+    adm = KtAdm(out=io.StringIO())
+    cluster = adm.init(str(tmp_path / "cluster"))
+    api = cluster.api
+
+    # ---- two workers join over the bootstrap-token flow ----------------
+    kubelets = {}
+    for i in range(2):
+        name = f"worker-{i}"
+        cred = adm.join(cluster, name, cluster.token,
+                        ca_cert_hash=ca_hash(cluster.ca_key))
+        node = api.get("Node", "", name, cred=cluster.admin_cred)
+        kubelets[name] = HollowKubelet(api.store, node)
+
+    # ---- controllers + scheduler against the same store ----------------
+    factory = SharedInformerFactory(api.store)
+    dep_ctrl = DeploymentController(api.store, factory,
+                                    record_events=False)
+    rs_ctrl = ReplicaSetController(api.store, factory,
+                                   record_events=False)
+    factory.start()
+    sched = Scheduler(api.store)
+    sched.start()
+
+    # ---- user: apply a Deployment manifest through ktctl ---------------
+    out = io.StringIO()
+    kt = Ktctl(api, out=out, cred=cluster.admin_cred, kubelets=kubelets)
+    manifest = tmp_path / "web.yaml"
+    manifest.write_text("""
+kind: Deployment
+name: web
+namespace: default
+replicas: 4
+selector:
+  match_labels: {app: web}
+template:
+  name: ""
+  namespace: default
+  labels: {app: web}
+  containers:
+  - name: app
+    requests: {cpu: 100, memory: 1048576}
+  annotations:
+    bench/log-lines: "booting\\nserving"
+""")
+    assert kt.run(["apply", "-f", str(manifest)]) == 0
+
+    # ---- converge: controllers stamp pods, scheduler binds, kubelets run
+    for _ in range(10):
+        factory.step_all()
+        dep_ctrl.pump()
+        rs_ctrl.pump()
+        sched.run_until_drained()
+        for name, kl in kubelets.items():
+            for p in api.store.list("Pod")[0]:
+                if p.node_name == name:
+                    kl.handle_pod(p)
+            kl.workers.drain()
+            kl.step()
+        pods = [p for p in api.store.list("Pod")[0]
+                if p.labels.get("app") == "web"]
+        if len(pods) == 4 and all(p.node_name for p in pods):
+            break
+    pods = [p for p in api.store.list("Pod")[0]
+            if p.labels.get("app") == "web"]
+    assert len(pods) == 4
+    assert all(p.node_name in kubelets for p in pods)
+    # spread across both workers (SelectorSpread at work)
+    assert len({p.node_name for p in pods}) == 2
+
+    # ---- user: get with selectors, logs via the kubelet API ------------
+    out.truncate(0), out.seek(0)
+    assert kt.run(["get", "pods", "-l", "app=web", "-o", "name"]) == 0
+    assert len(out.getvalue().split()) == 4
+    out.truncate(0), out.seek(0)
+    assert kt.run(["logs", pods[0].name, "--tail", "1"]) == 0
+    assert out.getvalue().strip() == "serving"
+
+    # ---- user: scale down; the stack converges again -------------------
+    assert kt.run(["scale", "deploy", "web", "--replicas", "1"]) == 0
+    for _ in range(10):
+        factory.step_all()
+        dep_ctrl.pump()
+        rs_ctrl.pump()
+        sched.run_until_drained()
+        alive = [p for p in api.store.list("Pod")[0]
+                 if p.labels.get("app") == "web" and not p.deleted]
+        if len(alive) == 1:
+            break
+    assert len([p for p in api.store.list("Pod")[0]
+                if p.labels.get("app") == "web" and not p.deleted]) == 1
+
+    # ---- teardown: delete through the CLI; everything drains -----------
+    assert kt.run(["delete", "deploy", "web"]) == 0
+    for _ in range(10):
+        factory.step_all()
+        dep_ctrl.pump()
+        rs_ctrl.pump()
+        if not [p for p in api.store.list("Pod")[0]
+                if p.labels.get("app") == "web" and not p.deleted]:
+            break
+    # the audit trail saw the whole session under real identities
+    assert any(e.user == "kubernetes-admin" for e in api.audit_log)
