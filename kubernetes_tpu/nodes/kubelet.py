@@ -60,8 +60,13 @@ from kubernetes_tpu.api.types import (
 from kubernetes_tpu.client.informer import SharedInformerFactory
 from kubernetes_tpu.server.apiserver_lite import ApiServerLite, Conflict, NotFound
 
-RUN_SECONDS_ANNOTATION = "bench/run-seconds"
-FAIL_ANNOTATION = "bench/fail"
+# scripted-workload annotation keys live with the runtime manager that
+# consumes them (nodes/kuberuntime.py); re-exported for compatibility
+from kubernetes_tpu.nodes.kuberuntime import (  # noqa: F401
+    FAIL_ANNOTATION,
+    RUN_SECONDS_ANNOTATION,
+)
+
 READY_AFTER_ANNOTATION = "bench/ready-after"
 LIVENESS_FAIL_AT_ANNOTATION = "bench/liveness-fail-at"
 ACTUAL_MEM_ANNOTATION = "bench/actual-mem"
@@ -267,10 +272,16 @@ class EvictionManager:
             qos = 1  # Burstable
         return (qos, -(usage - request))
 
-    def synchronize(self, admitted: Dict[str, Pod]) -> List[str]:
+    def synchronize(self, admitted: Dict[str, Pod], extra_disk: int = 0,
+                    disk_reclaim=None) -> List[str]:
         """Returns pod keys to evict, updating the pressure flags. Evicts
         greedily in rank order until the signal clears, like the manager's
-        one-eviction-per-sync loop collapsed into one pass."""
+        one-eviction-per-sync loop collapsed into one pass.
+
+        `extra_disk` is non-pod disk usage (the image filesystem);
+        `disk_reclaim(bytes)` frees node-level disk (image GC) and returns
+        bytes freed — tried BEFORE any pod is evicted, mirroring
+        eviction_manager.go reclaimNodeLevelResources."""
         mem_use = disk_use = 0
         per_pod = {}
         for key, pod in admitted.items():
@@ -298,7 +309,11 @@ class EvictionManager:
                 to_evict.append(key)
                 mem_use -= per_pod[key][0]
         if self.disk_limit:
+            disk_use += extra_disk
             self.disk_pressure = disk_use > self.disk_limit
+            if self.disk_pressure and disk_reclaim is not None:
+                disk_use -= disk_reclaim(disk_use - self.disk_limit)
+                self.disk_pressure = disk_use > self.disk_limit
             if self.disk_pressure:
                 ranked = sorted(
                     evictable.items(),
@@ -318,16 +333,37 @@ class HollowKubelet:
     def __init__(self, api: ApiServerLite, node: Node,
                  startup_latency: float = 0.0,
                  now: Callable[[], float] = time.monotonic,
-                 volume_manager=None, checkpointer=None):
+                 volume_manager=None, checkpointer=None,
+                 runtime=None):
+        from kubernetes_tpu.nodes.cri import FakeRuntimeService
+        from kubernetes_tpu.nodes.images import (
+            ImageGCManager,
+            ImageManager,
+        )
+        from kubernetes_tpu.nodes.kuberuntime import RuntimeManager
         self.api = api
         self.node_name = node.name
         self._template = node
         self._now = now
         self.startup_latency = startup_latency
-        # pod key -> ready_at (startup in flight)
+        # THE runtime boundary (nodes/cri.py; ref pkg/kubelet/apis/cri/
+        # services.go): any RuntimeService+ImageService plugs in here; the
+        # default is the scripted fake (the kubemark hollow runtime)
+        if runtime is None:
+            runtime = FakeRuntimeService(boot_latency=startup_latency,
+                                         now=now)
+        self.runtime = runtime
+        self.images = ImageManager(runtime)
+        # image fs capacity = the node's scratch disk (cadvisor
+        # ImagesFsInfo in the reference)
+        self.image_gc = ImageGCManager(
+            runtime, capacity_bytes=node.allocatable.storage_scratch)
+        self.runtime_mgr = RuntimeManager(runtime, image_manager=self.images,
+                                          now=now)
+        # pod keys whose containers are not all Running yet (startup or
+        # liveness-restart in flight); the step() loop polls the runtime
+        # for them — the PLEG relist analog (pkg/kubelet/pleg/)
         self._starting: Dict[str, float] = {}
-        # pod key -> finish_at (run-to-completion in flight)
-        self._running_until: Dict[str, float] = {}
         self._admitted: Dict[str, Pod] = {}  # local running set
         self._restarts: Dict[str, int] = {}  # pod key -> restart count
         self._ready: Dict[str, bool] = {}  # last written Ready condition
@@ -445,7 +481,10 @@ class HollowKubelet:
                 self._write_status(pod, reason="FailedMount")
                 return
         self._admitted[key] = pod
-        self._starting[key] = self._now() + self.startup_latency
+        self._starting[key] = self._now()
+        # sandbox + image pulls + container create/start, through the CRI
+        # boundary (kuberuntime SyncPod); step() polls for Running
+        self.runtime_mgr.sync_pod(pod)
         self.prober.add_pod(pod, self._now())
         rec = self._restored.pop(key, None)
         if rec is not None and rec.get("restarts"):
@@ -468,7 +507,7 @@ class HollowKubelet:
     def _forget(self, key: str) -> None:
         self._admitted.pop(key, None)
         self._starting.pop(key, None)
-        self._running_until.pop(key, None)
+        self.runtime_mgr.kill_pod(key)
         self._restarts.pop(key, None)
         self._ready.pop(key, None)
         self.workers.forget(key)
@@ -612,14 +651,23 @@ class HollowKubelet:
                     self.checkpointer.remove(pod_key)
         for pod in self._static.values():
             self._ensure_mirror(pod)
-        for key, ready_at in list(self._starting.items()):
-            if now < ready_at:
-                continue
-            del self._starting[key]
+        # ---- runtime relist for in-flight startups (the PLEG pass) ------
+        for key in list(self._starting):
             pod = self._admitted.get(key)
             if pod is None:
+                del self._starting[key]
                 continue
-            run_s = pod.annotations.get(RUN_SECONDS_ANNOTATION)
+            # one status read; execute actions only when there are any (a
+            # liveness restart leaves killed containers behind —
+            # computePodActions starts the fresh attempt here)
+            status = self.runtime_mgr.pod_status(pod)
+            actions = self.runtime_mgr.compute_pod_actions(pod, status)
+            if self.runtime_mgr.actions_needed(actions):
+                self.runtime_mgr.execute_pod_actions(pod, actions)
+                status = self.runtime_mgr.pod_status(pod)
+            if not (status.all_running or status.completed_phase):
+                continue
+            del self._starting[key]
             # a pod with a readiness probe starts NOT-ready; the probe
             # flips it (results_manager initial state)
             ready0 = not self.prober.has_readiness(key)
@@ -627,8 +675,6 @@ class HollowKubelet:
                                   restart_count=self._restarts.get(key, 0)):
                 wrote += 1
             self._ready[key] = ready0
-            if run_s is not None:
-                self._running_until[key] = now + float(run_s)
         # ---- probe workers over running pods ----------------------------
         for key, pod in list(self._admitted.items()):
             if key in self._starting:
@@ -642,23 +688,30 @@ class HollowKubelet:
                     wrote += 1
                     self._ready[key] = ready
         # ---- eviction manager -------------------------------------------
-        for key in self.eviction.synchronize({
-                k: p for k, p in self._admitted.items()
-                if k not in self._starting}):
+        for key in self.eviction.synchronize(
+                {k: p for k, p in self._admitted.items()
+                 if k not in self._starting},
+                extra_disk=self.runtime.image_fs_info(),
+                disk_reclaim=self.image_gc.free_space):
             pod = self._admitted.get(key)
             if pod is not None:
                 if self._write_status(pod, phase="Failed", reason="Evicted"):
                     wrote += 1
                 self._forget(key)
-        for key, done_at in list(self._running_until.items()):
-            if now < done_at:
+        # ---- run-to-completion: the runtime reports natural exits -------
+        for key, pod in list(self._admitted.items()):
+            if key in self._starting:
                 continue
-            del self._running_until[key]
-            pod = self._admitted.pop(key, None)
-            if pod is None:
+            # scripted runtime: only pods with a scripted exit can finish;
+            # a REAL runtime's containers can die anytime, so poll them all
+            if self.runtime.exits_are_scripted \
+                    and RUN_SECONDS_ANNOTATION not in pod.annotations:
                 continue
-            final = "Failed" if pod.annotations.get(FAIL_ANNOTATION) else "Succeeded"
-            if self._write_status(pod, phase=final):
+            status = self.runtime_mgr.pod_status(pod)
+            if not status.completed_phase:
+                continue
+            self._admitted.pop(key, None)
+            if self._write_status(pod, phase=status.completed_phase):
                 wrote += 1
         return wrote
 
@@ -672,8 +725,13 @@ class HollowKubelet:
             return 1
         self._restarts[key] = self._restarts.get(key, 0) + 1
         self._checkpoint(key)
+        # CRI kill + immediate re-sync: the fresh attempt starts NOW (with
+        # the runtime's boot latency), not one step later — keeping restart
+        # downtime and the prober's restart clock in agreement
+        self.runtime_mgr.restart_pod_containers(pod)
+        self.runtime_mgr.sync_pod(pod)
         started_at = self._now() + self.startup_latency
-        self._starting[key] = started_at
+        self._starting[key] = self._now()
         self.prober.restart(pod, started_at)
         wrote = 0
         # pod goes unready while the container restarts
