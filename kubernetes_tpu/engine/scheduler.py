@@ -89,6 +89,10 @@ class Scheduler:
         self._gang_parked_at: Dict[str, float] = {}
         self._rv = 0
         self._pods: Dict[str, Pod] = {}  # last-seen apiserver pod state
+        # pod key -> wall-clock instant first seen unscheduled: the start
+        # of the honest create->bound latency (always time.monotonic, even
+        # when self._now is a fake test clock — latency is wall time)
+        self._first_queued: Dict[str, float] = {}
         self._started = False
 
     # ------------------------------------------------------------ lifecycle
@@ -118,6 +122,7 @@ class Scheduler:
             if p.node_name:
                 self.cache.add_pod(p)
             elif self._responsible_for(p):
+                self._first_queued.setdefault(p.key(), time.monotonic())
                 self.queue.add(dataclasses.replace(p))
         self._rv = rv
         self._started = True
@@ -162,6 +167,7 @@ class Scheduler:
         self.sync()
         trace.step("informer sync done")
         pods = self.queue.pop_batch(max_n=max_batch, wait=wait)
+        pop_ts = time.monotonic()  # NextPod-pop instant (scheduler.go:289)
         stats = {"popped": len(pods), "bound": 0, "unschedulable": 0,
                  "bind_errors": 0, "preemptions": 0}
         # gang (coscheduling) gating: pods in a group schedule atomically
@@ -240,10 +246,6 @@ class Scheduler:
                                                 mode=self.batch_mode))
         t_alg = time.monotonic() - t0
         trace.step("batch placement computed (device)")
-        # amortize over pods actually SCHEDULED this round (parked gang
-        # members were popped but not placed; counting them would
-        # understate the per-pod latency histograms)
-        per_pod_alg = t_alg / max(scheduled_count, 1)
         placed = []
         unschedulable_pods = []
         for r in results:
@@ -263,7 +265,8 @@ class Scheduler:
         errs = self.api.bind_many(
             [Binding(r.pod.name, r.pod.namespace, r.pod.uid, r.node_name)
              for r in placed])
-        per_bind = (time.monotonic() - tb0) / max(len(placed), 1)
+        bind_done = time.monotonic()
+        t_bind = bind_done - tb0
         bound_pods = []
         for r, err in zip(placed, errs):
             if err is not None:
@@ -286,9 +289,18 @@ class Scheduler:
             stats["preemptions"] = self._preempt_round(unschedulable_pods)
         n = len(bound_pods)
         self.metrics.scheduled.inc(n)
-        self.metrics.algorithm_latency.observe_many(per_pod_alg, n)
-        self.metrics.binding_latency.observe_many(per_bind, n)
-        self.metrics.e2e_latency.observe_many(per_pod_alg + per_bind, n)
+        # honest spans (not amortized t/n): every pod in the batch really
+        # waited the FULL algorithm span and the FULL binding span — its
+        # placement was not done until the round's was. e2e matches the
+        # reference's pop->bind-complete window (scheduler.go:289)
+        self.metrics.algorithm_latency.observe_many(t_alg, n)
+        self.metrics.binding_latency.observe_many(t_bind, n)
+        self.metrics.e2e_latency.observe_many(bind_done - pop_ts, n)
+        # per-pod create->bound, queue wait + backoff rounds included:
+        # distinct value per pod, the distribution the SLO check reads
+        self.metrics.create_to_bound.observe_batch(
+            [bind_done - self._first_queued.pop(p.key(), pop_ts)
+             for p in bound_pods])
         self.cache.cleanup_assumed()
         self.queue.backoff.gc()
         # per-pod amortized threshold: a 30k-pod round is not "slow" the way
@@ -426,6 +438,7 @@ class Scheduler:
             waiting.pop(key, None)
         if etype == "DELETED":
             self._pods.pop(key, None)
+            self._first_queued.pop(key, None)
             self.queue.remove(key)
             if prev is not None and prev.node_name:
                 self.cache.remove_pod(prev)
@@ -435,12 +448,15 @@ class Scheduler:
             if pod.node_name:
                 self.cache.add_pod(pod)
             elif self._responsible_for(pod):
+                self._first_queued.setdefault(key, time.monotonic())
                 self.queue.add(dataclasses.replace(pod))
             return
         # MODIFIED
         was_bound = prev is not None and bool(prev.node_name)
         if not was_bound and pod.node_name:
             self.queue.remove(key)
+            self._first_queued.pop(key, None)  # bound (possibly by a
+            # foreign scheduler); our own binds already harvested it
             self.cache.add_pod(pod)  # confirms our assume, or records a
             # foreign scheduler's bind (cache.go:214)
         elif was_bound and pod.node_name:
@@ -448,10 +464,12 @@ class Scheduler:
         elif was_bound and not pod.node_name:
             self.cache.remove_pod(prev)
             if self._responsible_for(pod):
+                self._first_queued.setdefault(key, time.monotonic())
                 self.queue.add(dataclasses.replace(pod))
         else:
             self.queue.remove(key)
             if self._responsible_for(pod):
+                self._first_queued.setdefault(key, time.monotonic())
                 self.queue.add(dataclasses.replace(pod))
 
     def _relist(self) -> None:
@@ -471,6 +489,13 @@ class Scheduler:
         self._gang_parked_at = {}
         self._started = False
         self.start()
+        # prune create->bound stamps for pods that bound or vanished
+        # during the watch blackout (their terminal event is exactly what
+        # the log compaction lost) — a stale stamp would otherwise inflate
+        # a later reschedule's sample, or leak forever
+        self._first_queued = {
+            k: t for k, t in self._first_queued.items()
+            if k in self._pods and not self._pods[k].node_name}
 
     def _event(self, pod: Pod, etype: str, reason: str, message: str) -> None:
         if not self.record_events:
