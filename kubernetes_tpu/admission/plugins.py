@@ -8,7 +8,9 @@ use informers/listers — same data, same freshness model in-process).
 from __future__ import annotations
 
 import copy
-from typing import Dict, Optional
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
 from kubernetes_tpu.admission.chain import (
     AdmissionRequest,
@@ -452,3 +454,206 @@ class ResourceQuotaPlugin(_StorePlugin):
                 return
             except Conflict:
                 continue
+
+
+# ---------------------------------------------------------------------------
+# round-5 sweep: the remaining static plugins of plugin/pkg/admission/
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodPreset:
+    """settings.k8s.io PodPreset, reduced to the injection surface this
+    model carries: annotations to merge and volumes to append into pods
+    matched by a label selector (plugin/pkg/admission/podpreset/admission.go
+    injects env/envFrom/volumes/volumeMounts; env lives in annotations
+    here)."""
+
+    name: str
+    namespace: str = "default"
+    selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    volumes: List = dataclasses.field(default_factory=list)
+    resource_version: int = 0
+    deleted: bool = False
+
+
+class PodPresetPlugin(_StorePlugin):
+    """plugin/pkg/admission/podpreset: merge matching presets into pods at
+    CREATE. Reference conflict semantics (admission.go mergePodPresets):
+    ANY conflict across the matched presets aborts injection entirely —
+    the pod is admitted unmodified, never rejected. Applied presets are
+    recorded as podpreset.admission.kubernetes.io/podpreset-<name>
+    annotations, like the reference's bookkeeping stamp."""
+
+    STAMP_PREFIX = "podpreset.admission.kubernetes.io/podpreset-"
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.operation == CREATE and req.kind == "Pod"
+
+    def admit(self, req: AdmissionRequest) -> None:
+        pod: Pod = req.obj
+        try:
+            presets, _ = self.store.list("PodPreset")
+        except Exception:
+            return
+        matched = [
+            p for p in presets
+            if p.namespace == req.namespace
+            and all(pod.labels.get(k) == v for k, v in p.selector.items())]
+        if not matched:
+            return
+        new_ann: Dict[str, str] = {}
+        new_vols = []
+        vol_names = {v.name for v in pod.volumes}
+        for p in matched:
+            for k, v in p.annotations.items():
+                if pod.annotations.get(k, v) != v or new_ann.get(k, v) != v:
+                    return  # conflict: skip ALL presets, admit unmodified
+                new_ann[k] = v
+            for vol in p.volumes:
+                if vol.name in vol_names:
+                    return  # volume-name conflict
+                vol_names.add(vol.name)
+                new_vols.append(vol)
+        pod.annotations.update(new_ann)
+        pod.volumes.extend(new_vols)
+        for p in matched:
+            pod.annotations[self.STAMP_PREFIX + p.name] = \
+                str(p.resource_version)
+
+
+class LimitPodHardAntiAffinityTopology:
+    """plugin/pkg/admission/antiaffinity: deny pods whose REQUIRED pod
+    anti-affinity uses a topology key other than kubernetes.io/hostname —
+    a hard zone/region anti-affinity lets one pod fence whole failure
+    domains (admission.go checkPodsWithAntiAffinityTerm)."""
+
+    HOSTNAME = "kubernetes.io/hostname"
+
+    def set_store(self, store) -> None:
+        pass
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.kind == "Pod" and req.operation in (CREATE, UPDATE)
+
+    def admit(self, req: AdmissionRequest) -> None:
+        pod: Pod = req.obj
+        aff = pod.affinity
+        if aff is None or aff.pod_anti_affinity is None:
+            return
+        for term in aff.pod_anti_affinity.required_terms:
+            if term.topology_key and term.topology_key != self.HOSTNAME:
+                raise Rejected(
+                    "affinity.podAntiAffinity."
+                    "requiredDuringSchedulingIgnoredDuringExecution with "
+                    f"topologyKey {term.topology_key!r} is not allowed "
+                    f"(only {self.HOSTNAME})")
+
+
+class DenyEscalatingExec(_StorePlugin):
+    """plugin/pkg/admission/exec DenyEscalatingExec: block exec/attach
+    CONNECTs into pods that escalate to the host (privileged containers;
+    host-network stands in for the reference's hostPID/hostIPC checks —
+    the host axes this pod model carries)."""
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        from kubernetes_tpu.admission.chain import CONNECT
+        return req.operation == CONNECT \
+            and req.subresource in ("exec", "attach")
+
+    def admit(self, req: AdmissionRequest) -> None:
+        pod = req.obj
+        if pod is None:
+            pod = self._get("Pod", req.namespace, req.name)
+        if pod is None:
+            return
+        if getattr(pod, "host_network", False):
+            raise Rejected(
+                "cannot exec into or attach to a container using host "
+                "network")
+        for c in getattr(pod, "containers", []):
+            sc = c.security_context
+            if sc is not None and sc.privileged:
+                raise Rejected(
+                    "cannot exec into or attach to a privileged container")
+
+
+class OwnerReferencesPermissionEnforcement:
+    """plugin/pkg/admission/gc: setting or changing ownerReferences
+    requires delete permission on the object — otherwise any writer could
+    mark an object for cascade deletion by a controller they don't own
+    (gc_admission.go Admit)."""
+
+    def __init__(self, authorize=None):
+        # authorize(user, verb, kind, namespace) -> bool; None = allow all
+        # (the plugin is inert without an authorizer, like the reference
+        # wired without RBAC)
+        self._authorize = authorize
+
+    def set_store(self, store) -> None:
+        pass
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.operation in (CREATE, UPDATE)
+
+    @staticmethod
+    def _owner(obj) -> tuple:
+        return (getattr(obj, "owner_kind", ""),
+                getattr(obj, "owner_name", ""))
+
+    def admit(self, req: AdmissionRequest) -> None:
+        if self._authorize is None:
+            return
+        new_owner = self._owner(req.obj)
+        if req.operation == CREATE:
+            changed = new_owner != ("", "")
+        else:
+            changed = req.old_obj is not None \
+                and new_owner != self._owner(req.old_obj)
+        if not changed:
+            return
+        if not self._authorize(req.user, "delete", req.kind, req.namespace):
+            raise Rejected(
+                f"cannot set an ownerReference on a {req.kind} without "
+                f"delete permission")
+
+
+class PersistentVolumeLabel(_StorePlugin):
+    """plugin/pkg/admission/persistentvolume/label PersistentVolumeLabel:
+    stamp cloud zone/region failure-domain labels onto EBS/GCE-PD PVs at
+    CREATE so the VolumeZone predicate can enforce them (admission.go
+    findVolumeLabels via the cloud's disk API)."""
+
+    CLOUD_KINDS = ("GCEPersistentDisk", "AWSElasticBlockStore", "AzureDisk")
+
+    def __init__(self, cloud=None):
+        self.cloud = cloud
+
+    def handles(self, req: AdmissionRequest) -> bool:
+        return req.operation == CREATE and req.kind == "PersistentVolume"
+
+    def admit(self, req: AdmissionRequest) -> None:
+        pv = req.obj
+        if self.cloud is None or pv.source.kind.value not in self.CLOUD_KINDS:
+            return
+        zone_of = getattr(self.cloud, "disk_zone", None)
+        if zone_of is None:
+            return
+        zr = zone_of(pv.source.volume_id)
+        if zr is None:
+            # the reference plugin errors when the cloud can't find the
+            # volume (admission.go findVolumeLabels) — stamping a made-up
+            # zone would let VolumeZone schedule against fiction
+            raise Rejected(
+                f"error querying volume {pv.source.volume_id!r}: "
+                f"disk not found in cloud provider")
+        zone, region = zr
+        from kubernetes_tpu.ops.oracle_ext import (
+            ZONE_LABEL,
+            ZONE_REGION_LABEL,
+        )
+        # admission labels win over client-supplied ones (the reference
+        # overwrites: the cloud is authoritative about where a disk lives)
+        pv.labels[ZONE_LABEL] = zone
+        pv.labels[ZONE_REGION_LABEL] = region

@@ -123,6 +123,9 @@ class FakeCloud(CloudProvider):
         self.calls: List[str] = []
         self._next_ip = 1
         self.disks: Dict[str, int] = {}  # volume_id -> size_gb
+        # volume_id -> (zone, region); what PersistentVolumeLabel admission
+        # reads (plugin/pkg/admission/persistentvolume/label)
+        self.disk_zones: Dict[str, Tuple[str, str]] = {}
         self.attachments: Dict[str, str] = {}  # volume_id -> node
         # per-node attachable-disk ceiling (the cloud-side analog of the
         # MaxPDVolumeCount predicate defaults)
@@ -197,9 +200,18 @@ class FakeCloud(CloudProvider):
     def has_disks(self) -> bool:
         return True
 
-    def create_disk(self, volume_id: str, size_gb: int = 10) -> None:
+    def create_disk(self, volume_id: str, size_gb: int = 10,
+                    zone: str = "zone-a", region: str = "region-1") -> None:
         with self._lock:
             self.disks[volume_id] = size_gb
+            self.disk_zones[volume_id] = (zone, region)
+
+    def disk_zone(self, volume_id: str) -> Optional[Tuple[str, str]]:
+        """Where the disk lives — the cloud's authoritative answer the PV
+        label admission stamps onto PVs. None for a disk this cloud never
+        created (the reference plugin errors rather than fabricate a
+        zone)."""
+        return self.disk_zones.get(volume_id)
 
     def delete_disk(self, volume_id: str) -> None:
         with self._lock:
@@ -208,6 +220,7 @@ class FakeCloud(CloudProvider):
                     f"disk {volume_id!r} is attached to "
                     f"{self.attachments[volume_id]!r}")
             self.disks.pop(volume_id, None)
+            self.disk_zones.pop(volume_id, None)
 
     def _validate_attach_locked(self, volume_id: str) -> None:
         """Flavor hook, called UNDER self._lock so existence checks cannot

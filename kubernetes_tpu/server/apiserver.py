@@ -379,16 +379,23 @@ class ApiServer:
         return self._run(cred, "get", kind, namespace, name, do)
 
     def list(self, kind: str, cred: Optional[Credential] = None,
-             namespace: str = "", field_selector: str = ""):
+             namespace: str = "", field_selector: str = "",
+             include_uninitialized: bool = False):
         """namespace="" = cluster-wide list (needs cluster-wide authority);
         a namespace scopes both the RBAC check and the result set, like the
         namespaced list endpoints. field_selector is the apimachinery
         fields axis ("spec.nodeName=n1,status.phase!=Failed") applied
-        through the per-kind GetAttrs (api/fields.py)."""
+        through the per-kind GetAttrs (api/fields.py).
+        include_uninitialized=False hides objects with pending initializers
+        (the ?includeUninitialized=true list knob of the 1.7 alpha
+        initializers feature)."""
 
         def do(user: UserInfo):
             self._serving_info(kind)
             objs, rv = self.store.list(kind)
+            if not include_uninitialized:
+                from kubernetes_tpu.admission.webhook import is_uninitialized
+                objs = [o for o in objs if not is_uninitialized(o)]
             if namespace:
                 objs = [o for o in objs
                         if getattr(o, "namespace", "") == namespace]

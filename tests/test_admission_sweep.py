@@ -1,0 +1,186 @@
+"""Chain-level tests for the round-5 static-plugin sweep: podpreset,
+antiaffinity, exec, gc, persistentvolume (plugin/pkg/admission/<dir>
+analogs in admission/plugins.py), each driven through a real
+AdmissionChain + ApiServer where the operation exists."""
+
+import pytest
+
+from kubernetes_tpu.admission.chain import (
+    AdmissionChain,
+    AdmissionRequest,
+    CONNECT,
+    Rejected,
+)
+from kubernetes_tpu.admission.plugins import (
+    DenyEscalatingExec,
+    LimitPodHardAntiAffinityTopology,
+    OwnerReferencesPermissionEnforcement,
+    PersistentVolumeLabel,
+    PodPreset,
+    PodPresetPlugin,
+)
+from kubernetes_tpu.api.rbac import UserInfo
+from kubernetes_tpu.api.types import (
+    Affinity,
+    PersistentVolume,
+    PodAffinity,
+    PodAffinityTerm,
+    SecurityContext,
+    Volume,
+    VolumeKind,
+    make_pod,
+)
+from kubernetes_tpu.cloud.provider import FakeCloud
+from kubernetes_tpu.ops.oracle_ext import ZONE_LABEL, ZONE_REGION_LABEL
+from kubernetes_tpu.server.apiserver import ApiServer
+
+
+def mk_server(*plugins):
+    api = ApiServer()
+    api.admission = AdmissionChain(list(plugins), store=api.store)
+    return api
+
+
+# ------------------------------------------------------------- podpreset
+
+
+def test_podpreset_injects_into_matching_pods():
+    api = mk_server(PodPresetPlugin())
+    api.store.create("PodPreset", PodPreset(
+        name="env", selector={"app": "web"},
+        annotations={"preset/DB_HOST": "db.local"},
+        volumes=[Volume(name="cache")]))
+    pod = make_pod("p", cpu=10, labels={"app": "web"})
+    api.create("Pod", pod)
+    stored = api.store.get("Pod", "default", "p")
+    assert stored.annotations["preset/DB_HOST"] == "db.local"
+    assert any(v.name == "cache" for v in stored.volumes)
+    # the applied preset is stamped (reference bookkeeping annotation)
+    assert stored.annotations[
+        "podpreset.admission.kubernetes.io/podpreset-env"] != ""
+    # non-matching pod untouched
+    other = make_pod("q", cpu=10, labels={"app": "api"})
+    api.create("Pod", other)
+    assert "preset/DB_HOST" not in \
+        api.store.get("Pod", "default", "q").annotations
+
+
+def test_podpreset_conflict_skips_all_presets_without_rejecting():
+    api = mk_server(PodPresetPlugin())
+    api.store.create("PodPreset", PodPreset(
+        name="a", selector={"app": "web"},
+        annotations={"preset/KEY": "from-a"}))
+    api.store.create("PodPreset", PodPreset(
+        name="b", selector={"app": "web"},
+        annotations={"preset/KEY": "from-b"}))  # conflicting value
+    pod = make_pod("p", cpu=10, labels={"app": "web"})
+    api.create("Pod", pod)  # admitted, NOT rejected
+    stored = api.store.get("Pod", "default", "p")
+    assert "preset/KEY" not in stored.annotations  # nothing injected
+    assert not any(k.startswith("podpreset.admission")
+                   for k in stored.annotations)
+
+
+# ----------------------------------------------------------- antiaffinity
+
+
+def test_hard_antiaffinity_topology_limited_to_hostname():
+    api = mk_server(LimitPodHardAntiAffinityTopology())
+    ok = make_pod("ok", cpu=10)
+    ok.affinity = Affinity(pod_anti_affinity=PodAffinity(required_terms=[
+        PodAffinityTerm(topology_key="kubernetes.io/hostname")]))
+    api.create("Pod", ok)
+    bad = make_pod("bad", cpu=10)
+    bad.affinity = Affinity(pod_anti_affinity=PodAffinity(required_terms=[
+        PodAffinityTerm(
+            topology_key="failure-domain.beta.kubernetes.io/zone")]))
+    with pytest.raises(Rejected) as e:
+        api.create("Pod", bad)
+    assert "topologyKey" in str(e.value)
+
+
+# ------------------------------------------------------------------ exec
+
+
+def test_deny_escalating_exec():
+    chain = AdmissionChain([DenyEscalatingExec()])
+    priv = make_pod("priv", cpu=10)
+    priv.containers[0].security_context = SecurityContext(privileged=True)
+    with pytest.raises(Rejected):
+        chain.admit(AdmissionRequest(CONNECT, "Pod", "default", "priv",
+                                     obj=priv, subresource="exec"))
+    hostnet = make_pod("hn", cpu=10)
+    hostnet.host_network = True
+    with pytest.raises(Rejected):
+        chain.admit(AdmissionRequest(CONNECT, "Pod", "default", "hn",
+                                     obj=hostnet, subresource="attach"))
+    # plain pod execs fine; non-exec subresources are not handled
+    chain.admit(AdmissionRequest(CONNECT, "Pod", "default", "ok",
+                                 obj=make_pod("ok", cpu=10),
+                                 subresource="exec"))
+    chain.admit(AdmissionRequest(CONNECT, "Pod", "default", "priv",
+                                 obj=priv, subresource="portforward"))
+
+
+# -------------------------------------------------------------------- gc
+
+
+def test_owner_references_need_delete_permission():
+    def authorize(user, verb, kind, namespace):
+        return user is not None and user.name == "controller"
+
+    chain = AdmissionChain([OwnerReferencesPermissionEnforcement(authorize)])
+    owned = make_pod("p", cpu=10, owner=("ReplicaSet", "rs-1"))
+    with pytest.raises(Rejected):
+        chain.admit(AdmissionRequest(
+            "CREATE", "Pod", "default", "p", obj=owned,
+            user=UserInfo("mallory")))
+    # the rightful controller may set owner refs
+    chain.admit(AdmissionRequest(
+        "CREATE", "Pod", "default", "p", obj=owned,
+        user=UserInfo("controller")))
+    # updates that do NOT touch owner refs pass for anyone
+    old = make_pod("q", cpu=10, owner=("ReplicaSet", "rs-1"))
+    new = make_pod("q", cpu=10, owner=("ReplicaSet", "rs-1"))
+    new.labels["x"] = "y"
+    chain.admit(AdmissionRequest(
+        "UPDATE", "Pod", "default", "q", obj=new, old_obj=old,
+        user=UserInfo("mallory")))
+    # updates that CHANGE owner refs are gated
+    stolen = make_pod("q", cpu=10, owner=("ReplicaSet", "rs-2"))
+    with pytest.raises(Rejected):
+        chain.admit(AdmissionRequest(
+            "UPDATE", "Pod", "default", "q", obj=stolen, old_obj=old,
+            user=UserInfo("mallory")))
+
+
+# -------------------------------------------------- persistentvolume/label
+
+
+def test_persistent_volume_label_stamps_cloud_zone():
+    cloud = FakeCloud()
+    cloud.create_disk("disk-1", zone="zone-b", region="region-2")
+    api = mk_server(PersistentVolumeLabel(cloud))
+    pv = PersistentVolume(
+        name="pv-1", source=Volume(kind=VolumeKind.GCE_PD,
+                                   volume_id="disk-1"),
+        labels={ZONE_LABEL: "client-lie"})
+    api.create("PersistentVolume", pv)
+    stored = api.store.get("PersistentVolume", "", "pv-1")
+    # the cloud is authoritative: the client-supplied zone is overwritten
+    assert stored.labels[ZONE_LABEL] == "zone-b"
+    assert stored.labels[ZONE_REGION_LABEL] == "region-2"
+    # non-cloud PVs untouched
+    nfs = PersistentVolume(name="pv-2",
+                           source=Volume(kind=VolumeKind.OTHER,
+                                         volume_id="srv:/export"))
+    api.create("PersistentVolume", nfs)
+    assert ZONE_LABEL not in api.store.get(
+        "PersistentVolume", "", "pv-2").labels
+    # a PV referencing a disk the cloud never made is rejected, not
+    # stamped with a fabricated zone
+    ghost = PersistentVolume(name="pv-3",
+                             source=Volume(kind=VolumeKind.GCE_PD,
+                                           volume_id="no-such-disk"))
+    with pytest.raises(Rejected):
+        api.create("PersistentVolume", ghost)

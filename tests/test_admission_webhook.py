@@ -1,0 +1,261 @@
+"""Dynamic admission: webhook + imagepolicy + initializers
+(admission/webhook.py), driven through the REAL ApiServer chain against an
+in-process HTTP backend — the shape of tests/test_extender_http.py and the
+reference's httptest-backed webhook admission tests
+(plugin/pkg/admission/webhook/admission_test.go,
+imagepolicy/admission_test.go)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.admission.chain import AdmissionChain, Rejected
+from kubernetes_tpu.admission.webhook import (
+    AdmissionHookConfiguration,
+    GenericAdmissionWebhook,
+    ImagePolicyWebhook,
+    InitializerConfiguration,
+    Initializers,
+    PENDING_INITIALIZERS_ANNOTATION,
+    Rule,
+    WebhookHook,
+    is_uninitialized,
+    remove_initializer,
+)
+from kubernetes_tpu.api.types import make_pod
+from kubernetes_tpu.server.apiserver import ApiServer
+
+
+class WebhookBackend:
+    """Scriptable admission backend. `decide(review) -> response dict`."""
+
+    def __init__(self, decide):
+        self.decide = decide
+        self.reviews = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                review = json.loads(self.rfile.read(length))
+                outer.reviews.append(review)
+                body = json.dumps(outer.decide(review)).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}/admit"
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def mk_server(*plugins):
+    api = ApiServer()
+    api.admission = AdmissionChain(list(plugins), store=api.store)
+    return api
+
+
+# ------------------------------------------------------------- webhook
+
+
+def test_validating_webhook_denies_through_the_chain():
+    backend = WebhookBackend(lambda review: {
+        "response": {"allowed":
+                     "forbidden" not in review["request"]["name"],
+                     "status": {"message": "name is forbidden"}}})
+    try:
+        hook = WebhookHook(name="name-police", url=backend.url,
+                           rules=[Rule(operations=["CREATE"],
+                                       kinds=["Pod"])])
+        api = mk_server(GenericAdmissionWebhook([hook]))
+        api.create("Pod", make_pod("ok-pod", cpu=10))  # allowed
+        with pytest.raises(Rejected) as e:
+            api.create("Pod", make_pod("forbidden-pod", cpu=10))
+        assert "name-police" in str(e.value)
+        assert "name is forbidden" in str(e.value)
+        # the denied pod never reached storage
+        assert [p.name for p in api.store.list("Pod")[0]] == ["ok-pod"]
+        # the review carried the serialized object + user identity keys
+        assert backend.reviews[0]["request"]["object"]["metadata"][
+            "name"] == "ok-pod"
+    finally:
+        backend.stop()
+
+
+def test_mutating_webhook_patches_the_object():
+    def decide(review):
+        obj = dict(review["request"]["object"])
+        obj["metadata"].setdefault("labels", {})["injected"] = "true"
+        return {"response": {"allowed": True, "patchedObject": obj}}
+
+    backend = WebhookBackend(decide)
+    try:
+        hook = WebhookHook(name="injector", url=backend.url, mutating=True,
+                           rules=[Rule(operations=["CREATE"],
+                                       kinds=["Pod"])])
+        api = mk_server(GenericAdmissionWebhook([hook]))
+        api.create("Pod", make_pod("p", cpu=10))
+        stored = api.store.get("Pod", "default", "p")
+        assert stored.labels.get("injected") == "true"
+    finally:
+        backend.stop()
+
+
+def test_mutating_webhook_cannot_steal_identity_or_wipe_fields():
+    """A hook's patchedObject only lands on the mutable spec surface:
+    renames/re-namespacing are ignored (identity was authorized + audited
+    already), and fields the wire encoding doesn't carry (annotations)
+    survive the round-trip instead of being wiped."""
+    def decide(review):
+        obj = dict(review["request"]["object"])
+        obj["metadata"] = dict(obj["metadata"])
+        obj["metadata"]["name"] = "evil"
+        obj["metadata"]["namespace"] = "kube-system"
+        obj["metadata"].setdefault("labels", {})["injected"] = "true"
+        return {"response": {"allowed": True, "patchedObject": obj}}
+
+    backend = WebhookBackend(decide)
+    try:
+        hook = WebhookHook(name="thief", url=backend.url, mutating=True,
+                           rules=[Rule(operations=["CREATE"],
+                                       kinds=["Pod"])])
+        api = mk_server(GenericAdmissionWebhook([hook]))
+        pod = make_pod("p", cpu=10)
+        pod.annotations["keep"] = "me"
+        api.create("Pod", pod)
+        stored = api.store.get("Pod", "default", "p")  # original identity
+        assert stored.labels.get("injected") == "true"  # mutation applied
+        assert stored.annotations.get("keep") == "me"  # nothing wiped
+        with pytest.raises(Exception):
+            api.store.get("Pod", "kube-system", "evil")
+    finally:
+        backend.stop()
+
+
+def test_failure_policy_ignore_vs_fail():
+    dead_url = "http://127.0.0.1:1/admit"  # nothing listens on port 1
+    rules = [Rule(operations=["CREATE"], kinds=["Pod"])]
+    # Ignore (the reference default): fail-open
+    api = mk_server(GenericAdmissionWebhook(
+        [WebhookHook(name="down", url=dead_url, rules=rules,
+                     failure_policy="Ignore", timeout_s=0.5)]))
+    api.create("Pod", make_pod("p1", cpu=10))
+    # Fail: fail-closed
+    api2 = mk_server(GenericAdmissionWebhook(
+        [WebhookHook(name="down", url=dead_url, rules=rules,
+                     failure_policy="Fail", timeout_s=0.5)]))
+    with pytest.raises(Rejected) as e:
+        api2.create("Pod", make_pod("p2", cpu=10))
+    assert "down" in str(e.value)
+
+
+def test_hook_configs_load_from_the_api():
+    """Hooks registered as AdmissionHookConfiguration API objects take
+    effect on subsequent requests — the dynamic half of 'dynamic
+    admission' (the reference watches admissionregistration objects)."""
+    backend = WebhookBackend(lambda review: {
+        "response": {"allowed": False, "status": {"message": "nope"}}})
+    try:
+        api = mk_server(GenericAdmissionWebhook())
+        api.create("Pod", make_pod("before", cpu=10))  # no hooks yet
+        api.store.create(
+            "AdmissionHookConfiguration",
+            AdmissionHookConfiguration(
+                name="deny-all",
+                hooks=[WebhookHook(name="deny", url=backend.url,
+                                   rules=[Rule(operations=["CREATE"],
+                                               kinds=["Pod"])])]))
+        with pytest.raises(Rejected):
+            api.create("Pod", make_pod("after", cpu=10))
+        # removing the configuration restores admission
+        api.store.delete("AdmissionHookConfiguration", "", "deny-all")
+        api.create("Pod", make_pod("after2", cpu=10))
+    finally:
+        backend.stop()
+
+
+# --------------------------------------------------------- imagepolicy
+
+
+def test_image_policy_webhook_denies_by_image():
+    backend = WebhookBackend(lambda review: {
+        "status": {"allowed": not any(
+            "evil" in c["image"]
+            for c in review["spec"]["containers"]),
+            "reason": "image on deny list"}})
+    try:
+        api = mk_server(ImagePolicyWebhook(backend.url))
+        ok = make_pod("ok", cpu=10)
+        ok.containers[0].image = "registry/app:v1"
+        api.create("Pod", ok)
+        bad = make_pod("bad", cpu=10)
+        bad.containers[0].image = "registry/evil:v1"
+        with pytest.raises(Rejected) as e:
+            api.create("Pod", bad)
+        assert "deny list" in str(e.value)
+    finally:
+        backend.stop()
+
+
+def test_image_policy_default_allow_on_backend_error():
+    dead = "http://127.0.0.1:1/review"
+    api = mk_server(ImagePolicyWebhook(dead, default_allow=True,
+                                       timeout_s=0.5))
+    api.create("Pod", make_pod("p", cpu=10))  # fail-open
+    api2 = mk_server(ImagePolicyWebhook(dead, default_allow=False,
+                                        timeout_s=0.5))
+    with pytest.raises(Rejected):
+        api2.create("Pod", make_pod("p2", cpu=10))  # fail-closed
+
+
+# -------------------------------------------------------- initializers
+
+
+def test_initializers_stamp_hide_and_release():
+    api = mk_server(Initializers())
+    api.store.create(
+        "InitializerConfiguration",
+        InitializerConfiguration(name="pod-init",
+                                 initializers=["podimage.example.com"],
+                                 kinds=["Pod"]))
+    api.create("Pod", make_pod("p", cpu=10))
+    stored = api.store.get("Pod", "default", "p")
+    assert stored.annotations[PENDING_INITIALIZERS_ANNOTATION] \
+        == "podimage.example.com"
+    assert is_uninitialized(stored)
+    # hidden from normal LIST; visible with includeUninitialized
+    assert api.list("Pod")[0] == []
+    assert [p.name for p in
+            api.list("Pod", include_uninitialized=True)[0]] == ["p"]
+    # the initializer controller completes its work
+    remove_initializer(api.store, "Pod", stored, "podimage.example.com")
+    visible = api.list("Pod")[0]
+    assert [p.name for p in visible] == ["p"]
+    assert not is_uninitialized(visible[0])
+
+
+def test_initializers_only_touch_matching_kinds():
+    api = mk_server(Initializers([InitializerConfiguration(
+        name="svc-only", initializers=["x.example.com"],
+        kinds=["Service"])]))
+    api.create("Pod", make_pod("p", cpu=10))
+    assert PENDING_INITIALIZERS_ANNOTATION not in \
+        api.store.get("Pod", "default", "p").annotations
