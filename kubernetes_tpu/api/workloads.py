@@ -45,6 +45,7 @@ class ReplicaSet:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
     selector: LabelSelector = field(default_factory=LabelSelector)
@@ -67,6 +68,7 @@ class ReplicationController:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
     selector: Dict[str, str] = field(default_factory=dict)
     template: Pod = field(default_factory=lambda: Pod(name=""))
@@ -86,6 +88,7 @@ class Deployment:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
     selector: LabelSelector = field(default_factory=LabelSelector)
     template: Pod = field(default_factory=lambda: Pod(name=""))
@@ -108,6 +111,7 @@ class Job:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     completions: int = 1
     parallelism: int = 1
     backoff_limit: int = 6
@@ -131,6 +135,7 @@ class CronJob:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     schedule: str = "@every 60s"
     suspend: bool = False
     concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
@@ -155,6 +160,7 @@ class HorizontalPodAutoscaler:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     target_kind: str = "ReplicaSet"
     target_name: str = ""
     min_replicas: int = 1
@@ -197,6 +203,7 @@ class StatefulSet:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
     selector: LabelSelector = field(default_factory=LabelSelector)
     template: Pod = field(default_factory=lambda: Pod(name=""))
@@ -237,6 +244,7 @@ class Service:
 
     name: str
     namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)
     cluster_ip: str = ""

@@ -396,6 +396,9 @@ class Ktctl:
             elif a == "-l":
                 flags["selector"] = args[i + 1]
                 i += 1
+            elif a == "-p":
+                flags["patch"] = args[i + 1]
+                i += 1
             else:
                 pos.append(a)
             i += 1
@@ -646,31 +649,155 @@ class Ktctl:
             self.api.create(kind, obj)
             self._print(f"{self._plural(kind)}/{obj.name} created")
 
+    # ---- the canonical manifest shape the merge machinery operates on.
+    # Pod/Node use the serde metadata/spec shape — precisely the SPEC
+    # surface, so a merge can never stomp status or server bookkeeping;
+    # everything else uses the flat reflective wire shape. User manifests
+    # in either accepted shape are normalized through decode->encode
+    # before diffing, so 3-way inputs always agree on shape.
+
+    def _canon_manifest(self, kind: str, obj) -> Dict[str, Any]:
+        from kubernetes_tpu.api import serde
+        if kind == "Pod":
+            return serde.encode_pod(obj)
+        if kind == "Node":
+            return serde.encode_node(obj)
+        return wire.encode(obj, kind)
+
+    @staticmethod
+    def _with_last_applied(canon: Dict[str, Any],
+                           canon_txt: str) -> Dict[str, Any]:
+        import copy as _copy
+        out = _copy.deepcopy(canon)
+        if "metadata" in out:
+            out["metadata"].setdefault("annotations", {})[LAST_APPLIED] = \
+                canon_txt
+        elif isinstance(out.get("annotations"), dict) or \
+                "annotations" not in out:
+            out.setdefault("annotations", {})[LAST_APPLIED] = canon_txt
+        return out
+
+    def _decode_canon(self, kind: str, data: Dict[str, Any], cur):
+        """Canonical manifest -> live object, restoring the status/server
+        fields the spec-surface encoding doesn't carry (apply and patch
+        never touch status — the reference's status-subresource split)."""
+        new_obj = wire.decode_any(data, kind)
+        if cur is not None:
+            if kind == "Pod":
+                new_obj.phase = cur.phase
+                new_obj.ready = cur.ready
+                new_obj.restart_count = cur.restart_count
+            elif kind == "Node":
+                new_obj.heartbeat = cur.heartbeat
+                new_obj.annotations = dict(cur.annotations)
+            new_obj.resource_version = cur.resource_version
+        return new_obj
+
     def cmd_apply(self, args):
+        """kubectl apply: THREE-way strategic merge (apply.go:658) — the
+        patch is computed from (last-applied, new manifest) and played
+        onto the LIVE object, so manifest-removed fields/list items are
+        pruned while controller-owned fields (an HPA's replicas, status,
+        defaults) survive untouched."""
+        from kubernetes_tpu.cli import strategicpatch
         _, flags = self._flags(args)
         objs, raws = self._load_manifests(flags)
         for obj, raw in zip(objs, raws):
             kind = raw.get("kind")
-            if hasattr(obj, "annotations"):
-                obj.annotations[LAST_APPLIED] = json.dumps(raw,
-                                                           sort_keys=True)
             ns = getattr(obj, "namespace", "")
+            canon_new = self._canon_manifest(kind, obj)
+            canon_txt = json.dumps(canon_new, sort_keys=True)
             try:
                 cur = self.api.get(kind, ns if not self._cluster_scoped(kind) else "",
                                    obj.name)
             except Exception:
                 cur = None
             if cur is None:
+                if hasattr(obj, "annotations"):
+                    obj.annotations[LAST_APPLIED] = canon_txt
                 self.api.create(kind, obj)
                 self._print(f"{self._plural(kind)}/{obj.name} created")
-            else:
-                prev = getattr(cur, "annotations", {}).get(LAST_APPLIED)
-                if prev == json.dumps(raw, sort_keys=True):
-                    self._print(f"{self._plural(kind)}/{obj.name} unchanged")
-                    continue
-                obj.resource_version = cur.resource_version
-                self.api.update(kind, obj)
-                self._print(f"{self._plural(kind)}/{obj.name} configured")
+                continue
+            prev_txt = getattr(cur, "annotations", {}).get(LAST_APPLIED, "")
+            prev = json.loads(prev_txt) if prev_txt else {}
+            cur_manifest = self._canon_manifest(kind, cur)
+            # like kubectl, the modified object carries the new
+            # last-applied annotation INTO the diff: metadata.annotations
+            # is then never absent from `modified`, so dropping the user's
+            # annotations from a manifest prunes them per-key instead of
+            # nuking the whole map (controller-set keys survive)
+            modified = self._with_last_applied(canon_new, canon_txt)
+            merged = strategicpatch.three_way_merge(prev, modified,
+                                                    cur_manifest)
+            if merged == cur_manifest and prev_txt == canon_txt:
+                self._print(f"{self._plural(kind)}/{obj.name} unchanged")
+                continue
+            new_obj = self._decode_canon(kind, merged, cur)
+            if hasattr(new_obj, "annotations"):
+                new_obj.annotations[LAST_APPLIED] = canon_txt
+            self.api.update(kind, new_obj)
+            self._print(f"{self._plural(kind)}/{obj.name} configured")
+
+    def cmd_patch(self, args):
+        """kubectl patch -p '<json>': server-state strategic merge patch
+        (pkg/kubectl/cmd/patch.go, default --type=strategic): merge-keyed
+        lists merge per item, null deletes a key, $patch: delete removes a
+        keyed list item."""
+        from kubernetes_tpu.cli import strategicpatch
+        pos, flags = self._flags(args)
+        if "patch" not in flags:
+            raise SystemExit("error: -p / --patch is required")
+        kind = self._resolve_kind(pos[0])
+        ns = "" if self._cluster_scoped(kind) \
+            else flags.get("namespace", "default")
+        patch = json.loads(flags["patch"])
+        cur = self.api.get(kind, ns, pos[1])
+        # the patch follows the object's manifest shape (metadata/spec for
+        # Pod/Node, flat for the rest) — same contract as apply manifests
+        merged = strategicpatch.strategic_merge_patch(
+            self._canon_manifest(kind, cur), patch)
+        new_obj = self._decode_canon(kind, merged, cur)
+        self.api.update(kind, new_obj)
+        self._print(f"{self._plural(kind)}/{pos[1]} patched")
+
+    def cmd_edit(self, args):
+        """kubectl edit: round the live object through $EDITOR as YAML and
+        update with whatever comes back (pkg/kubectl/cmd/edit.go's
+        edit-reapply loop collapsed to one pass; KTCTL_EDITOR/EDITOR)."""
+        import os
+        import subprocess
+        import tempfile
+        pos, flags = self._flags(args)
+        kind = self._resolve_kind(pos[0])
+        ns = "" if self._cluster_scoped(kind) \
+            else flags.get("namespace", "default")
+        cur = self.api.get(kind, ns, pos[1])
+        editor = os.environ.get("KTCTL_EDITOR") or os.environ.get(
+            "EDITOR")
+        if not editor:
+            raise SystemExit("error: no KTCTL_EDITOR or EDITOR defined")
+        manifest = self._canon_manifest(kind, cur)
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".yaml", delete=False) as f:
+            yaml.safe_dump(manifest, f)
+            path = f.name
+        try:
+            try:
+                subprocess.run(editor.split() + [path], check=True)
+            except subprocess.CalledProcessError:
+                # vim :cq and friends — the conventional abort signal
+                self._print("Edit cancelled, no changes made.")
+                return
+            with open(path) as f:
+                edited = yaml.safe_load(f)
+        finally:
+            os.unlink(path)
+        if edited is None or edited == manifest:
+            self._print("Edit cancelled, no changes made.")
+            return
+        new_obj = self._decode_canon(kind, edited, cur)
+        self.api.update(kind, new_obj)
+        self._print(f"{self._plural(kind)}/{pos[1]} edited")
 
     def cmd_delete(self, args):
         pos, flags = self._flags(args)
