@@ -270,6 +270,85 @@ def _encode_policy_v1(policy) -> Dict[str, Any]:
     return out
 
 
+# ------------------------------------------------- core group (Pod/Node)
+#
+# The defining apimachinery axis (pkg/api/v1/conversion.go + runtime.Scheme
+# Convert): versioned external shapes <-> the internal dataclasses, with
+# defaulting applied exactly once at decode. v1 is the serde wire shape
+# (metadata/spec, camelCase). "v2" is a hypothetical next version proving
+# the conversion machinery handles FIELD RENAMES through the internal hub:
+#   spec.nodeName      -> spec.boundNode
+#   spec.schedulerName -> spec.scheduler
+#   (Node) spec.unschedulable -> spec.schedulingDisabled
+# Converting v1<->v2 is always two hops through internal, never
+# field-by-field between versions — exactly runtime.Scheme's shape.
+
+
+def _decode_pod_v1(data: Dict[str, Any]):
+    from kubernetes_tpu.api import serde
+    return serde.decode_pod(data)
+
+
+def _encode_pod_v1(pod) -> Dict[str, Any]:
+    from kubernetes_tpu.api import serde
+    return serde.encode_pod(pod)
+
+
+def _decode_pod_v2(data: Dict[str, Any]):
+    from kubernetes_tpu.api import serde
+    spec = dict(data.get("spec") or {})
+    if "boundNode" in spec:
+        spec["nodeName"] = spec.pop("boundNode")
+    if "scheduler" in spec:
+        spec["schedulerName"] = spec.pop("scheduler")
+    return serde.decode_pod({**data, "spec": spec})
+
+
+def _encode_pod_v2(pod) -> Dict[str, Any]:
+    from kubernetes_tpu.api import serde
+    out = serde.encode_pod(pod)
+    spec = out["spec"]
+    spec["boundNode"] = spec.pop("nodeName")
+    spec["scheduler"] = spec.pop("schedulerName")
+    return out
+
+
+def _decode_node_v1(data: Dict[str, Any]):
+    from kubernetes_tpu.api import serde
+    return serde.decode_node(data)
+
+
+def _encode_node_v1(node) -> Dict[str, Any]:
+    from kubernetes_tpu.api import serde
+    return serde.encode_node(node)
+
+
+def _decode_node_v2(data: Dict[str, Any]):
+    spec = dict(data.get("spec") or {})
+    if "schedulingDisabled" in spec:
+        spec["unschedulable"] = spec.pop("schedulingDisabled")
+    return _decode_node_v1({**data, "spec": spec})
+
+
+def _encode_node_v2(node) -> Dict[str, Any]:
+    out = _encode_node_v1(node)
+    spec = out["spec"]
+    spec["schedulingDisabled"] = spec.pop("unschedulable")
+    return out
+
+
+def _decode_service_v1(data: Dict[str, Any]):
+    from kubernetes_tpu.api import wire
+    body = {k: v for k, v in data.items()
+            if k not in ("apiVersion",)}
+    return wire.decode_any(body, "Service")
+
+
+def _encode_service_v1(svc) -> Dict[str, Any]:
+    from kubernetes_tpu.api import wire
+    return wire.encode(svc, "Service")
+
+
 def default_scheme() -> Scheme:
     s = Scheme()
     s.register(_SCHED_GV, _SCHED_KIND,
@@ -278,6 +357,12 @@ def default_scheme() -> Scheme:
     # the unversioned legacy Policy files (--use-legacy-policy-config)
     # decode through the same codec
     s.register("", "Policy", _decode_policy_v1, _encode_policy_v1)
+    # core group: two served versions over one internal hub
+    s.register("v1", "Pod", _decode_pod_v1, _encode_pod_v1)
+    s.register("v2", "Pod", _decode_pod_v2, _encode_pod_v2)
+    s.register("v1", "Node", _decode_node_v1, _encode_node_v1)
+    s.register("v2", "Node", _decode_node_v2, _encode_node_v2)
+    s.register("v1", "Service", _decode_service_v1, _encode_service_v1)
     return s
 
 

@@ -168,6 +168,84 @@ def decode_affinity(aff: Optional[Dict]) -> Optional[Affinity]:
     )
 
 
+# -- encoders inverting the decoders above (conversion round-trip support) --
+
+
+def _encode_requirements(reqs: List[SelectorRequirement]) -> List[Dict]:
+    return [{"key": r.key,
+             "operator": r.operator.value
+             if hasattr(r.operator, "value") else r.operator,
+             "values": list(r.values)} for r in reqs]
+
+
+def _encode_label_selector(ls: Optional[LabelSelector]) -> Optional[Dict]:
+    if ls is None:
+        return None  # nil selector (matches nothing) != empty (matches all)
+    out: Dict[str, Any] = {}
+    if ls.match_labels:
+        out["matchLabels"] = dict(ls.match_labels)
+    if ls.match_expressions:
+        out["matchExpressions"] = _encode_requirements(ls.match_expressions)
+    return out
+
+
+def _encode_pod_affinity_term(t: PodAffinityTerm) -> Dict:
+    out: Dict[str, Any] = {"topologyKey": t.topology_key}
+    sel = _encode_label_selector(t.label_selector)
+    if sel is not None:
+        out["labelSelector"] = sel
+    if t.namespaces:
+        out["namespaces"] = list(t.namespaces)
+    return out
+
+
+def _encode_pod_affinity(pa: Optional[PodAffinity]) -> Optional[Dict]:
+    if pa is None:
+        return None
+    out: Dict[str, Any] = {}
+    if pa.required_terms:
+        out["requiredDuringSchedulingIgnoredDuringExecution"] = [
+            _encode_pod_affinity_term(t) for t in pa.required_terms]
+    if pa.preferred_terms:
+        out["preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": w, "podAffinityTerm": _encode_pod_affinity_term(t)}
+            for w, t in pa.preferred_terms]
+    return out or None
+
+
+def encode_affinity(aff: Optional[Affinity]) -> Optional[Dict]:
+    """Inverse of decode_affinity: decode(encode(x)) == x, preserving the
+    nil-vs-empty distinctions the predicates read (required_terms None vs
+    [], nil vs empty labelSelector)."""
+    if aff is None:
+        return None
+    out: Dict[str, Any] = {}
+    na = aff.node_affinity
+    if na is not None:
+        d: Dict[str, Any] = {}
+        if na.required_terms is not None:
+            d["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                "nodeSelectorTerms": [
+                    {"matchExpressions":
+                     _encode_requirements(t.match_expressions)}
+                    for t in na.required_terms]}
+        if na.preferred_terms:
+            d["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {"weight": w, "preference": {
+                    "matchExpressions":
+                    _encode_requirements(t.match_expressions)}}
+                for w, t in na.preferred_terms]
+        if d:
+            out["nodeAffinity"] = d
+    pa = _encode_pod_affinity(aff.pod_affinity)
+    if pa is not None:
+        out["podAffinity"] = pa
+    paa = _encode_pod_affinity(aff.pod_anti_affinity)
+    if paa is not None:
+        out["podAntiAffinity"] = paa
+    return out or None
+
+
 # ---------------------------------------------------------------------------
 # Pod / Node
 # ---------------------------------------------------------------------------
@@ -324,6 +402,7 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
         tolerations=tolerations,
         scheduler_name=spec.get("schedulerName", "default-scheduler"),
         priority=int(spec.get("priority") or 0),
+        restart_policy=spec.get("restartPolicy", "Always"),
         host_network=bool(spec.get("hostNetwork", False)),
         security_context=_decode_sc(spec.get("securityContext"), True),
         owner_kind=owner_kind,
@@ -372,7 +451,9 @@ def decode_node(obj: Dict[str, Any]) -> Node:
 
 
 def encode_pod(pod: Pod) -> Dict[str, Any]:
-    """Minimal re-encode (enough for extender round-trips and debugging)."""
+    """Inverse of decode_pod over the full spec surface it reads —
+    decode(encode(p)) == p for every wire-carried field (the codec
+    round-trip invariant the core-group conversion tests pin)."""
     def _enc_sc(s) -> Optional[Dict[str, Any]]:
         if s is None:
             return None
@@ -387,17 +468,35 @@ def encode_pod(pod: Pod) -> Dict[str, Any]:
             out["readOnlyRootFilesystem"] = s.read_only_root_filesystem
         return out or None
 
+    def _enc_rl(rl: Dict[str, int]) -> Dict[str, str]:
+        return {k: (f"{v}m" if k == "cpu" else str(v))
+                for k, v in rl.items()}
+
+    def _enc_probe(p) -> Optional[Dict[str, Any]]:
+        if p is None:
+            return None
+        return {p.kind: {},
+                "initialDelaySeconds": p.initial_delay_s,
+                "periodSeconds": p.period_s,
+                "failureThreshold": p.failure_threshold,
+                "successThreshold": p.success_threshold}
+
     containers = []
     for c in pod.containers:
-        req = {}
-        for k, v in c.requests.items():
-            req[k] = f"{v}m" if k == "cpu" else str(v)
         enc = {
             "name": c.name, "image": c.image,
-            "resources": {"requests": req},
+            "resources": {"requests": _enc_rl(c.requests),
+                          **({"limits": _enc_rl(c.limits)}
+                             if c.limits else {})},
             "ports": [{"hostPort": p.host_port, "containerPort": p.container_port,
                        "protocol": p.protocol} for p in c.ports],
         }
+        lp = _enc_probe(c.liveness_probe)
+        if lp:
+            enc["livenessProbe"] = lp
+        rp = _enc_probe(c.readiness_probe)
+        if rp:
+            enc["readinessProbe"] = rp
         csc = _enc_sc(c.security_context)
         if csc:
             enc["securityContext"] = csc
@@ -406,17 +505,40 @@ def encode_pod(pod: Pod) -> Dict[str, Any]:
         "containers": containers, "nodeName": pod.node_name,
         "nodeSelector": pod.node_selector,
         "schedulerName": pod.scheduler_name,
+        "restartPolicy": pod.restart_policy,
         "volumes": [encode_volume(v) for v in pod.volumes]}
+    if pod.priority:
+        spec["priority"] = pod.priority
+    if pod.tolerations:
+        spec["tolerations"] = [
+            {"key": t.key,
+             "operator": t.operator.value
+             if hasattr(t.operator, "value") else t.operator,
+             "value": t.value,
+             **({"effect": t.effect.value
+                 if hasattr(t.effect, "value") else t.effect}
+                if t.effect else {})}
+            for t in pod.tolerations]
+    aff = encode_affinity(pod.affinity)
+    if aff is not None:
+        spec["affinity"] = aff
     if pod.host_network:
         spec["hostNetwork"] = True
     psc = _enc_sc(pod.security_context)
     if psc:
         spec["securityContext"] = psc
-    return {
-        "metadata": {"name": pod.name, "namespace": pod.namespace,
-                     "uid": pod.uid, "labels": pod.labels},
-        "spec": spec,
-    }
+    meta: Dict[str, Any] = {
+        "name": pod.name, "namespace": pod.namespace,
+        "uid": pod.uid, "labels": pod.labels}
+    if pod.annotations:
+        meta["annotations"] = dict(pod.annotations)
+    if pod.owner_kind:
+        meta["ownerReferences"] = [{
+            "kind": pod.owner_kind, "name": pod.owner_name,
+            "uid": pod.owner_uid, "controller": True}]
+    if pod.deleted:
+        meta["deletionTimestamp"] = "1970-01-01T00:00:00Z"
+    return {"metadata": meta, "spec": spec}
 
 
 def encode_node(node: Node) -> Dict[str, Any]:

@@ -1,0 +1,172 @@
+"""Core-group versioned conversion (api/scheme.py core codecs,
+api/serde.py encoders).
+
+Pins the runtime.Scheme invariants the reference's generated conversions
+guarantee (pkg/api/v1/conversion.go, apimachinery runtime.Scheme):
+decode applies defaults exactly once; decode(encode(x)) == x over the
+wire-carried surface; v1<->v2 converts losslessly through the internal
+hub including field renames; unknown versions fail loudly. The fuzz
+round-trips random manifests, the moral analog of the reference's
+roundtrip_test.go fuzzing (apimachinery/pkg/api/testing)."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api.scheme import DEFAULT_SCHEME, SchemeError
+from kubernetes_tpu.api.types import Pod
+
+
+# --------------------------------------------------------------- defaults
+
+
+def test_pod_decode_applies_defaults_once():
+    data = {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p"},
+            "spec": {"containers": [{"name": "c"}]}}
+    pod = DEFAULT_SCHEME.decode(data)
+    assert pod.scheduler_name == "default-scheduler"  # defaulted
+    assert pod.restart_policy == "Always"  # defaulted
+    assert pod.namespace == "default"  # defaulted
+    # encode makes the defaults explicit; a second decode is idempotent
+    wire = DEFAULT_SCHEME.encode(pod, "v1", "Pod")
+    assert wire["spec"]["schedulerName"] == "default-scheduler"
+    assert wire["spec"]["restartPolicy"] == "Always"
+    assert DEFAULT_SCHEME.decode(wire) == pod
+
+
+def test_unknown_core_version_fails_loudly():
+    with pytest.raises(SchemeError):
+        DEFAULT_SCHEME.decode({"apiVersion": "v9", "kind": "Pod",
+                               "metadata": {"name": "p"}})
+
+
+# ---------------------------------------------------------- field renames
+
+
+def test_pod_v2_round_trip_renames_fields():
+    v1 = {"apiVersion": "v1", "kind": "Pod",
+          "metadata": {"name": "p", "namespace": "ns"},
+          "spec": {"containers": [{"name": "c"}],
+                   "nodeName": "n1", "schedulerName": "custom"}}
+    pod = DEFAULT_SCHEME.decode(v1)
+    v2 = DEFAULT_SCHEME.encode(pod, "v2", "Pod")
+    assert v2["spec"]["boundNode"] == "n1"  # renamed
+    assert v2["spec"]["scheduler"] == "custom"  # renamed
+    assert "nodeName" not in v2["spec"]
+    assert "schedulerName" not in v2["spec"]
+    # v2 decodes to the SAME internal object (two-hop conversion)
+    assert DEFAULT_SCHEME.decode(v2) == pod
+    # and scheme.convert round-trips versioned->versioned
+    v1_again = DEFAULT_SCHEME.convert(v2, "v1")
+    assert v1_again["spec"]["nodeName"] == "n1"
+    assert DEFAULT_SCHEME.decode(v1_again) == pod
+
+
+def test_node_v2_round_trip_renames_unschedulable():
+    v1 = {"apiVersion": "v1", "kind": "Node",
+          "metadata": {"name": "n1", "labels": {"zone": "a"}},
+          "spec": {"unschedulable": True, "taints": []},
+          "status": {"allocatable": {"cpu": "4000m", "memory": "1048576",
+                                     "pods": "110"},
+                     "conditions": [{"type": "Ready", "status": "True"}]}}
+    node = DEFAULT_SCHEME.decode(v1)
+    assert node.unschedulable is True
+    v2 = DEFAULT_SCHEME.encode(node, "v2", "Node")
+    assert v2["spec"]["schedulingDisabled"] is True
+    assert "unschedulable" not in v2["spec"]
+    assert DEFAULT_SCHEME.decode(v2) == node
+
+
+def test_service_v1_codec():
+    data = {"apiVersion": "v1", "kind": "Service",
+            "name": "svc", "namespace": "default",
+            "selector": {"app": "web"}}
+    svc = DEFAULT_SCHEME.decode(data)
+    assert svc.name == "svc" and svc.selector == {"app": "web"}
+    wire = DEFAULT_SCHEME.encode(svc, "v1", "Service")
+    assert DEFAULT_SCHEME.decode(wire) == svc
+
+
+# -------------------------------------------------------- round-trip fuzz
+
+
+def _random_manifest(rng: random.Random) -> dict:
+    Mi = 1 << 20
+    containers = []
+    for i in range(rng.randint(1, 3)):
+        c = {"name": f"c{i}",
+             "image": rng.choice(["", "app:v1", "registry/x:2"]),
+             "resources": {"requests": {
+                 "cpu": f"{rng.randint(1, 4000)}m",
+                 "memory": str(rng.randint(1, 64) * Mi)}}}
+        if rng.random() < 0.3:
+            c["resources"]["limits"] = {
+                "cpu": f"{rng.randint(1000, 8000)}m"}
+        if rng.random() < 0.3:
+            c["ports"] = [{"hostPort": rng.randint(0, 1),
+                           "containerPort": rng.randint(1, 9999),
+                           "protocol": "TCP"}]
+        if rng.random() < 0.3:
+            c["livenessProbe"] = {
+                "exec": {}, "initialDelaySeconds": float(rng.randint(0, 9)),
+                "periodSeconds": 10.0, "failureThreshold": 3,
+                "successThreshold": 1}
+        containers.append(c)
+    spec = {"containers": containers,
+            "nodeName": rng.choice(["", "n1"]),
+            "schedulerName": rng.choice(["default-scheduler", "custom"]),
+            "restartPolicy": rng.choice(["Always", "OnFailure", "Never"])}
+    if rng.random() < 0.4:
+        spec["tolerations"] = [{
+            "key": "dedicated", "operator": "Equal", "value": "gpu",
+            "effect": "NoSchedule"}]
+    if rng.random() < 0.4:
+        spec["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [{
+                        "key": "zone", "operator": "In",
+                        "values": ["a", "b"]}]}]}},
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "kubernetes.io/hostname",
+                    "labelSelector": {"matchLabels": {"app": "web"}}}],
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 7, "podAffinityTerm": {
+                        "topologyKey": "zone",
+                        "labelSelector": {"matchExpressions": [{
+                            "key": "tier", "operator": "NotIn",
+                            "values": ["db"]}]}}}]}}
+    if rng.random() < 0.3:
+        spec["priority"] = rng.randint(1, 1000)
+    if rng.random() < 0.2:
+        spec["hostNetwork"] = True
+    meta = {"name": f"p{rng.randint(0, 999)}",
+            "namespace": rng.choice(["default", "kube-system"]),
+            "labels": {"app": rng.choice(["web", "db"])}}
+    if rng.random() < 0.4:
+        meta["annotations"] = {"a": "1", "b": "two"}
+    if rng.random() < 0.3:
+        meta["ownerReferences"] = [{"kind": "ReplicaSet", "name": "rs-1",
+                                    "uid": "u1", "controller": True}]
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": meta, "spec": spec}
+
+
+def test_round_trip_fuzz_v1_and_v2():
+    rng = random.Random(7)
+    for i in range(200):
+        data = _random_manifest(rng)
+        pod = DEFAULT_SCHEME.decode(data)
+        assert isinstance(pod, Pod)
+        # v1 round trip
+        assert DEFAULT_SCHEME.decode(
+            DEFAULT_SCHEME.encode(pod, "v1", "Pod")) == pod, i
+        # v2 round trip (rename hop both ways through internal)
+        assert DEFAULT_SCHEME.decode(
+            DEFAULT_SCHEME.encode(pod, "v2", "Pod")) == pod, i
+        # versioned->versioned conversion is stable after the first hop
+        v2 = DEFAULT_SCHEME.convert(data, "v2")
+        v1b = DEFAULT_SCHEME.convert(v2, "v1")
+        assert DEFAULT_SCHEME.convert(v1b, "v2") == v2, i
