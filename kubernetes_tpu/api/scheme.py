@@ -339,8 +339,19 @@ def _encode_node_v2(node) -> Dict[str, Any]:
 
 def _decode_service_v1(data: Dict[str, Any]):
     from kubernetes_tpu.api import wire
-    body = {k: v for k, v in data.items()
-            if k not in ("apiVersion",)}
+    if "metadata" in data:
+        # the kubectl manifest shape: flatten metadata + spec into the
+        # native field namespace before the reflective decode
+        meta = data.get("metadata") or {}
+        spec = data.get("spec") or {}
+        body = {**spec,
+                "name": meta.get("name", ""),
+                "namespace": meta.get("namespace", "default"),
+                "labels": dict(meta.get("labels") or {}),
+                "annotations": dict(meta.get("annotations") or {})}
+    else:
+        body = {k: v for k, v in data.items()
+                if k not in ("apiVersion",)}
     return wire.decode_any(body, "Service")
 
 

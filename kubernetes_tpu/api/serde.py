@@ -210,7 +210,9 @@ def _encode_pod_affinity(pa: Optional[PodAffinity]) -> Optional[Dict]:
         out["preferredDuringSchedulingIgnoredDuringExecution"] = [
             {"weight": w, "podAffinityTerm": _encode_pod_affinity_term(t)}
             for w, t in pa.preferred_terms]
-    return out or None
+    # a present-but-empty PodAffinity must stay present ({}), not vanish —
+    # decode({'podAffinity': {}}) produced it and must get it back
+    return out
 
 
 def encode_affinity(aff: Optional[Affinity]) -> Optional[Dict]:
@@ -235,8 +237,7 @@ def encode_affinity(aff: Optional[Affinity]) -> Optional[Dict]:
                     "matchExpressions":
                     _encode_requirements(t.match_expressions)}}
                 for w, t in na.preferred_terms]
-        if d:
-            out["nodeAffinity"] = d
+        out["nodeAffinity"] = d  # {} round-trips to NodeAffinity(None, [])
     pa = _encode_pod_affinity(aff.pod_affinity)
     if pa is not None:
         out["podAffinity"] = pa
@@ -549,8 +550,11 @@ def encode_node(node: Node) -> Dict[str, Any]:
         alloc["nvidia.com/gpu"] = str(node.allocatable.nvidia_gpu)
     for k, v in node.allocatable.extended.items():
         alloc[k] = str(v)
+    meta: Dict[str, Any] = {"name": node.name, "labels": node.labels}
+    if node.annotations:
+        meta["annotations"] = dict(node.annotations)
     return {
-        "metadata": {"name": node.name, "labels": node.labels},
+        "metadata": meta,
         "spec": {
             "unschedulable": node.unschedulable,
             "taints": [{"key": t.key, "value": t.value,

@@ -86,6 +86,39 @@ def test_service_v1_codec():
     assert svc.name == "svc" and svc.selector == {"app": "web"}
     wire = DEFAULT_SCHEME.encode(svc, "v1", "Service")
     assert DEFAULT_SCHEME.decode(wire) == svc
+    # the kubectl metadata/spec manifest shape decodes to the same object
+    manifest = {"apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": "svc", "namespace": "default"},
+                "spec": {"selector": {"app": "web"}}}
+    assert DEFAULT_SCHEME.decode(manifest) == svc
+
+
+def test_node_annotations_round_trip():
+    v1 = {"apiVersion": "v1", "kind": "Node",
+          "metadata": {"name": "n1", "annotations": {"k": "v"}},
+          "spec": {}, "status": {"allocatable": {"cpu": "1000m",
+                                                 "memory": "1048576",
+                                                 "pods": "10"}}}
+    node = DEFAULT_SCHEME.decode(v1)
+    assert node.annotations == {"k": "v"}
+    assert DEFAULT_SCHEME.decode(
+        DEFAULT_SCHEME.encode(node, "v2", "Node")) == node
+
+
+def test_empty_affinity_stanzas_round_trip():
+    """decode({'nodeAffinity': {}}) and decode({'podAffinity': {}}) are
+    real states (match-everything / empty) and must survive encode."""
+    data = {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p"},
+            "spec": {"containers": [{"name": "c"}],
+                     "affinity": {"nodeAffinity": {},
+                                  "podAffinity": {}}}}
+    pod = DEFAULT_SCHEME.decode(data)
+    assert pod.affinity is not None
+    assert pod.affinity.node_affinity is not None
+    assert pod.affinity.pod_affinity is not None
+    assert DEFAULT_SCHEME.decode(
+        DEFAULT_SCHEME.encode(pod, "v1", "Pod")) == pod
 
 
 # -------------------------------------------------------- round-trip fuzz
