@@ -24,7 +24,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -268,13 +267,13 @@ def evaluate_pod(pod: Pod, infos, snap: ClusterSnapshot,
         AffinityData,
         collect_pod_pairs,
         intern_topology_pairs,
-        precompute_static,
         step_fits,
         step_prio_counts,
         step_spread_counts,
         interpod_score,
         spread_score,
     )
+    from kubernetes_tpu.ops.pallas_kernels import precompute_static_fast
     from kubernetes_tpu.ops.predicates import fits_jit, node_arrays, pod_arrays
 
     if eval_cache is not None:
@@ -320,25 +319,24 @@ def evaluate_pod(pod: Pod, infos, snap: ClusterSnapshot,
     fits_on = adata.fits_needed
     prio_on = bool(w_ip) and adata.prio_needed
     spread_on = bool(w_sp) and adata.spread_needed
-    with jax.enable_x64(True):
-        m = fits_jit(parr, narr)[0]
-        s = prio.score(parr, narr, plain)[0]
-        if fits_on or prio_on or spread_on:
-            aff = adata.device_arrays()
-            labels = narr["labels"]
-            pre = precompute_static(aff, labels)
-            c_dim = aff["m_aff"].shape[0]
-            commdom0 = jnp.zeros((c_dim, labels.shape[1]), dtype=jnp.int32)
-            committed0 = jnp.zeros((c_dim, labels.shape[0]), dtype=jnp.int32)
-            comm_cnt0 = jnp.zeros(c_dim, dtype=jnp.int32)
-            if fits_on:
-                m = m & step_fits(aff, pre, 0, commdom0, comm_cnt0, labels)
-            if prio_on:
-                cnt = step_prio_counts(aff, pre, 0, commdom0, labels)
-                s = s + w_ip * interpod_score(cnt, m)
-            if spread_on:
-                cnt = step_spread_counts(aff, 0, committed0)
-                s = s + w_sp * spread_score(aff, aff["sp_has"][0], cnt, m)
+    m = fits_jit(parr, narr)[0]
+    s = prio.score(parr, narr, plain)[0]
+    if fits_on or prio_on or spread_on:
+        aff = adata.device_arrays()
+        labels = narr["labels"]
+        pre = precompute_static_fast(aff, labels)
+        c_dim = aff["m_aff"].shape[0]
+        commdom0 = jnp.zeros((c_dim, labels.shape[1]), dtype=jnp.int32)
+        committed0 = jnp.zeros((c_dim, labels.shape[0]), dtype=jnp.int32)
+        comm_cnt0 = jnp.zeros(c_dim, dtype=jnp.int32)
+        if fits_on:
+            m = m & step_fits(aff, pre, 0, commdom0, comm_cnt0, labels)
+        if prio_on:
+            cnt = step_prio_counts(aff, pre, 0, commdom0, labels)
+            s = s + w_ip * interpod_score(cnt, m)
+        if spread_on:
+            cnt = step_spread_counts(aff, 0, committed0)
+            s = s + w_sp * spread_score(aff, aff["sp_has"][0], cnt, m)
     m = np.array(m)  # copy: device buffers are read-only views
     m[n_real:] = False
     return m, np.asarray(s)
@@ -503,11 +501,10 @@ class SchedulingEngine:
                     pf, aff_arrays, aff_mode, kernel_priorities,
                     (w_ip, w_sp))
             else:
-                with jax.enable_x64(True):
-                    selected, fit_counts, _, rr_end = gather_place_batch(
-                        cls_arr, jnp.asarray(pc_fast), nodes, state,
-                        jnp.uint32(self.rr.counter), kernel_priorities,
-                        aff=aff_arrays, aff_mode=aff_mode)
+                selected, fit_counts, _, rr_end = gather_place_batch(
+                    cls_arr, jnp.asarray(pc_fast), nodes, state,
+                    jnp.uint32(self.rr.counter), kernel_priorities,
+                    aff=aff_arrays, aff_mode=aff_mode)
                 selected = np.asarray(selected)[:pf]
                 fit_counts = np.asarray(fit_counts)[:pf]
             self.rr.counter = int(rr_end)
@@ -579,10 +576,9 @@ class SchedulingEngine:
         fits_on, prio_on, spread_on = aff_mode
         extra = None
         if prio_on or spread_on:
-            with jax.enable_x64(True):
-                extra = waves.frozen_affinity_scores(
-                    cls_arr, nodes, state, aff_arrays,
-                    (w_ip if prio_on else 0, w_sp if spread_on else 0))
+            extra = waves.frozen_affinity_scores(
+                cls_arr, nodes, state, aff_arrays,
+                (w_ip if prio_on else 0, w_sp if spread_on else 0))
         ser = adata.serialize[pc_fast[:pf]]
         selected = np.full(pf, -1, dtype=np.int32)
         fit_counts = np.zeros(pf, dtype=np.int32)
@@ -619,11 +615,10 @@ class SchedulingEngine:
                 commdom0 = committed0 @ nodes["labels"].astype(jnp.int32)
                 comm_cnt0 = committed0.sum(axis=1)
                 aff_init = (commdom0, committed0, comm_cnt0)
-            with jax.enable_x64(True):
-                sel_s, fc_s, _, rr_d = gather_place_batch(
-                    cls_arr, jnp.asarray(pcs), nodes, state_cur,
-                    jnp.uint32(rr), kernel_priorities, aff=aff_arrays,
-                    aff_mode=aff_mode, aff_init=aff_init)
+            sel_s, fc_s, _, rr_d = gather_place_batch(
+                cls_arr, jnp.asarray(pcs), nodes, state_cur,
+                jnp.uint32(rr), kernel_priorities, aff=aff_arrays,
+                aff_mode=aff_mode, aff_init=aff_init)
             selected[strict_pos] = np.asarray(sel_s)[:sp_n]
             fit_counts[strict_pos] = np.asarray(fc_s)[:sp_n]
             rr = int(rr_d)

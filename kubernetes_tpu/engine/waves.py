@@ -374,13 +374,17 @@ def frozen_affinity_scores(cls: Arrays, nodes: Arrays, state: NodeState,
     BATCH, not per wave — within-batch drift of preferred-affinity/spread
     counts is a documented wave-mode approximation; classes with REQUIRED
     (anti-)affinity never take this path (AffinityData.serialize routes
-    them to the strict scan). Trace under jax.enable_x64 when w_spread>0."""
+    them to the strict scan). Pure int32 — no x64 required."""
     from kubernetes_tpu.ops import affinity as aff_ops
 
     w_ip, w_sp = weights
     fits = preds.static_fits(cls, nodes) & _dynamic_fits(cls, nodes, state)
     extra = jnp.zeros(fits.shape, dtype=jnp.int32)
     if w_ip:
+        # jnp einsum, not the Pallas incidence kernel: this matrix is also
+        # computed with the node axis sharded over a mesh (test_mesh.py),
+        # and a pallas_call is a custom call the SPMD partitioner cannot
+        # split. The single-chip evaluate_pod path uses the kernel.
         pre = aff_ops.precompute_static(aff, nodes["labels"])
         extra = extra + w_ip * aff_ops.interpod_score(pre["prio_counts"],
                                                       fits)

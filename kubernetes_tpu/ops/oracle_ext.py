@@ -268,42 +268,57 @@ def pod_selectors(pod: Pod, workloads: Sequence[WorkloadObject]
 def selector_spread_scores(pod: Pod, filtered: Sequence[NodeInfo],
                            ctx: SchedulingContext) -> List[int]:
     """selector_spreading.go:98-185."""
+    from kubernetes_tpu.ops.affinity import (
+        SPREAD_NODE_COUNT_CAP,
+        SPREAD_ZONE_COUNT_CAP,
+    )
     selectors = pod_selectors(pod, ctx.workloads)
     nodes = [i.node for i in filtered if i.node is not None]
-    counts: Dict[str, float] = {}
-    counts_by_zone: Dict[str, float] = {}
-    max_by_node = 0.0
+    counts: Dict[str, int] = {}
+    counts_by_zone: Dict[str, int] = {}
+    max_by_node = 0
     if selectors:
         for info in filtered:
             node = info.node
             if node is None:
                 continue
-            count = 0.0
+            count = 0
             for np in info.pods:
                 if np.namespace != pod.namespace or np.deleted:
                     continue
                 if any(w.selects(np) for w in selectors):
                     count += 1
+            count = min(count, SPREAD_NODE_COUNT_CAP)
             counts[node.name] = count
             max_by_node = max(max_by_node, count)
             zone = get_zone_key(node)
             if zone:
-                counts_by_zone[zone] = counts_by_zone.get(zone, 0.0) + count
+                counts_by_zone[zone] = counts_by_zone.get(zone, 0) + count
+    for z in counts_by_zone:
+        counts_by_zone[z] = min(counts_by_zone[z], SPREAD_ZONE_COUNT_CAP)
     have_zones = bool(counts_by_zone)
-    max_by_zone = max(counts_by_zone.values(), default=0.0)
+    max_by_zone = max(counts_by_zone.values(), default=0)
     out = []
     for node in nodes:
-        f = float(MAX_PRIORITY)
+        # exact-rational spec (see ops/affinity.py spread_score: deliberate
+        # deviation from the reference's float64 rounding crumbs): the
+        # score is floor of r1n/r1d blended 1/3:2/3 with zn/zd, over ints
         if max_by_node > 0:
-            f = MAX_PRIORITY * ((max_by_node - counts.get(node.name, 0.0))
-                                / max_by_node)
-        if have_zones:
-            zone = get_zone_key(node)
-            if zone:
-                zf = MAX_PRIORITY * ((max_by_zone - counts_by_zone.get(zone, 0.0))
-                                     / max_by_zone) if max_by_zone > 0 else 0.0
-                f = f * (1.0 - 2.0 / 3.0) + (2.0 / 3.0) * zf
-        out.append(int(f))
+            r1n = MAX_PRIORITY * (max_by_node - counts.get(node.name, 0))
+            r1d = max_by_node
+        else:
+            r1n, r1d = MAX_PRIORITY, 1
+        zone = get_zone_key(node)
+        if have_zones and zone:
+            if max_by_zone > 0:
+                zn = MAX_PRIORITY * (max_by_zone
+                                     - counts_by_zone.get(zone, 0))
+                zd = max_by_zone
+            else:
+                zn, zd = 0, 1
+            out.append((r1n * zd + 2 * zn * r1d) // (3 * r1d * zd))
+        else:
+            out.append(r1n // r1d)
     return out
 
 

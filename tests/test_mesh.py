@@ -139,9 +139,11 @@ def test_wave_engine_parity_under_mesh(seed):
 
 def test_dryrun_multichip_impl_runs_in_process():
     """The driver-facing dryrun body itself (CPU backend is already forced
-    by conftest, so the impl can run in-process here)."""
+    by conftest, so the impl can run in-process here). Small explicit shape
+    — the driver run uses the large default (2k nodes / 10k pods), which is
+    minutes of CPU scan and belongs there, not in the suite."""
     import __graft_entry__ as g
-    g._dryrun_multichip_impl(N_DEV)
+    g._dryrun_multichip_impl(N_DEV, n_nodes=512, n_pending=288)
 
 
 # ---------------------------------------------------------------- affinity
@@ -202,15 +204,14 @@ def test_strict_engine_affinity_parity_under_mesh(seed):
     assert adata.spread_needed or adata.prio_needed
     aff = adata.device_arrays()
     mode = (adata.fits_needed, adata.prio_needed, adata.spread_needed)
-    with jax.enable_x64(True):
-        sel0, fc0, st0, rr0 = gather_place_batch(
-            cls_arr, jnp.asarray(pc), narr, node_state(narr),
-            jnp.uint32(0), prio.DEFAULT_PRIORITIES, aff=aff, aff_mode=mode)
+    sel0, fc0, st0, rr0 = gather_place_batch(
+        cls_arr, jnp.asarray(pc), narr, node_state(narr),
+        jnp.uint32(0), prio.DEFAULT_PRIORITIES, aff=aff, aff_mode=mode)
     base_sel, base_fc = np.asarray(sel0), np.asarray(fc0)
     assert (base_sel[: len(pending)] >= 0).any()
 
     mesh = make_mesh(N_DEV)
-    with mesh, jax.enable_x64(True):
+    with mesh:
         nsh = shard_nodes(narr, mesh)
         csh = replicate(cls_arr, mesh)
         ash = shard_affinity(aff, mesh)
@@ -239,11 +240,10 @@ def test_frozen_affinity_scores_parity_under_mesh(seed):
     cls_arr, pc, narr, adata = _affinity_kernel_inputs(
         nodes, existing, workloads, pending)
     aff = adata.device_arrays()
-    with jax.enable_x64(True):
-        base = np.asarray(waves.frozen_affinity_scores(
-            cls_arr, narr, mk_state(narr), aff, (2, 1)))
+    base = np.asarray(waves.frozen_affinity_scores(
+        cls_arr, narr, mk_state(narr), aff, (2, 1)))
     mesh = make_mesh(N_DEV)
-    with mesh, jax.enable_x64(True):
+    with mesh:
         got = waves.frozen_affinity_scores(
             replicate(cls_arr, mesh), shard_nodes(narr, mesh),
             mk_state(shard_nodes(narr, mesh)), shard_affinity(aff, mesh),
