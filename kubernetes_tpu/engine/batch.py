@@ -211,6 +211,11 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
         c_dim = aff["m_aff"].shape[0]
         labels = nodes["labels"]
         l_dim = labels.shape[1]
+        # deliberately the jnp einsum, NOT the Pallas incidence kernel
+        # (ops/pallas_kernels.precompute_static_fast): this path also runs
+        # with the node axis SHARDED over a mesh (dryrun_multichip,
+        # tests/test_mesh.py), and a pallas_call is an opaque custom call
+        # XLA's SPMD partitioner cannot split — the einsum it CAN
         pre_aff = aff_ops.precompute_static(aff, labels)
     else:
         c_dim, l_dim = 1, 1

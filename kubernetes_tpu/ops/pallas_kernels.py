@@ -118,6 +118,94 @@ def capacity_fits_pallas(pod_req: jnp.ndarray, alloc: jnp.ndarray,
     return out[:p, :n] != 0
 
 
+# ---------------------------------------------------------------------------
+# topology-incidence matmul (SURVEY §7 phase 2's flagship kernel):
+# [C,S,L] x [N,L] -> [C,S,N] — the static affinity hit matrix
+# ---------------------------------------------------------------------------
+
+M_BLK = 128
+K_BLK = 512
+
+
+def _incidence_kernel(a_ref, b_ref, o_ref):
+    """One (M_BLK, N_BLK) tile of A @ B with the L (contraction) axis
+    blocked over the third grid dimension — the canonical Pallas matmul
+    shape (pallas_guide.md): zero the accumulator on the first k step,
+    accumulate an MXU dot per k block. f32 is exact here: entries are
+    0/1 incidences (or small int weights), so every partial sum stays
+    far below 2^24."""
+    @pl.when(pl.program_id(2) == 0)
+    def _zero():
+        o_ref[...] = jnp.zeros_like(o_ref)
+    o_ref[...] += jnp.dot(a_ref[...], b_ref[...],
+                          preferred_element_type=jnp.float32)
+
+
+def incidence_matmul_pallas(a: jnp.ndarray, b_t: jnp.ndarray,
+                            interpret: bool = False) -> jnp.ndarray:
+    """A [M, L] int x B_t [N, L] int -> [M, N] int32, tiled (M,N,L) on
+    the MXU. Zero padding is exact (0-rows/cols contribute 0)."""
+    m, l = a.shape
+    n = b_t.shape[0]
+    a_p = _pad_to(_pad_to(a.astype(jnp.float32), 0, M_BLK), 1, K_BLK)
+    b_p = _pad_to(_pad_to(b_t.astype(jnp.float32), 0, N_BLK), 1, K_BLK).T
+    mm, kk = a_p.shape
+    nn = b_p.shape[1]
+    out = pl.pallas_call(
+        _incidence_kernel,
+        out_shape=jax.ShapeDtypeStruct((mm, nn), jnp.float32),
+        grid=(mm // M_BLK, nn // N_BLK, kk // K_BLK),
+        in_specs=[
+            pl.BlockSpec((M_BLK, K_BLK), lambda i, j, k: (i, k)),
+            pl.BlockSpec((K_BLK, N_BLK), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((M_BLK, N_BLK), lambda i, j, k: (i, j)),
+        interpret=interpret,
+    )(a_p, b_p)
+    return out[:m, :n].astype(jnp.int32)
+
+
+def precompute_static_fast(aff, labels: jnp.ndarray,
+                           force: Optional[bool] = None,
+                           interpret: bool = False):
+    """Drop-in for affinity.precompute_static with the [C,S,L]x[N,L]
+    allow-hit contraction (and the [C,L] forbid/prio ones, which batch
+    into the same call) Pallas-tiled.
+
+    Measured A/B on the real TPU chip (r5; 20-iter steady-state, jitted,
+    block_until_ready, parity asserted on device):
+
+        C=8   S=4 L=2048 N=5120   jnp 0.221 ms   pallas 0.044 ms  (5.0x)
+        C=64  S=8 L=2048 N=5120   jnp 10.772 ms  pallas 10.658 ms (1.01x)
+        C=256 S=8 L=4096 N=5120   jnp 13.108 ms  pallas 12.661 ms (1.04x)
+
+    Stacking the three einsums into ONE tiled matmul dominates at small
+    class counts (the common case: density batches have few classes) and
+    never loses at large ones — so unlike resources_fit_fast (where the
+    measurement said sub-tile shapes lose), the gate here is simply
+    "pallas available on a TPU backend". Off-TPU the reference jnp path
+    runs."""
+    from kubernetes_tpu.ops.affinity import precompute_static
+    c, s, l = aff["aff_allow"].shape
+    n = labels.shape[0]
+    use = force if force is not None else _use_pallas()
+    if not use:
+        return precompute_static(aff, labels)
+    # one [C*(S+2), L] stack: allow terms, then forbid, then prio rows —
+    # a single tiled matmul instead of three
+    stacked = jnp.concatenate([
+        aff["aff_allow"].reshape(c * s, l).astype(jnp.int32),
+        aff["forbid_static"].astype(jnp.int32),
+        aff["prio_static"].astype(jnp.int32)], axis=0)
+    hits = incidence_matmul_pallas(stacked, labels.astype(jnp.int32),
+                                   interpret=interpret)
+    allow_hit = hits[:c * s].reshape(c, s, n) > 0
+    forbid_hit = hits[c * s:c * s + c] > 0
+    prio_counts = hits[c * s + c:]
+    return {"allow_hit": allow_hit, "forbid_hit": forbid_hit,
+            "prio_counts": prio_counts}
+
+
 def _use_pallas() -> bool:
     env = os.environ.get("KT_PALLAS", "")
     if env in ("0", "off", "false"):

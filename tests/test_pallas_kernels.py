@@ -93,3 +93,65 @@ def test_dispatcher_falls_back_off_tpu():
     got = np.asarray(resources_fit_fast(pod_req, zero_req, alloc,
                                         requested))
     np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------- topology-incidence matmul
+
+
+def _affinity_arrays(rng, c=3, s=4, l=600, n=700):
+    """Random affinity static arrays shaped like AffinityData's."""
+    import jax.numpy as jnp
+    aff = {
+        "aff_allow": jnp.asarray(
+            rng.integers(0, 2, size=(c, s, l)).astype(np.int32)),
+        "forbid_static": jnp.asarray(
+            rng.integers(0, 2, size=(c, l)).astype(np.int32)),
+        "prio_static": jnp.asarray(
+            rng.integers(-5, 9, size=(c, l)).astype(np.int32)),
+    }
+    labels = jnp.asarray(rng.integers(0, 2, size=(n, l)).astype(np.int8))
+    return aff, labels
+
+
+def test_incidence_matmul_interpret_parity():
+    import jax.numpy as jnp
+    from kubernetes_tpu.ops.pallas_kernels import incidence_matmul_pallas
+    rng = np.random.default_rng(11)
+    for m, l, n in [(5, 17, 9), (130, 600, 300), (128, 512, 256)]:
+        a = rng.integers(-3, 7, size=(m, l)).astype(np.int32)
+        b_t = rng.integers(0, 2, size=(n, l)).astype(np.int32)
+        want = a @ b_t.T
+        got = np.asarray(incidence_matmul_pallas(
+            jnp.asarray(a), jnp.asarray(b_t), interpret=True))
+        np.testing.assert_array_equal(got, want, err_msg=f"{m},{l},{n}")
+
+
+def test_precompute_static_fast_parity():
+    """Interpret-mode kernel output vs the reference jnp einsums, over
+    random incidence structures (r4 VERDICT weak #2's asked-for case)."""
+    from kubernetes_tpu.ops.affinity import precompute_static
+    from kubernetes_tpu.ops.pallas_kernels import precompute_static_fast
+    rng = np.random.default_rng(5)
+    for trial in range(4):
+        aff, labels = _affinity_arrays(
+            rng, c=int(rng.integers(1, 6)), s=int(rng.integers(1, 7)),
+            l=int(rng.integers(40, 900)), n=int(rng.integers(50, 800)))
+        want = precompute_static(aff, labels)
+        got = precompute_static_fast(aff, labels, force=True,
+                                     interpret=True)
+        for k in ("allow_hit", "forbid_hit", "prio_counts"):
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(want[k]),
+                err_msg=f"trial {trial} key {k}")
+
+
+def test_precompute_static_fast_falls_back_off_tpu():
+    from kubernetes_tpu.ops.affinity import precompute_static
+    from kubernetes_tpu.ops.pallas_kernels import precompute_static_fast
+    rng = np.random.default_rng(6)
+    aff, labels = _affinity_arrays(rng)
+    want = precompute_static(aff, labels)
+    got = precompute_static_fast(aff, labels)  # CPU backend: jnp path
+    for k in ("allow_hit", "forbid_hit", "prio_counts"):
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(want[k]))
