@@ -28,21 +28,26 @@ exactly-once against the promoted truth.
 from __future__ import annotations
 
 import os
+import zlib
 from typing import Optional, Tuple
 
 from kubernetes_tpu.server.durable import _HDR, DurableStore
 
 
 def _complete_frame_prefix(data: bytes) -> int:
-    """Length of the longest prefix of `data` consisting of whole WAL
-    frames. Shipping must be frame-aligned: if a half-record shipped and
-    the standby's torn-tail repair dropped it, the record's second half
-    arriving next pass would desynchronize every frame after it."""
+    """Length of the longest prefix of `data` consisting of whole,
+    CRC-VALID WAL frames. Shipping must be frame-aligned (a half-record
+    shipped and then dropped by the standby's torn-tail repair would
+    desynchronize every later frame), and CRC-checked (bytes read at a
+    stale offset after a primary compaction can be length-plausible
+    garbage — the checksum is what proves they are frames)."""
     off = 0
     while off + _HDR.size <= len(data):
-        ln, _crc = _HDR.unpack_from(data, off)
+        ln, crc = _HDR.unpack_from(data, off)
         end = off + _HDR.size + ln
         if end > len(data):
+            break
+        if zlib.crc32(data[off + _HDR.size:end]) != crc:
             break
         off = end
     return off
@@ -93,26 +98,36 @@ class WalShippingStandby:
           (the primary truncated its WAL at that instant)
         - WAL shrunk below our offset without a visible new snapshot
           (raced mid-compaction): same reset, next pass catches up
-        """
+
+        A compaction can also land BETWEEN reading the snapshot signature
+        and reading the WAL (the primary is another process): the
+        signature is re-checked after the WAL read, and a changed one
+        discards this pass's bytes and retries — appending them would
+        stack post-compaction frames on the pre-compaction standby
+        snapshot, silently skipping the records in between."""
         shipped = 0
-        sig = self._snapshot_signature()
-        try:
-            wal_size = os.path.getsize(self._p_wal)
-        except FileNotFoundError:
-            wal_size = 0
-        if sig != self._snap_sig or wal_size < self._wal_offset:
-            if sig is not None:
-                self._copy_snapshot()
-                shipped += sig[1]
-            self._snap_sig = sig
-            self._wal_offset = 0
-            # the primary's WAL restarted at its snapshot point; ours must
-            # restart with it or we'd replay pre-snapshot records twice
-            open(self._s_wal, "wb").close()
-        if wal_size > self._wal_offset:
-            with open(self._p_wal, "rb") as src:
-                src.seek(self._wal_offset)
-                data = src.read(wal_size - self._wal_offset)
+        for _attempt in range(4):
+            sig = self._snapshot_signature()
+            try:
+                wal_size = os.path.getsize(self._p_wal)
+            except FileNotFoundError:
+                wal_size = 0
+            if sig != self._snap_sig or wal_size < self._wal_offset:
+                if sig is not None:
+                    self._copy_snapshot()
+                    shipped += sig[1]
+                self._snap_sig = sig
+                self._wal_offset = 0
+                # the primary's WAL restarted at its snapshot point; ours
+                # must restart with it or we'd replay pre-snapshot records
+                open(self._s_wal, "wb").close()
+            data = b""
+            if wal_size > self._wal_offset:
+                with open(self._p_wal, "rb") as src:
+                    src.seek(self._wal_offset)
+                    data = src.read(wal_size - self._wal_offset)
+            if self._snapshot_signature() != sig:
+                continue  # compaction raced this pass; retry clean
             n = _complete_frame_prefix(data)
             if n:
                 with open(self._s_wal, "ab") as dst:
@@ -121,6 +136,7 @@ class WalShippingStandby:
                     os.fsync(dst.fileno())
                 self._wal_offset += n
                 shipped += n
+            break
         self.ships += 1
         self.bytes_shipped += shipped
         return shipped

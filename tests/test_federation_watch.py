@@ -1,0 +1,148 @@
+"""Watch-driven federation (federation/sync_loop.py).
+
+The r4 VERDICT's weak #6 done-criterion: cluster-loss rebalance happens
+from a WATCH EVENT with no manual sync_all() call — plus the other
+reference behaviors the informer wiring buys (member-drift self-heal from
+the member's own watch stream, auto-watch on join, deletion propagation).
+Reference pattern: federation/pkg/federatedtypes sync controllers on
+informers + workqueue with clusterDeliverer full-reconciles."""
+
+from kubernetes_tpu.api.cluster import ConfigMap
+from kubernetes_tpu.api.workloads import ReplicaSet
+from kubernetes_tpu.federation.controller import (
+    FEDERATED_RS_KIND,
+    FederatedReplicaSet,
+    FederationControlPlane,
+    MANAGED_ANNOTATION,
+)
+from kubernetes_tpu.federation.sync_loop import FederationSyncLoop
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite, NotFound
+
+
+def mk_plane(*names):
+    plane = FederationControlPlane()
+    members = {}
+    for n in names:
+        api = ApiServerLite()
+        members[n] = api
+        plane.join(n, api)
+    return plane, members
+
+
+def mk_frs(replicas=10, name="web"):
+    return FederatedReplicaSet(
+        name=name, replicas=replicas,
+        template=ReplicaSet(name=name))
+
+
+def test_create_event_drives_children():
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.pump()  # cluster ADDs start the member watches
+    plane.api.create(FEDERATED_RS_KIND, mk_frs(10))
+    loop.pump(rounds=2)
+    a = members["alpha"].get("ReplicaSet", "default", "web")
+    b = members["beta"].get("ReplicaSet", "default", "web")
+    assert a.replicas + b.replicas == 10
+    assert loop.syncs > 0
+
+
+def test_cluster_loss_rebalances_from_watch_event():
+    """THE done-criterion: no sync_all anywhere — readiness flips on the
+    federation apiserver, the Cluster informer fires, the queue drains,
+    replicas move."""
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.pump()
+    plane.api.create(FEDERATED_RS_KIND, mk_frs(10))
+    loop.pump(rounds=2)
+    before = members["alpha"].get("ReplicaSet", "default", "web").replicas
+    assert 0 < before < 10
+    # beta dies: ONLY the API write happens; the loop must react on its own
+    plane.mark_ready("beta", False)
+    loop.pump(rounds=2)
+    assert members["alpha"].get(
+        "ReplicaSet", "default", "web").replicas == 10
+    try:
+        beta_rs = members["beta"].get("ReplicaSet", "default", "web")
+        assert beta_rs is None or beta_rs.replicas == 0
+    except NotFound:
+        pass  # removed from the lost cluster's plan entirely
+
+
+def test_member_drift_self_heals_from_member_watch():
+    """Someone hand-deletes the child in a member cluster: the MEMBER's
+    watch stream enqueues the federated parent; no federation-side event
+    needed."""
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.pump()
+    plane.api.create(FEDERATED_RS_KIND, mk_frs(10))
+    loop.pump(rounds=2)
+    members["alpha"].delete("ReplicaSet", "default", "web")
+    loop.pump(rounds=2)
+    assert members["alpha"].get("ReplicaSet", "default", "web") is not None
+
+
+def test_late_join_auto_watched_and_rebalanced():
+    import json
+
+    from kubernetes_tpu.federation.planner import PREFERENCES_ANNOTATION
+    plane, members = mk_plane("alpha")
+    loop = FederationSyncLoop(plane)
+    loop.pump()
+    frs = mk_frs(10)
+    # rebalance=true: without it the planner is deliberately sticky and a
+    # late joiner gets nothing (reference planner semantics)
+    frs.annotations[PREFERENCES_ANNOTATION] = json.dumps(
+        {"rebalance": True, "clusters": {"*": {"weight": 1}}})
+    plane.api.create(FEDERATED_RS_KIND, frs)
+    loop.pump(rounds=2)
+    assert members["alpha"].get(
+        "ReplicaSet", "default", "web").replicas == 10
+    # a new cluster joins: the Cluster ADD event triggers the rebalance
+    gamma = ApiServerLite()
+    plane.join("gamma", gamma)
+    loop.pump(rounds=2)
+    a = members["alpha"].get("ReplicaSet", "default", "web").replicas
+    g = gamma.get("ReplicaSet", "default", "web").replicas
+    assert a + g == 10 and g > 0
+    # and gamma's own drift now self-heals (its watch is live)
+    gamma.delete("ReplicaSet", "default", "web")
+    loop.pump(rounds=2)
+    assert gamma.get("ReplicaSet", "default", "web") is not None
+
+
+def test_deletion_propagates_absence():
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.pump()
+    plane.api.create(FEDERATED_RS_KIND, mk_frs(6))
+    loop.pump(rounds=2)
+    plane.api.delete(FEDERATED_RS_KIND, "default", "web")
+    loop.pump(rounds=2)
+    for api in members.values():
+        try:
+            assert api.get("ReplicaSet", "default", "web") is None
+        except NotFound:
+            pass
+
+
+def test_propagated_kinds_flow_through_the_loop():
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.pump()
+    plane.api.create("FederatedConfigMap",
+                     ConfigMap(name="settings", data={"k": "v"}))
+    loop.pump(rounds=2)
+    for api in members.values():
+        cm = api.get("ConfigMap", "default", "settings")
+        assert cm.data == {"k": "v"}
+        assert cm.annotations[MANAGED_ANNOTATION] == "true"
+    plane.api.delete("FederatedConfigMap", "default", "settings")
+    loop.pump(rounds=2)
+    for api in members.values():
+        try:
+            assert api.get("ConfigMap", "default", "settings") is None
+        except NotFound:
+            pass
