@@ -118,6 +118,11 @@ class RestServer:
                     return self._send(200, api.configz())
                 if url.path == "/version":
                     return self._send(200, VERSION)
+                if url.path in ("/openapi/v2", "/swagger.json"):
+                    # routes/openapi.go: the generated spec, served at
+                    # both the modern and the 1.7 swagger paths
+                    from kubernetes_tpu.server.openapi import build_spec
+                    return self._send(200, build_spec(api.store))
                 if url.path == "/metrics":
                     text = outer.metrics_text() if outer.metrics_text else ""
                     body = text.encode()
