@@ -885,7 +885,7 @@ class Ktctl:
         if len(pos) < 3:
             raise SystemExit(
                 "error: usage: rollout "
-                "{status|history|undo|pause|resume} KIND NAME")
+                "{status|history|undo|pause|resume|restart} KIND NAME")
         sub, kind_arg, name = pos[0], pos[1], pos[2]
         kind = self._resolve_kind(kind_arg)
         ns = flags.get("namespace", "default")
@@ -926,6 +926,20 @@ class Ktctl:
             obj.paused = want
             self.api.update(kind, obj)
             self._print(f"{self._plural(kind)}/{name} {sub}d")
+        elif sub == "restart":
+            # kubectl rollout restart (cmd/rollout_restart.go): stamp a
+            # restartedAt annotation on the POD TEMPLATE — the template
+            # change hashes differently, so the controller rolls new pods
+            # without any spec change
+            tmpl = getattr(obj, "template", None)
+            if tmpl is None or not hasattr(tmpl, "annotations"):
+                raise SystemExit(
+                    f"error: {kind} does not support restart")
+            import time as _time
+            tmpl.annotations["kubectl.kubernetes.io/restartedAt"] = \
+                str(_time.time())
+            self.api.update(kind, obj)
+            self._print(f"{self._plural(kind)}/{name} restarted")
         else:
             raise SystemExit(f"error: unknown rollout subcommand {sub!r}")
 
@@ -1293,6 +1307,134 @@ class Ktctl:
             self._print(target.serve_exec(ns, name, cmd))
         except KubeletApiError as e:
             raise SystemExit(f"error: {e}") from None
+
+    def cmd_attach(self, args):
+        """kubectl attach (non-streaming form): attach to the RUNNING
+        container's output via the node's kubelet /attach endpoint
+        (kubectl cmd/attach.go; SPDY streaming elided like exec)."""
+        import urllib.request
+
+        from kubernetes_tpu.nodes.kubelet_server import KubeletApiError
+        from kubernetes_tpu.server.apiserver_lite import NotFound
+
+        pos, flags = self._flags(args)
+        if not pos:
+            raise SystemExit("error: pod name required")
+        ns = flags.get("namespace", "default")
+        try:
+            pod = self.api.get("Pod", ns, pos[0])
+        except NotFound as e:
+            raise SystemExit(f"error: {e}") from None
+        if not pod.node_name:
+            raise SystemExit(f"error: pod {pos[0]!r} is not scheduled yet")
+        target = self._kubelet_for(pod.node_name)
+        if isinstance(target, str):
+            req = urllib.request.Request(f"{target}/attach/{ns}/{pos[0]}",
+                                         data=b"", method="POST")
+            try:
+                with urllib.request.urlopen(req) as r:
+                    self._print(r.read().decode().rstrip("\n"))
+            except Exception as e:
+                raise SystemExit(f"error: attach failed: {e}") from None
+            return
+        try:
+            self._print(target.serve_attach(ns, pos[0]))
+        except KubeletApiError as e:
+            raise SystemExit(f"error: {e}") from None
+
+    def cmd_port_forward(self, args):
+        """kubectl port-forward: bind a REAL local TCP port; every
+        connection is answered with one round of the pod's port stream
+        fetched through the kubelet (cmd/portforward.go; the kubelet leg
+        is /portForward). Runs on a daemon thread (the in-process harness
+        cannot block the CLI loop the way kubectl's foreground does);
+        forwarders are exposed on self.port_forwards with .local_port and
+        .stop()."""
+        import socket
+        import threading
+
+        from kubernetes_tpu.nodes.kubelet_server import KubeletApiError
+        from kubernetes_tpu.server.apiserver_lite import NotFound
+
+        pos, flags = self._flags(args)
+        if len(pos) < 2 or ":" not in pos[1]:
+            raise SystemExit(
+                "error: usage: port-forward POD LOCAL:REMOTE")
+        ns = flags.get("namespace", "default")
+        local_s, _, remote_s = pos[1].partition(":")
+        try:
+            local, remote = int(local_s), int(remote_s)
+        except ValueError:
+            raise SystemExit(
+                f"error: invalid port mapping {pos[1]!r}") from None
+        try:
+            pod = self.api.get("Pod", ns, pos[0])
+        except NotFound as e:
+            raise SystemExit(f"error: {e}") from None
+        if not pod.node_name:
+            raise SystemExit(f"error: pod {pos[0]!r} is not scheduled yet")
+        target = self._kubelet_for(pod.node_name)
+
+        def fetch() -> bytes:
+            if isinstance(target, str):
+                import urllib.request
+                with urllib.request.urlopen(
+                        f"{target}/portForward/{ns}/{pos[0]}"
+                        f"?port={remote}") as r:
+                    return r.read()
+            return target.serve_port(ns, pos[0], remote)
+
+        try:
+            fetch()  # fail fast: bad pod/port surfaces NOW, not per-conn
+        except KubeletApiError as e:
+            raise SystemExit(f"error: {e}") from None
+        except Exception as e:
+            raise SystemExit(f"error: port-forward failed: {e}") from None
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind(("127.0.0.1", local))
+        except OSError as e:
+            raise SystemExit(
+                f"error: unable to listen on port {local}: {e}") from None
+        srv.listen(8)
+
+        class Forwarder:
+            local_port = srv.getsockname()[1]
+
+            def __init__(self):
+                self._alive = True
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                while self._alive:
+                    try:
+                        conn, _addr = srv.accept()
+                    except OSError:
+                        return
+                    try:
+                        conn.sendall(fetch())
+                    except Exception:
+                        pass
+                    finally:
+                        conn.close()
+
+            def stop(self):
+                self._alive = False
+                try:
+                    srv.close()
+                except OSError:
+                    pass
+
+        fwd = Forwarder()
+        if not hasattr(self, "port_forwards"):
+            self.port_forwards = []
+        self.port_forwards.append(fwd)
+        self._print(f"Forwarding from 127.0.0.1:{fwd.local_port} -> "
+                    f"{remote}")
 
     def cmd_version(self, args):
         from kubernetes_tpu.server.rest_http import VERSION

@@ -566,6 +566,39 @@ class HollowKubelet:
             lines = lines[-n:] if n > 0 else []
         return "\n".join(lines)
 
+    def serve_attach(self, namespace: str, name: str) -> str:
+        """POST /attach/<ns>/<pod>: attach to the running container's
+        output stream (server.go InstallDebuggingHandlers attach; the
+        hollow stream is the pod's current log tail). Attaching to a pod
+        that is not Running is an error, unlike logs."""
+        from kubernetes_tpu.nodes.kubelet_server import KubeletApiError
+        pod = self._admitted.get(namespace + "/" + name)
+        if pod is None or pod.key() in self._starting:
+            raise KubeletApiError(
+                404, f'cannot attach: pod "{namespace}/{name}" is not '
+                     f'running on node "{self.node_name}"')
+        return self.serve_logs(namespace, name)
+
+    PORT_ANNOTATION_PREFIX = "bench/port-"
+
+    def serve_port(self, namespace: str, name: str, port: int) -> bytes:
+        """GET /portForward/<ns>/<pod>?port=N: one round of the
+        port-forward stream — what the pod "serves" on that port (the
+        hollow runtime scripts it via the bench/port-<N> annotation, the
+        way it scripts exec outputs)."""
+        from kubernetes_tpu.nodes.kubelet_server import KubeletApiError
+        pod = self._admitted.get(namespace + "/" + name)
+        if pod is None:
+            raise KubeletApiError(
+                404, f'pod "{namespace}/{name}" is not running on node '
+                     f'"{self.node_name}"')
+        payload = pod.annotations.get(
+            self.PORT_ANNOTATION_PREFIX + str(port))
+        if payload is None:
+            raise KubeletApiError(
+                400, f"pod {namespace}/{name} does not serve port {port}")
+        return payload.encode()
+
     def serve_exec(self, namespace: str, name: str, cmd: str) -> str:
         """POST /exec/<ns>/<pod>?command=...: canned hollow outputs."""
         from kubernetes_tpu.nodes.kubelet_server import (

@@ -72,6 +72,18 @@ class KubeletServer:
                     return self._send(200, {"items": k.serve_pods()})
                 if url.path == "/stats/summary":
                     return self._send(200, k.serve_stats())
+                if parts[:1] == ["portForward"] and len(parts) >= 3:
+                    # /portForward/<ns>/<pod>?port=N — one stream round
+                    q = parse_qs(url.query)
+                    try:
+                        port = int(q.get("port", ["0"])[0])
+                        data = k.serve_port(parts[1], parts[2], port)
+                    except KubeletApiError as e:
+                        return self._send(e.code, {"message": str(e)})
+                    except ValueError:
+                        return self._send(400, {"message": "bad port"})
+                    return self._send(200, data,
+                                      "application/octet-stream")
                 if parts[:1] == ["containerLogs"] and len(parts) >= 3:
                     # /containerLogs/<ns>/<pod>[/<container>]
                     q = parse_qs(url.query)
@@ -88,6 +100,13 @@ class KubeletServer:
                 url = urlparse(self.path)
                 parts = [p for p in url.path.split("/") if p]
                 k = outer.kubelet
+                if parts[:1] == ["attach"] and len(parts) >= 3:
+                    # /attach/<ns>/<pod> — the running container's stream
+                    try:
+                        out = k.serve_attach(parts[1], parts[2])
+                    except KubeletApiError as e:
+                        return self._send(e.code, {"message": str(e)})
+                    return self._send(200, out.encode(), "text/plain")
                 if parts[:1] == ["exec"] and len(parts) >= 3:
                     # /exec/<ns>/<pod>?command=<cmd> (the non-streaming
                     # half of the exec contract; SPDY upgrade elided)

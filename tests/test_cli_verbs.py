@@ -1,0 +1,111 @@
+"""Round-5 CLI verbs: attach, port-forward, rollout restart
+(cli/ktctl.py; ref pkg/kubectl/cmd/{attach,portforward,rollout_restart}.go,
+kubelet legs in nodes/kubelet_server.py)."""
+
+import io
+import socket
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.api.workloads import Namespace
+from kubernetes_tpu.cli.ktctl import Ktctl
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.nodes.kubelet import HollowFleet
+from kubernetes_tpu.nodes.kubelet_server import KubeletServer
+from kubernetes_tpu.server.apiserver import ApiServer
+
+
+def mk_cluster():
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    factory = SharedInformerFactory(api.store)
+    fleet = HollowFleet(api.store, factory)
+    fleet.add_node(make_node("n0"))
+    factory.step_all()
+    out = io.StringIO()
+    kt = Ktctl(api, out=out, kubelets=dict(fleet.kubelets))
+    return api, factory, fleet, kt, out
+
+
+def run_pod(api, factory, fleet, name="web", annotations=None):
+    pod = make_pod(name, cpu=100, node_name="n0")
+    pod.annotations.update(annotations or {})
+    api.store.create("Pod", pod)
+    factory.step_all()
+    fleet.step()
+    assert api.store.get("Pod", "default", name).phase == "Running"
+    return pod
+
+
+def test_attach_streams_running_container():
+    api, factory, fleet, kt, out = mk_cluster()
+    run_pod(api, factory, fleet,
+            annotations={"bench/log-lines": "line1\nline2"})
+    assert kt.run(["attach", "web"]) == 0
+    assert out.getvalue().strip().endswith("line2")
+    # attaching to a pod that is not running errors (unlike logs)
+    assert kt.run(["attach", "ghost"]) != 0
+
+
+def test_attach_over_http_kubelet():
+    api, factory, fleet, kt, out = mk_cluster()
+    run_pod(api, factory, fleet,
+            annotations={"bench/log-lines": "hello"})
+    srv = KubeletServer(fleet.kubelets["n0"])
+    srv.start()
+    try:
+        kt.kubelets = {"n0": f"http://127.0.0.1:{srv.port}"}
+        assert kt.run(["attach", "web"]) == 0
+        assert "hello" in out.getvalue()
+    finally:
+        srv.stop()
+
+
+def test_port_forward_round_trip():
+    api, factory, fleet, kt, out = mk_cluster()
+    run_pod(api, factory, fleet,
+            annotations={"bench/port-80": "HTTP/1.0 200 OK\r\n\r\nhome"})
+    assert kt.run(["port-forward", "web", "0:80"]) == 0
+    fwd = kt.port_forwards[-1]
+    try:
+        assert f"127.0.0.1:{fwd.local_port}" in out.getvalue()
+        # a REAL tcp connection to the forwarded port gets the pod's bytes
+        with socket.create_connection(("127.0.0.1", fwd.local_port),
+                                      timeout=5) as conn:
+            data = b""
+            while True:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+        assert data.endswith(b"home")
+    finally:
+        fwd.stop()
+
+
+def test_port_forward_rejects_unserved_port():
+    api, factory, fleet, kt, out = mk_cluster()
+    run_pod(api, factory, fleet)
+    assert kt.run(["port-forward", "web", "0:9999"]) != 0
+    assert "9999" in out.getvalue()
+
+
+def test_rollout_restart_stamps_template():
+    from kubernetes_tpu.api.types import LabelSelector, Pod
+    from kubernetes_tpu.api.workloads import Deployment
+    api, factory, fleet, kt, out = mk_cluster()
+    api.store.create("Deployment", Deployment(
+        name="app", replicas=1,
+        selector=LabelSelector(match_labels={"app": "app"}),
+        template=Pod(name="", labels={"app": "app"})))
+    assert kt.run(["rollout", "restart", "deploy", "app"]) == 0
+    dep = api.store.get("Deployment", "default", "app")
+    assert "kubectl.kubernetes.io/restartedAt" in dep.template.annotations
+    assert "restarted" in out.getvalue()
+    # a second restart moves the stamp (a fresh rollout each time)
+    first = dep.template.annotations["kubectl.kubernetes.io/restartedAt"]
+    import time
+    time.sleep(0.01)
+    assert kt.run(["rollout", "restart", "deploy", "app"]) == 0
+    second = api.store.get("Deployment", "default", "app") \
+        .template.annotations["kubectl.kubernetes.io/restartedAt"]
+    assert second != first

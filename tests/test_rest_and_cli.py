@@ -349,8 +349,8 @@ def test_rollout_pause_resume_freezes_controller():
     ctrl.pump()
     rs = api.store.list("ReplicaSet")[0]
     assert len(rs) == 1 and rs[0].replicas == 3
-    # unknown subcommand errors cleanly
-    assert kt.run(["rollout", "restart", "deploy", "web"]) == 1
+    # unknown subcommand errors cleanly (restart is a real verb now)
+    assert kt.run(["rollout", "bogus", "deploy", "web"]) == 1
 
 
 def test_describe_shows_events_section():
