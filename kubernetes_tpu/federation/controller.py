@@ -177,7 +177,9 @@ class FederatedReplicaSetController:
             if rs is None:
                 child = dataclasses.replace(
                     frs.template, name=frs.name, namespace=frs.namespace,
-                    replicas=want, resource_version=0)
+                    replicas=want, resource_version=0,
+                    annotations={**getattr(frs.template, "annotations", {}),
+                                 MANAGED_ANNOTATION: "true"})
                 try:
                     api.create(self.CHILD_KIND, child)
                 except Conflict:

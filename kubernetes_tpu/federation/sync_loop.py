@@ -158,8 +158,21 @@ class FederationSyncLoop:
     def _delete_children(self, child_kind: str, ns: str, name: str) -> None:
         # ALL members, not just ready ones — a child orphaned in a
         # not-ready cluster would otherwise survive forever (nothing
-        # requeues a deleted federated object when the cluster comes back)
+        # requeues a deleted federated object when the cluster comes back).
+        # ONLY managed children: member watch events fire for objects
+        # federation never owned (a user's local ReplicaSet, a member
+        # Deployment's hash-named child RSs), and deleting those here
+        # would destroy user workloads — the same ownership guard
+        # propagate_kind applies (controller.py MANAGED_ANNOTATION)
+        from kubernetes_tpu.federation.controller import MANAGED_ANNOTATION
         for api in list(self.plane.members.values()):
+            try:
+                cur = api.get(child_kind, ns, name)
+            except NotFound:
+                continue
+            if getattr(cur, "annotations", {}).get(MANAGED_ANNOTATION) \
+                    != "true":
+                continue
             try:
                 api.delete(child_kind, ns, name)
             except NotFound:

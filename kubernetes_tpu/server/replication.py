@@ -107,6 +107,7 @@ class WalShippingStandby:
         snapshot, silently skipping the records in between."""
         shipped = 0
         for _attempt in range(4):
+            shipped = 0  # a retried attempt's copies don't count twice
             sig = self._snapshot_signature()
             try:
                 wal_size = os.path.getsize(self._p_wal)

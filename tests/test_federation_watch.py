@@ -128,6 +128,33 @@ def test_deletion_propagates_absence():
             pass
 
 
+def test_loop_never_deletes_unmanaged_member_objects():
+    """A user's plain ReplicaSet created directly in a member cluster has
+    no federated parent: its watch event enqueues a federated key that
+    resolves NotFound — and the loop must LEAVE IT ALONE (the managed
+    ownership guard), not delete it from every cluster."""
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.pump()
+    members["alpha"].create("ReplicaSet",
+                            ReplicaSet(name="local-web", replicas=3))
+    loop.pump(rounds=3)
+    survivor = members["alpha"].get("ReplicaSet", "default", "local-web")
+    assert survivor is not None and survivor.replicas == 3
+    # while MANAGED children of a real deleted federated object DO go
+    plane.api.create(FEDERATED_RS_KIND, mk_frs(4, name="owned"))
+    loop.pump(rounds=2)
+    assert members["alpha"].get("ReplicaSet", "default", "owned") \
+        .annotations[MANAGED_ANNOTATION] == "true"
+    plane.api.delete(FEDERATED_RS_KIND, "default", "owned")
+    loop.pump(rounds=2)
+    try:
+        gone = members["alpha"].get("ReplicaSet", "default", "owned")
+        assert gone is None
+    except NotFound:
+        pass
+
+
 def test_propagated_kinds_flow_through_the_loop():
     plane, members = mk_plane("alpha", "beta")
     loop = FederationSyncLoop(plane)
