@@ -59,14 +59,22 @@ class Histogram:
             self._observe_locked(v, n)
 
     def observe_batch(self, values: List[float]) -> None:
-        """Record a round's worth of DISTINCT per-pod values under one lock
-        (30k individual observe() calls would pay 30k lock round-trips on
-        the hot drain path)."""
+        """Record a round's worth of DISTINCT per-pod values under one lock,
+        vectorized — 30k individual observe() calls would pay 30k lock
+        round-trips and bisects on the hot drain path."""
         if not values:
             return
+        import numpy as np
+        arr = np.asarray(values, dtype=np.float64)
+        # bisect_left semantics == searchsorted 'left'
+        idx = np.searchsorted(np.asarray(self.buckets), arr, side="left")
+        binned = np.bincount(idx, minlength=len(self.buckets) + 1)
         with self._lock:
-            for v in values:
-                self._observe_locked(v, 1)
+            for i, c in enumerate(binned):
+                self._counts[i] += int(c)
+            self._sum += float(arr.sum())
+            self._count += len(values)
+            self._values.extend((float(v), 1) for v in arr)
 
     @property
     def count(self) -> int:
