@@ -1010,6 +1010,113 @@ class Ktctl:
             name=pos[3] if len(pos) > 3 else "")
         self._print("yes" if authorizer.authorize(attrs) == ALLOW else "no")
 
+    def cmd_explain(self, args):
+        """kubectl explain KIND[.field[.field]]: field documentation from
+        the live OpenAPI document (kubectl cmd/explain.go reads the same
+        swagger the server publishes — here server/openapi.py, which
+        derives from the serving dataclasses, so explain can never drift
+        from what the server accepts)."""
+        pos, _flags = self._flags(args)
+        if not pos:
+            raise SystemExit("error: resource name required")
+        path = pos[0].split(".")
+        kind = self._resolve_kind(path[0])
+        store = getattr(self.api, "store", None)
+        if store is not None:
+            from kubernetes_tpu.server.openapi import build_spec
+            spec = build_spec(store)
+        else:
+            # remote backend: fetch the server-PUBLISHED document so CRD
+            # definitions the server serves are visible here too
+            spec = self.api.openapi()
+        schema = spec["definitions"].get(kind)
+        if schema is None:
+            raise SystemExit(
+                f"error: couldn't find resource for {path[0]!r}")
+        is_array = False
+        for field_name in path[1:]:
+            props = schema.get("properties", {})
+            if field_name not in props:
+                raise SystemExit(
+                    f'error: field "{field_name}" does not exist')
+            schema = props[field_name]
+            is_array = schema.get("type") == "array"
+            if is_array:
+                schema = schema.get("items", {})
+        self._print(f"KIND:     {kind}")
+        self._print(f"VERSION:  v1\n")
+        if len(path) > 1:
+            t = schema.get("type", "object")
+            self._print(f"FIELD:    {path[-1]} "
+                        f"<{'[]' + t if is_array else t}>")
+        props = schema.get("properties")
+        if props:
+            self._print("FIELDS:")
+            for fname, fschema in sorted(props.items()):
+                self._print(f"   {fname}\t<{fschema.get('type', 'object')}>")
+
+    def cmd_run(self, args):
+        """kubectl run NAME --image=IMG [--replicas=N] (cmd/run.go, the
+        1.7 generator behavior): one pod by default, a Deployment when
+        --replicas is given."""
+        pos, flags = self._flags(args)
+        if not pos or not flags.get("image"):
+            raise SystemExit("error: usage: run NAME --image=IMAGE")
+        ns = flags.get("namespace", "default")
+        name = pos[0]
+        from kubernetes_tpu.api.types import (
+            Container,
+            LabelSelector,
+            Pod,
+        )
+        reps = flags.get("replicas")
+        if reps is None:
+            pod = Pod(name=name, namespace=ns, labels={"run": name},
+                      containers=[Container(name=name,
+                                            image=flags["image"])])
+            self.api.create("Pod", pod)
+            self._print(f"pod/{name} created")
+            return
+        from kubernetes_tpu.api.workloads import Deployment
+        dep = Deployment(
+            name=name, namespace=ns, replicas=int(reps),
+            selector=LabelSelector(match_labels={"run": name}),
+            template=Pod(name="", namespace=ns, labels={"run": name},
+                         containers=[Container(name=name,
+                                               image=flags["image"])]))
+        self.api.create("Deployment", dep)
+        self._print(f"deployment/{name} created")
+
+    def cmd_autoscale(self, args):
+        """kubectl autoscale KIND NAME --min=N --max=M [--cpu-percent=P]
+        (cmd/autoscale.go): create an HPA targeting the workload."""
+        pos, flags = self._flags(args)
+        if len(pos) < 2 or "max" not in flags:
+            raise SystemExit(
+                "error: usage: autoscale KIND NAME --max=N [--min=N] "
+                "[--cpu-percent=P]")
+        kind = self._resolve_kind(pos[0])
+        ns = flags.get("namespace", "default")
+        from kubernetes_tpu.server.apiserver_lite import NotFound
+        try:
+            self.api.get(kind, ns, pos[1])  # target must exist
+        except NotFound as e:
+            raise SystemExit(f"error: {e}") from None
+        from kubernetes_tpu.api.workloads import HorizontalPodAutoscaler
+        lo, hi = int(flags.get("min", 1)), int(flags["max"])
+        if hi <= 0 or lo > hi:
+            # kubectl rejects this at the CLI; letting it through would
+            # pin the workload at min forever (the controller clamps
+            # max(min, min(max, desired)))
+            raise SystemExit(
+                f"error: --max={hi} must be at least 1 and >= --min={lo}")
+        hpa = HorizontalPodAutoscaler(
+            name=pos[1], namespace=ns, target_kind=kind,
+            target_name=pos[1], min_replicas=lo, max_replicas=hi,
+            target_cpu_utilization=int(flags.get("cpu-percent", 80)))
+        self.api.create("HorizontalPodAutoscaler", hpa)
+        self._print(f"horizontalpodautoscaler/{pos[1]} autoscaled")
+
     def cmd_expose(self, args):
         """kubectl expose KIND NAME --port P [--target-port T] [--name N]:
         create a Service selecting the workload's pods

@@ -39,6 +39,11 @@ class RestClient:
             self._discovery_cache = self._do("GET", self.base + "/apis")
         return self._discovery_cache
 
+    def openapi(self) -> dict:
+        """GET /openapi/v2 — the server-published OpenAPI document
+        (ktctl explain's remote source)."""
+        return self._do("GET", self.base + "/openapi/v2")
+
     def _url(self, kind: str, namespace: str, name: str = "",
              sub: str = "") -> str:
         if kind in KIND_INFO:

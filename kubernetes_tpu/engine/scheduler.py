@@ -117,12 +117,14 @@ class Scheduler:
             vctx.pvcs[(pvc.namespace, pvc.name)] = pvc
         vctx.version += 1
         pods, rv = self.api.list("Pod")
+        listed_at = time.monotonic()  # one instant for the whole List —
+        # 30k per-pod clock reads would be pure accounting overhead
         for p in pods:
             self._pods[p.key()] = p
             if p.node_name:
                 self.cache.add_pod(p)
             elif self._responsible_for(p):
-                self._first_queued.setdefault(p.key(), time.monotonic())
+                self._first_queued.setdefault(p.key(), listed_at)
                 self.queue.add(dataclasses.replace(p))
         self._rv = rv
         self._started = True

@@ -20,17 +20,19 @@ from typing import Any, Dict
 VERSION_INFO = {"title": "kubernetes-tpu", "version": "v1.7-tpu"}
 
 
-def _schema_for_type(tp: Any) -> Dict[str, Any]:
+def _schema_for_type(tp: Any, depth: int = 0) -> Dict[str, Any]:
     origin = typing.get_origin(tp)
-    if origin in (list, typing.List):
+    if origin in (list, typing.List, tuple, typing.Tuple):
         args = typing.get_args(tp)
-        item = _schema_for_type(args[0]) if args else {"type": "object"}
+        item = _schema_for_type(args[0], depth) if args \
+            else {"type": "object"}
         return {"type": "array", "items": item}
     if origin in (dict, typing.Dict):
         return {"type": "object", "additionalProperties": True}
     if origin is typing.Union:  # Optional[X]
         args = [a for a in typing.get_args(tp) if a is not type(None)]
-        return _schema_for_type(args[0]) if args else {"type": "object"}
+        return _schema_for_type(args[0], depth) if args \
+            else {"type": "object"}
     if tp is int:
         return {"type": "integer", "format": "int64"}
     if tp is float:
@@ -40,22 +42,25 @@ def _schema_for_type(tp: Any) -> Dict[str, Any]:
     if tp is str:
         return {"type": "string"}
     if isinstance(tp, type) and dataclasses.is_dataclass(tp):
-        # nested dataclasses inline as objects (no $ref cycles to manage
-        # at this scale; the reference $refs everything via gen)
-        return {"type": "object"}
+        # nested dataclasses inline their fields (no $ref plumbing at
+        # this scale; the reference $refs via gen) — depth-capped so a
+        # future recursive type cannot blow the document up
+        if depth >= 4:
+            return {"type": "object"}
+        return _definition_for(tp, depth + 1)
     if isinstance(tp, type) and issubclass(tp, str):  # str enums
         return {"type": "string"}
     return {"type": "object"}
 
 
-def _definition_for(cls: type) -> Dict[str, Any]:
+def _definition_for(cls: type, depth: int = 0) -> Dict[str, Any]:
     props: Dict[str, Any] = {}
     try:
         hints = typing.get_type_hints(cls)
     except Exception:
         hints = {f.name: f.type for f in dataclasses.fields(cls)}
     for f in dataclasses.fields(cls):
-        props[f.name] = _schema_for_type(hints.get(f.name, str))
+        props[f.name] = _schema_for_type(hints.get(f.name, str), depth)
     return {"type": "object", "properties": props}
 
 

@@ -109,3 +109,87 @@ def test_rollout_restart_stamps_template():
     second = api.store.get("Deployment", "default", "app") \
         .template.annotations["kubectl.kubernetes.io/restartedAt"]
     assert second != first
+
+
+def test_explain_reads_live_openapi():
+    api, factory, fleet, kt, out = mk_cluster()
+    assert kt.run(["explain", "pod"]) == 0
+    text = out.getvalue()
+    assert "KIND:     Pod" in text
+    assert "containers" in text
+    out.truncate(0), out.seek(0)
+    assert kt.run(["explain", "pod.containers"]) == 0
+    assert "image" in out.getvalue()
+    assert kt.run(["explain", "pod.nosuchfield"]) != 0
+
+
+def test_run_creates_pod_or_deployment():
+    api, factory, fleet, kt, out = mk_cluster()
+    assert kt.run(["run", "one", "--image", "app:v1"]) == 0
+    assert api.store.get("Pod", "default", "one") \
+        .containers[0].image == "app:v1"
+    assert kt.run(["run", "many", "--image", "app:v1",
+                   "--replicas", "3"]) == 0
+    dep = api.store.get("Deployment", "default", "many")
+    assert dep.replicas == 3
+    assert dep.template.containers[0].image == "app:v1"
+
+
+def test_autoscale_creates_hpa():
+    from kubernetes_tpu.api.types import LabelSelector, Pod
+    from kubernetes_tpu.api.workloads import Deployment
+    api, factory, fleet, kt, out = mk_cluster()
+    api.store.create("Deployment", Deployment(
+        name="web", replicas=2,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=Pod(name="", labels={"app": "web"})))
+    assert kt.run(["autoscale", "deploy", "web", "--min", "2",
+                   "--max", "8", "--cpu-percent", "70"]) == 0
+    hpa = api.store.get("HorizontalPodAutoscaler", "default", "web")
+    assert hpa.min_replicas == 2 and hpa.max_replicas == 8
+    assert hpa.target_cpu_utilization == 70
+    assert hpa.target_kind == "Deployment"
+    # target must exist, like kubectl
+    assert kt.run(["autoscale", "deploy", "ghost", "--max", "4"]) != 0
+
+
+def test_explain_against_remote_backend_sees_crds():
+    """explain over a RestClient backend must read the server-published
+    /openapi/v2, so Established CRDs are explainable remotely."""
+    from kubernetes_tpu.api.extensions import (
+        CRDNames,
+        CustomResourceDefinition,
+    )
+    from kubernetes_tpu.cli.rest_client import RestClient
+    from kubernetes_tpu.server.rest_http import RestServer
+    api = ApiServer()
+    api.store.create("Namespace", Namespace("default"))
+    # through the apiserver verb so the CRD is named + Established (the
+    # establishing controller work runs at admission; a bare store write
+    # would never surface in discovery)
+    api.create("CustomResourceDefinition", CustomResourceDefinition(
+        name="widgets.example.com", group="example.com", version="v1",
+        names=CRDNames(plural="widgets", kind="Widget",
+                       singular="widget")))
+    srv = RestServer(api)
+    srv.start()
+    try:
+        out = io.StringIO()
+        kt = Ktctl(RestClient(f"http://127.0.0.1:{srv.port}"), out=out)
+        assert kt.run(["explain", "widgets"]) == 0
+        assert "KIND:     Widget" in out.getvalue()
+    finally:
+        srv.stop()
+
+
+def test_autoscale_rejects_min_above_max():
+    from kubernetes_tpu.api.types import LabelSelector, Pod
+    from kubernetes_tpu.api.workloads import Deployment
+    api, factory, fleet, kt, out = mk_cluster()
+    api.store.create("Deployment", Deployment(
+        name="web", replicas=2,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=Pod(name="", labels={"app": "web"})))
+    assert kt.run(["autoscale", "deploy", "web", "--min", "9",
+                   "--max", "4"]) != 0
+    assert "must be at least 1" in out.getvalue()
