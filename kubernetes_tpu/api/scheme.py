@@ -360,7 +360,38 @@ def _encode_service_v1(svc) -> Dict[str, Any]:
     return wire.encode(svc, "Service")
 
 
+def _generic_codec(kind: str):
+    """v1 codec for a reflective wire kind: accepts both the flat native
+    encoding and the kubectl metadata/spec manifest shape (flattened the
+    way _decode_service_v1 does), encodes flat."""
+    # kinds wire.decode_any sniffs the metadata/spec shape for itself —
+    # flattening first would bypass their dedicated manifest decoders
+    # (e.g. decode_crd_manifest's shortNames + openAPIV3Schema handling)
+    _SNIFFED = ("Pod", "Node", "CustomResourceDefinition")
+
+    def decode(data: Dict[str, Any]):
+        from kubernetes_tpu.api import wire
+        if "metadata" in data and kind not in _SNIFFED:
+            meta = data.get("metadata") or {}
+            spec = data.get("spec") or {}
+            body = {**spec,
+                    "name": meta.get("name", ""),
+                    "namespace": meta.get("namespace", "default"),
+                    "labels": dict(meta.get("labels") or {}),
+                    "annotations": dict(meta.get("annotations") or {})}
+        else:
+            body = {k: v for k, v in data.items() if k != "apiVersion"}
+        return wire.decode_any(body, kind)
+
+    def encode(obj) -> Dict[str, Any]:
+        from kubernetes_tpu.api import wire
+        return wire.encode(obj, kind)
+
+    return decode, encode
+
+
 def default_scheme() -> Scheme:
+    from kubernetes_tpu.api.wire import KIND_REGISTRY
     s = Scheme()
     s.register(_SCHED_GV, _SCHED_KIND,
                _decode_scheduler_config, _encode_scheduler_config)
@@ -368,6 +399,13 @@ def default_scheme() -> Scheme:
     # the unversioned legacy Policy files (--use-legacy-policy-config)
     # decode through the same codec
     s.register("", "Policy", _decode_policy_v1, _encode_policy_v1)
+    # every reflective wire kind gets a generic v1 codec, so the scheme
+    # covers the full served surface (the reference registers every group
+    # in its Scheme); the hand-written core codecs below override the
+    # kinds with richer semantics
+    for kind in KIND_REGISTRY:
+        dec, enc = _generic_codec(kind)
+        s.register("v1", kind, dec, enc)
     # core group: two served versions over one internal hub
     s.register("v1", "Pod", _decode_pod_v1, _encode_pod_v1)
     s.register("v2", "Pod", _decode_pod_v2, _encode_pod_v2)

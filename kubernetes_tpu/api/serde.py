@@ -413,37 +413,48 @@ def decode_pod(obj: Dict[str, Any]) -> Pod:
     )
 
 
+def _decode_resource(rl: Dict[str, int]) -> Resource:
+    extended = {k: v for k, v in rl.items()
+                if k not in ("cpu", "memory", "pods",
+                             "nvidia.com/gpu", "alpha.kubernetes.io/nvidia-gpu",
+                             "storage.kubernetes.io/scratch",
+                             "storage.kubernetes.io/overlay")}
+    return Resource(
+        milli_cpu=rl.get("cpu", 0),
+        memory=rl.get("memory", 0),
+        nvidia_gpu=rl.get("nvidia.com/gpu",
+                          rl.get("alpha.kubernetes.io/nvidia-gpu", 0)),
+        storage_scratch=rl.get("storage.kubernetes.io/scratch", 0),
+        storage_overlay=rl.get("storage.kubernetes.io/overlay", 0),
+        extended=extended,
+    )
+
+
 def decode_node(obj: Dict[str, Any]) -> Node:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
     status = obj.get("status") or {}
     alloc_rl = decode_resource_list(status.get("allocatable")
                                     or status.get("capacity"))
-    extended = {k: v for k, v in alloc_rl.items()
-                if k not in ("cpu", "memory", "pods",
-                             "nvidia.com/gpu", "alpha.kubernetes.io/nvidia-gpu",
-                             "storage.kubernetes.io/scratch",
-                             "storage.kubernetes.io/overlay")}
-    alloc = Resource(
-        milli_cpu=alloc_rl.get("cpu", 0),
-        memory=alloc_rl.get("memory", 0),
-        nvidia_gpu=alloc_rl.get("nvidia.com/gpu",
-                                alloc_rl.get("alpha.kubernetes.io/nvidia-gpu", 0)),
-        storage_scratch=alloc_rl.get("storage.kubernetes.io/scratch", 0),
-        storage_overlay=alloc_rl.get("storage.kubernetes.io/overlay", 0),
-        extended=extended,
-    )
+    alloc = _decode_resource(alloc_rl)
     taints = []
     for t in spec.get("taints") or []:
         taints.append(Taint(t.get("key", ""), t.get("value", ""),
                             TaintEffect(t.get("effect", "NoSchedule"))))
     conditions = [NodeCondition(c.get("type", ""), c.get("status", "Unknown"))
                   for c in status.get("conditions") or []]
+    # a capacity distinct from allocatable (node-allocatable reservation)
+    capacity = None
+    if status.get("capacity") and status.get("allocatable") \
+            and status["capacity"] != status["allocatable"]:
+        capacity = _decode_resource(
+            decode_resource_list(status["capacity"]))
     return Node(
         name=meta.get("name", ""),
         labels=dict(meta.get("labels") or {}),
         annotations=dict(meta.get("annotations") or {}),
         allocatable=alloc,
+        capacity=capacity,
         allowed_pod_number=alloc_rl.get("pods", 110),
         taints=taints,
         unschedulable=bool(spec.get("unschedulable", False)),
@@ -542,14 +553,20 @@ def encode_pod(pod: Pod) -> Dict[str, Any]:
     return {"metadata": meta, "spec": spec}
 
 
+def _encode_resource_list(res, pods: int) -> Dict[str, str]:
+    out = {"cpu": f"{res.milli_cpu}m",
+           "memory": str(res.memory),
+           "pods": str(pods)}
+    if res.nvidia_gpu:
+        out["nvidia.com/gpu"] = str(res.nvidia_gpu)
+    for k, v in res.extended.items():
+        out[k] = str(v)
+    return out
+
+
 def encode_node(node: Node) -> Dict[str, Any]:
-    alloc = {"cpu": f"{node.allocatable.milli_cpu}m",
-             "memory": str(node.allocatable.memory),
-             "pods": str(node.allowed_pod_number)}
-    if node.allocatable.nvidia_gpu:
-        alloc["nvidia.com/gpu"] = str(node.allocatable.nvidia_gpu)
-    for k, v in node.allocatable.extended.items():
-        alloc[k] = str(v)
+    alloc = _encode_resource_list(node.allocatable,
+                                  node.allowed_pod_number)
     meta: Dict[str, Any] = {"name": node.name, "labels": node.labels}
     if node.annotations:
         meta["annotations"] = dict(node.annotations)
@@ -563,6 +580,9 @@ def encode_node(node: Node) -> Dict[str, Any]:
         },
         "status": {
             "allocatable": alloc,
+            **({"capacity": _encode_resource_list(
+                node.capacity, node.allowed_pod_number)}
+               if node.capacity is not None else {}),
             "conditions": [{"type": c.type,
                             "status": (c.status.value if hasattr(c.status, "value")
                                        else c.status)}

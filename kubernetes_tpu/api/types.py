@@ -515,6 +515,11 @@ class Node:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     allocatable: Resource = field(default_factory=Resource)
+    # status.capacity when it differs from allocatable: the kubelet's
+    # node-allocatable reservation (kube/system-reserved; pkg/kubelet/cm/
+    # node_container_manager.go) publishes capacity - reserved as
+    # allocatable. None = no reservation (capacity == allocatable).
+    capacity: Optional[Resource] = None
     allowed_pod_number: int = 110
     taints: List[Taint] = field(default_factory=list)
     unschedulable: bool = False

@@ -68,6 +68,7 @@ class ReplicationController:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
     selector: Dict[str, str] = field(default_factory=dict)
@@ -88,6 +89,7 @@ class Deployment:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
     selector: LabelSelector = field(default_factory=LabelSelector)
@@ -111,6 +113,7 @@ class Job:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     completions: int = 1
     parallelism: int = 1
@@ -135,6 +138,7 @@ class CronJob:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     schedule: str = "@every 60s"
     suspend: bool = False
@@ -160,6 +164,7 @@ class HorizontalPodAutoscaler:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     target_kind: str = "ReplicaSet"
     target_name: str = ""
@@ -184,6 +189,7 @@ class DaemonSet:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     selector: LabelSelector = field(default_factory=LabelSelector)
     template: Pod = field(default_factory=lambda: Pod(name=""))
     annotations: Dict[str, str] = field(default_factory=dict)
@@ -203,6 +209,7 @@ class StatefulSet:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     replicas: int = 1
     selector: LabelSelector = field(default_factory=LabelSelector)
@@ -244,6 +251,7 @@ class Service:
 
     name: str
     namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)

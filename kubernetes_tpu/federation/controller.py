@@ -238,7 +238,9 @@ class FederatedDeploymentController(FederatedReplicaSetController):
     CHILD_KIND = "Deployment"
 
 
-PROPAGATED_KINDS = ("ConfigMap", "Secret")
+# Namespace rides the same body (federatedtypes/namespace.go): a federated
+# namespace lands in every ready member; cluster-scoped (namespace "")
+PROPAGATED_KINDS = ("ConfigMap", "Secret", "Namespace")
 FEDERATED_DS_KIND = "FederatedDaemonSet"
 
 
@@ -256,7 +258,7 @@ def propagate_kind(plane: FederationControlPlane, conflicts: List[str],
     from kubernetes_tpu.api import wire
     ready = set(plane.ready_clusters())
     fed_objs, _ = plane.api.list(fed_kind)
-    fed_keys = {(o.namespace, o.name) for o in fed_objs}
+    fed_keys = {(getattr(o, "namespace", ""), o.name) for o in fed_objs}
     wants = []  # desired state computed ONCE, reused for every member
     for obj in fed_objs:
         want = _copy.deepcopy(obj)
@@ -273,7 +275,8 @@ def propagate_kind(plane: FederationControlPlane, conflicts: List[str],
             continue
         for obj, want, want_enc in wants:
             try:
-                cur = api.get(child_kind, obj.namespace, obj.name)
+                cur = api.get(child_kind, getattr(obj, "namespace", ""),
+                              obj.name)
             except NotFound:
                 try:
                     api.create(child_kind, _copy.deepcopy(want))
@@ -283,7 +286,8 @@ def propagate_kind(plane: FederationControlPlane, conflicts: List[str],
             if getattr(cur, "annotations", {}).get(MANAGED_ANNOTATION) \
                     != "true":
                 conflicts.append(
-                    f"{cname}/{child_kind}/{obj.namespace}/{obj.name}")
+                    f"{cname}/{child_kind}/"
+                    f"{getattr(obj, 'namespace', '')}/{obj.name}")
                 continue
             cur_enc = wire.encode(cur)
             cur_enc.pop("resource_version", None)
@@ -294,12 +298,14 @@ def propagate_kind(plane: FederationControlPlane, conflicts: List[str],
                 fresh.resource_version = cur.resource_version
                 api.update(child_kind, fresh)
         for existing in api.list(child_kind)[0]:
-            if (existing.namespace, existing.name) in fed_keys:
+            if (getattr(existing, "namespace", ""),
+                    existing.name) in fed_keys:
                 continue
             if getattr(existing, "annotations", {}).get(
                     MANAGED_ANNOTATION) == "true":
                 try:
-                    api.delete(child_kind, existing.namespace,
+                    api.delete(child_kind,
+                               getattr(existing, "namespace", ""),
                                existing.name)
                 except NotFound:
                     pass

@@ -55,6 +55,7 @@ CHILD_TO_FED: Dict[str, Tuple[str, bool]] = {
     "DaemonSet": (FEDERATED_DS_KIND, False),
     "ConfigMap": ("FederatedConfigMap", False),
     "Secret": ("FederatedSecret", False),
+    "Namespace": ("FederatedNamespace", False),
 }
 
 

@@ -56,6 +56,7 @@ from kubernetes_tpu.api.types import (
     Node,
     NodeCondition,
     Pod,
+    Resource,
 )
 from kubernetes_tpu.client.informer import SharedInformerFactory
 from kubernetes_tpu.server.apiserver_lite import ApiServerLite, Conflict, NotFound
@@ -334,7 +335,7 @@ class HollowKubelet:
                  startup_latency: float = 0.0,
                  now: Callable[[], float] = time.monotonic,
                  volume_manager=None, checkpointer=None,
-                 runtime=None):
+                 runtime=None, reserved=None):
         from kubernetes_tpu.nodes.cri import FakeRuntimeService
         from kubernetes_tpu.nodes.images import (
             ImageGCManager,
@@ -343,9 +344,26 @@ class HollowKubelet:
         from kubernetes_tpu.nodes.kuberuntime import RuntimeManager
         self.api = api
         self.node_name = node.name
-        self._template = node
         self._now = now
         self.startup_latency = startup_latency
+        # node-allocatable reservation (--kube-reserved/--system-reserved;
+        # pkg/kubelet/cm/node_container_manager.go GetNodeAllocatable
+        # Reservation): the node's given resources are its CAPACITY;
+        # what registers as allocatable — what the scheduler and the
+        # node-side admission see — is capacity minus the reservation
+        if reserved is not None:
+            import dataclasses as _dc
+            cap = node.allocatable
+            node = _dc.replace(node, capacity=cap, allocatable=Resource(
+                milli_cpu=max(0, cap.milli_cpu - reserved.milli_cpu),
+                memory=max(0, cap.memory - reserved.memory),
+                nvidia_gpu=max(0, cap.nvidia_gpu - reserved.nvidia_gpu),
+                storage_scratch=max(
+                    0, cap.storage_scratch - reserved.storage_scratch),
+                storage_overlay=max(
+                    0, cap.storage_overlay - reserved.storage_overlay),
+                extended=dict(cap.extended)))
+        self._template = node
         # THE runtime boundary (nodes/cri.py; ref pkg/kubelet/apis/cri/
         # services.go): any RuntimeService+ImageService plugs in here; the
         # default is the scripted fake (the kubemark hollow runtime)

@@ -324,3 +324,32 @@ def test_liveness_restart_rides_cri_attempts():
     fleet.step()  # fresh attempt running again
     assert api.get("Pod", "default", "p").restart_count == 1
     assert kubelet.runtime_mgr.pod_status(pod).restarts == 1
+
+
+def test_node_allocatable_reservation():
+    """--kube-reserved semantics (pkg/kubelet/cm/node_container_manager.go):
+    the node registers allocatable = capacity - reserved; the scheduler
+    and node-side admission see only the allocatable slice."""
+    from kubernetes_tpu.api.types import Resource
+    api = ApiServerLite()
+    node = make_node("n0", cpu=4000, memory=8 << 30)
+    kl = HollowKubelet(api, node,
+                       reserved=Resource(milli_cpu=500, memory=1 << 30))
+    kl.register()
+    reg = api.get("Node", "", "n0")
+    assert reg.allocatable.milli_cpu == 3500
+    assert reg.allocatable.memory == 7 << 30
+    assert reg.capacity.milli_cpu == 4000  # capacity still published
+    # node-side admission enforces the RESERVED boundary, not capacity
+    big = make_pod("big", cpu=3600, node_name="n0")
+    api.create("Pod", big)
+    kl.handle_pod(big)
+    kl.step()
+    p = api.get("Pod", "default", "big")
+    assert p.phase == "Failed"
+    assert p.annotations["kubernetes.io/failure-reason"] == "OutOfcpu"
+    ok = make_pod("fits", cpu=3400, node_name="n0")
+    api.create("Pod", ok)
+    kl.handle_pod(ok)
+    kl.step()
+    assert api.get("Pod", "default", "fits").phase == "Running"

@@ -173,3 +173,24 @@ def test_propagated_kinds_flow_through_the_loop():
             assert api.get("ConfigMap", "default", "settings") is None
         except NotFound:
             pass
+
+
+def test_federated_namespace_propagates():
+    from kubernetes_tpu.api.workloads import Namespace
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.pump()
+    plane.api.create("FederatedNamespace",
+                     Namespace(name="team-a", labels={"team": "a"}))
+    loop.pump(rounds=2)
+    for api in members.values():
+        ns = api.get("Namespace", "", "team-a")
+        assert ns.labels == {"team": "a"}
+        assert ns.annotations[MANAGED_ANNOTATION] == "true"
+    plane.api.delete("FederatedNamespace", "", "team-a")
+    loop.pump(rounds=2)
+    for api in members.values():
+        try:
+            assert api.get("Namespace", "", "team-a") is None
+        except NotFound:
+            pass
