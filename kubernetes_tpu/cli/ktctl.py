@@ -355,8 +355,10 @@ class Ktctl:
                 self.api = _BoundApi(restore._api, _dc.replace(
                     restore._cred, impersonate_user=as_user,
                     impersonate_groups=tuple(as_groups)))
-            fn(rest)
-            return 0
+            rc = fn(rest)
+            # verbs with exit-code semantics beyond ok/error (diff's
+            # "1 = differences found") return an int
+            return rc if isinstance(rc, int) else 0
         except SystemExit as e:
             self._print(str(e))
             return 1
@@ -693,43 +695,55 @@ class Ktctl:
             new_obj.resource_version = cur.resource_version
         return new_obj
 
+    def _merge_preview(self, kind: str, obj):
+        """THE 3-way merge apply performs, shared by apply and diff so the
+        preview can never drift from the write: returns (cur, cur_manifest,
+        merged, canon_txt, changed). cur is None for would-create. Like
+        kubectl, the modified object carries the new last-applied
+        annotation INTO the diff — metadata.annotations is then never
+        absent from `modified`, so dropping the user's annotations from a
+        manifest prunes them per-key instead of nuking the whole map
+        (controller-set keys survive)."""
+        from kubernetes_tpu.cli import strategicpatch
+        ns = getattr(obj, "namespace", "")
+        canon_new = self._canon_manifest(kind, obj)
+        canon_txt = json.dumps(canon_new, sort_keys=True)
+        try:
+            cur = self.api.get(
+                kind, ns if not self._cluster_scoped(kind) else "",
+                obj.name)
+        except Exception:
+            cur = None
+        if cur is None:
+            return None, None, None, canon_txt, True
+        prev_txt = getattr(cur, "annotations", {}).get(LAST_APPLIED, "")
+        prev = json.loads(prev_txt) if prev_txt else {}
+        cur_manifest = self._canon_manifest(kind, cur)
+        modified = self._with_last_applied(canon_new, canon_txt)
+        merged = strategicpatch.three_way_merge(prev, modified,
+                                                cur_manifest)
+        changed = not (merged == cur_manifest and prev_txt == canon_txt)
+        return cur, cur_manifest, merged, canon_txt, changed
+
     def cmd_apply(self, args):
         """kubectl apply: THREE-way strategic merge (apply.go:658) — the
         patch is computed from (last-applied, new manifest) and played
         onto the LIVE object, so manifest-removed fields/list items are
         pruned while controller-owned fields (an HPA's replicas, status,
         defaults) survive untouched."""
-        from kubernetes_tpu.cli import strategicpatch
         _, flags = self._flags(args)
         objs, raws = self._load_manifests(flags)
         for obj, raw in zip(objs, raws):
             kind = raw.get("kind")
-            ns = getattr(obj, "namespace", "")
-            canon_new = self._canon_manifest(kind, obj)
-            canon_txt = json.dumps(canon_new, sort_keys=True)
-            try:
-                cur = self.api.get(kind, ns if not self._cluster_scoped(kind) else "",
-                                   obj.name)
-            except Exception:
-                cur = None
+            cur, _cur_manifest, merged, canon_txt, changed = \
+                self._merge_preview(kind, obj)
             if cur is None:
                 if hasattr(obj, "annotations"):
                     obj.annotations[LAST_APPLIED] = canon_txt
                 self.api.create(kind, obj)
                 self._print(f"{self._plural(kind)}/{obj.name} created")
                 continue
-            prev_txt = getattr(cur, "annotations", {}).get(LAST_APPLIED, "")
-            prev = json.loads(prev_txt) if prev_txt else {}
-            cur_manifest = self._canon_manifest(kind, cur)
-            # like kubectl, the modified object carries the new
-            # last-applied annotation INTO the diff: metadata.annotations
-            # is then never absent from `modified`, so dropping the user's
-            # annotations from a manifest prunes them per-key instead of
-            # nuking the whole map (controller-set keys survive)
-            modified = self._with_last_applied(canon_new, canon_txt)
-            merged = strategicpatch.three_way_merge(prev, modified,
-                                                    cur_manifest)
-            if merged == cur_manifest and prev_txt == canon_txt:
+            if not changed:
                 self._print(f"{self._plural(kind)}/{obj.name} unchanged")
                 continue
             new_obj = self._decode_canon(kind, merged, cur)
@@ -737,6 +751,42 @@ class Ktctl:
                 new_obj.annotations[LAST_APPLIED] = canon_txt
             self.api.update(kind, new_obj)
             self._print(f"{self._plural(kind)}/{obj.name} configured")
+
+    def cmd_diff(self, args):
+        """kubectl diff -f FILE: show what apply WOULD change — the same
+        3-way merge apply performs, rendered as a unified diff of the
+        live object vs the merged result, without writing anything
+        (kubectl cmd/diff.go's server-dry-run shape, computed with the
+        strategic-merge machinery apply already uses). Exit code 1 when
+        differences exist, 0 when clean — kubectl's contract."""
+        import difflib
+
+        _, flags = self._flags(args)
+        objs, raws = self._load_manifests(flags)
+        any_changed = False
+        for obj, raw in zip(objs, raws):
+            kind = raw.get("kind")
+            cur, cur_manifest, merged, _canon_txt, changed = \
+                self._merge_preview(kind, obj)
+            if cur is None:
+                any_changed = True
+                self._print(f"+ {self._plural(kind)}/{obj.name} "
+                            f"(would be created)")
+                continue
+            if not changed:
+                continue
+            any_changed = True
+            before = json.dumps(cur_manifest, indent=2,
+                                sort_keys=True).splitlines()
+            after = json.dumps(merged, indent=2,
+                               sort_keys=True).splitlines()
+            for line in difflib.unified_diff(
+                    before, after,
+                    fromfile=f"live/{self._plural(kind)}/{obj.name}",
+                    tofile=f"merged/{self._plural(kind)}/{obj.name}",
+                    lineterm=""):
+                self._print(line)
+        return 1 if any_changed else 0
 
     def cmd_patch(self, args):
         """kubectl patch -p '<json>': server-state strategic merge patch

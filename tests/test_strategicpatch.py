@@ -267,3 +267,26 @@ def test_edit_verb(tmp_path, monkeypatch):
     monkeypatch.setenv("KTCTL_EDITOR", str(editor))
     assert kt.run(["edit", "deploy", "web"]) == 0
     assert api.get("Deployment", "default", "web").replicas == 9
+
+
+def test_diff_previews_apply_without_writing(tmp_path):
+    """kubectl diff semantics: exit 1 + unified diff when apply would
+    change something, exit 0 clean, and the live object is untouched."""
+    api, kt, out = mk_cli()
+    m = tmp_path / "d.yaml"
+    m.write_text(DEPLOY_V1)
+    # would-create
+    assert kt.run(["diff", "-f", str(m)]) == 1
+    assert "would be created" in out.getvalue()
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    # clean: nothing to change
+    out.truncate(0), out.seek(0)
+    assert kt.run(["diff", "-f", str(m)]) == 0
+    assert out.getvalue() == ""
+    # manifest drops the sidecar: diff previews the removal, no write
+    m.write_text(DEPLOY_V2)
+    out.truncate(0), out.seek(0)
+    assert kt.run(["diff", "-f", str(m)]) == 1
+    assert "sidecar" in out.getvalue()
+    dep = api.get("Deployment", "default", "web")
+    assert len(dep.template.containers) == 2  # live object untouched
