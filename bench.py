@@ -60,7 +60,7 @@ def run_once(n_nodes: int, n_pods: int, profile: str):
     return totals, elapsed, sched
 
 
-def measure_extender_latency(n_nodes: int, rounds: int = 40):
+def measure_extender_latency(n_nodes: int, rounds: int = 20):
     """Real HTTP /filter + /prioritize latency against the TPU backend at
     n_nodes (the 5s extender budget of core/extender.go:36, measured on
     hardware instead of asserted structurally — r4 VERDICT weak #5).
