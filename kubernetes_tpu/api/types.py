@@ -439,7 +439,15 @@ class Pod:
     deleted: bool = False  # DeletionTimestamp != nil (spreading skips these)
 
     def key(self) -> str:
-        return self.namespace + "/" + self.name
+        # memoized: the drain hot path calls key() ~7x per pod per round
+        # (queue, cache, metrics bookkeeping). Not a dataclass field, so
+        # dataclasses.replace() never copies a stale value; name/namespace
+        # are identity and never mutated in place.
+        k = self.__dict__.get("_key")
+        if k is None:
+            k = self.namespace + "/" + self.name
+            self.__dict__["_key"] = k
+        return k
 
     def resource_request(self) -> Resource:
         """Sum of container requests — GetResourceRequest

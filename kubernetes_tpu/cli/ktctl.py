@@ -14,10 +14,13 @@ deploy, sts, ds, ns, pv, pvc, quota, sa, cm, pdb). Output: table (default),
 or a RestServer URL (--server) — both expose the same verbs, like kubectl
 against the secure/insecure ports.
 
-`apply` implements create-or-update with a last-applied annotation diff (the
-simplified 2-way form of kubectl's 3-way strategic merge patch,
-pkg/kubectl/cmd/apply.go — full strategic merge lives in the server-side
-strategies here, so last-applied carries the client intent)."""
+`apply` is kubectl's full THREE-way strategic merge
+(pkg/kubectl/cmd/apply.go:658, patch.go CreateThreeWayMergePatch): the
+patch combines deletions from (last-applied, manifest) with
+additions/updates from (live, manifest), played onto the live object —
+manifest-dropped fields are pruned, live drift on manifest-specified
+fields is reverted, and controller-owned fields survive untouched
+(cli/strategicpatch.py; `diff` previews the same merge)."""
 
 from __future__ import annotations
 
@@ -33,6 +36,8 @@ from kubernetes_tpu.api.types import Node, Pod, Taint, TaintEffect
 from kubernetes_tpu.server.apiserver import ApiServer, KIND_INFO
 
 LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+_ABSENT = object()  # _project_to_raw sentinel (None is a real YAML value)
 
 ALIASES = {
     "po": "pods", "pod": "pods",
@@ -679,10 +684,27 @@ class Ktctl:
             out.setdefault("annotations", {})[LAST_APPLIED] = canon_txt
         return out
 
+    # Node annotation keys the CONTROL PLANE owns (controllers write them
+    # between a client's read and its update): survive apply/patch/edit
+    # even when the user's manifest omits them. Everything else in
+    # metadata.annotations is client-owned — the merged manifest is
+    # authoritative, so a user-requested annotation change sticks.
+    SERVER_OWNED_NODE_ANNOTATIONS = (
+        "node.alpha.kubernetes.io/ttl",           # quota_sa TTL controller
+        "volumes.kubernetes.io/attached",         # attach-detach controller
+        "volumes.kubernetes.io/in-use",           # kubelet status sync — the
+        # attach-detach controller's detach guard reads it (cloudctrl.py);
+        # losing it to an apply could detach a still-mounted volume
+    )
+
     def _decode_canon(self, kind: str, data: Dict[str, Any], cur):
         """Canonical manifest -> live object, restoring the status/server
         fields the spec-surface encoding doesn't carry (apply and patch
-        never touch status — the reference's status-subresource split)."""
+        never touch status — the reference's status-subresource split).
+        Annotations are NOT wholesale-restored: the merge already computed
+        them from (live, manifest), and clobbering that with the live map
+        silently discarded every user-requested annotation change; only
+        the server-owned keys above are re-added if the merge lost them."""
         new_obj = wire.decode_any(data, kind)
         if cur is not None:
             if kind == "Pod":
@@ -691,11 +713,67 @@ class Ktctl:
                 new_obj.restart_count = cur.restart_count
             elif kind == "Node":
                 new_obj.heartbeat = cur.heartbeat
-                new_obj.annotations = dict(cur.annotations)
+                for k in self.SERVER_OWNED_NODE_ANNOTATIONS:
+                    if k in cur.annotations and k not in new_obj.annotations:
+                        new_obj.annotations[k] = cur.annotations[k]
             new_obj.resource_version = cur.resource_version
         return new_obj
 
-    def _merge_preview(self, kind: str, obj):
+    @staticmethod
+    def _norm_key(k: str) -> str:
+        return k.replace("_", "").replace("-", "").lower()
+
+    def _project_to_raw(self, canon, raw):
+        """Keep only the canonical keys the user's manifest actually wrote
+        (tolerant of camelCase vs snake_case spelling, positional for
+        lists, which decode preserves). The canonical shape is a
+        decode->encode round trip, so it materializes DEFAULTS for every
+        absent field; the drift-reverting delta half of the 3-way merge
+        must not treat those as user intent — kubectl computes `modified`
+        from the file bytes for exactly this reason
+        (GetModifiedConfiguration).
+
+        Shape-tolerant: decode_any accepts BOTH the metadata/spec nesting
+        and the flat native shape, so the projection must not require the
+        raw manifest to nest the same way the canonical encoding does — a
+        flat-shape Pod manifest would otherwise project to an EMPTY delta
+        and apply would silently drop every field update. Canonical
+        metadata/spec levels match against the flat raw directly, and flat
+        canonical keys also search raw's metadata/spec levels."""
+        if isinstance(canon, dict) and isinstance(raw, dict):
+            # lookup spaces: raw itself first, then its metadata/spec
+            # levels (for flat-canon x nested-raw); first hit wins
+            raw_by = {self._norm_key(k): v for k, v in raw.items()}
+            for lvl in ("metadata", "spec"):
+                sub = raw.get(lvl)
+                if isinstance(sub, dict):
+                    for k, v in sub.items():
+                        raw_by.setdefault(self._norm_key(k), v)
+            out = {}
+            for k, v in canon.items():
+                if k in ("metadata", "spec") and isinstance(v, dict) \
+                        and not isinstance(raw.get(k), dict):
+                    # nested-canon x flat-raw: the user's keys live at the
+                    # raw top level — project the nesting against it
+                    out[k] = self._project_to_raw(v, raw)
+                    continue
+                rv = raw_by.get(self._norm_key(k), _ABSENT)
+                if rv is _ABSENT:
+                    continue
+                if isinstance(v, dict) and isinstance(rv, dict):
+                    out[k] = self._project_to_raw(v, rv)
+                elif isinstance(v, list) and isinstance(rv, list) \
+                        and len(v) == len(rv):
+                    out[k] = [self._project_to_raw(ci, ri)
+                              if isinstance(ci, dict) and isinstance(ri, dict)
+                              else ci
+                              for ci, ri in zip(v, rv)]
+                else:
+                    out[k] = v
+            return out
+        return canon
+
+    def _merge_preview(self, kind: str, obj, raw=None):
         """THE 3-way merge apply performs, shared by apply and diff so the
         preview can never drift from the write: returns (cur, cur_manifest,
         merged, canon_txt, changed). cur is None for would-create. Like
@@ -703,7 +781,9 @@ class Ktctl:
         annotation INTO the diff — metadata.annotations is then never
         absent from `modified`, so dropping the user's annotations from a
         manifest prunes them per-key instead of nuking the whole map
-        (controller-set keys survive)."""
+        (controller-set keys survive). `raw` (the manifest as the user
+        wrote it) narrows the drift-reverting delta half to
+        manifest-specified fields (_project_to_raw)."""
         from kubernetes_tpu.cli import strategicpatch
         ns = getattr(obj, "namespace", "")
         canon_new = self._canon_manifest(kind, obj)
@@ -720,8 +800,11 @@ class Ktctl:
         prev = json.loads(prev_txt) if prev_txt else {}
         cur_manifest = self._canon_manifest(kind, cur)
         modified = self._with_last_applied(canon_new, canon_txt)
+        delta_view = self._project_to_raw(canon_new, raw) \
+            if raw is not None else None
         merged = strategicpatch.three_way_merge(prev, modified,
-                                                cur_manifest)
+                                                cur_manifest,
+                                                modified_for_delta=delta_view)
         changed = not (merged == cur_manifest and prev_txt == canon_txt)
         return cur, cur_manifest, merged, canon_txt, changed
 
@@ -736,7 +819,7 @@ class Ktctl:
         for obj, raw in zip(objs, raws):
             kind = raw.get("kind")
             cur, _cur_manifest, merged, canon_txt, changed = \
-                self._merge_preview(kind, obj)
+                self._merge_preview(kind, obj, raw=raw)
             if cur is None:
                 if hasattr(obj, "annotations"):
                     obj.annotations[LAST_APPLIED] = canon_txt
@@ -766,8 +849,8 @@ class Ktctl:
         any_changed = False
         for obj, raw in zip(objs, raws):
             kind = raw.get("kind")
-            cur, cur_manifest, merged, _canon_txt, changed = \
-                self._merge_preview(kind, obj)
+            cur, cur_manifest, merged, canon_txt, changed = \
+                self._merge_preview(kind, obj, raw=raw)
             if cur is None:
                 any_changed = True
                 self._print(f"+ {self._plural(kind)}/{obj.name} "
@@ -776,9 +859,15 @@ class Ktctl:
             if not changed:
                 continue
             any_changed = True
+            # render what apply will actually WRITE: the merge result plus
+            # the refreshed last-applied stamp (apply sets it after decode,
+            # outside the merge). Without it, a run where only last-applied
+            # moves exits 1 with an EMPTY diff; kubectl renders the
+            # annotation change in this case
+            after_obj = self._with_last_applied(merged, canon_txt)
             before = json.dumps(cur_manifest, indent=2,
                                 sort_keys=True).splitlines()
-            after = json.dumps(merged, indent=2,
+            after = json.dumps(after_obj, indent=2,
                                sort_keys=True).splitlines()
             for line in difflib.unified_diff(
                     before, after,

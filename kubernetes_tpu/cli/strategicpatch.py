@@ -162,12 +162,118 @@ class _Unchanged:
 _UNCHANGED = _Unchanged()
 
 
-def three_way_merge(original: Any, modified: Any, current: Any) -> Any:
-    """Apply's merge (CreateThreeWayMergePatch + apply): compute the
-    original->modified diff (which encodes the user's intended deletions)
-    and play it onto the LIVE object — fields the manifest never managed
-    (controller writes, server defaults) pass through untouched."""
-    patch = create_two_way_diff(original or {}, modified or {})
-    if patch is _UNCHANGED:
+def _diff_deletions_only(original: Any, modified: Any,
+                         field: str = "") -> Any:
+    """The deletions half of CreateThreeWayMergePatch (patch.go:1958
+    diffMaps with IgnoreChangesAndAdditions): ONLY the keys/list items
+    present in `original` but absent from `modified` — null markers and
+    `$patch: delete` entries, recursing for nested deletions."""
+    if isinstance(original, dict) and isinstance(modified, dict):
+        patch: Dict[str, Any] = {}
+        for k, v in original.items():
+            if k not in modified:
+                patch[k] = None
+            else:
+                sub = _diff_deletions_only(v, modified[k], field=k)
+                if sub is not _UNCHANGED:
+                    patch[k] = sub
+        return patch if patch else _UNCHANGED
+    if isinstance(original, list) and isinstance(modified, list):
+        key = _merge_key_for(field, original, modified)
+        if key is None or not (
+                all(isinstance(i, dict) for i in original)
+                and all(isinstance(i, dict) for i in modified)):
+            return _UNCHANGED  # atomic lists replace via the delta diff
+        mod_by = _index_by(modified, key)
+        items: List[dict] = []
+        for k, item in _index_by(original, key).items():
+            if k not in mod_by:
+                items.append({key: k, PATCH_DIRECTIVE: DELETE})
+            else:
+                sub = _diff_deletions_only(item, mod_by[k], field=field)
+                if sub is not _UNCHANGED:
+                    sub = dict(sub)
+                    sub[key] = k
+                    items.append(sub)
+        return items if items else _UNCHANGED
+    return _UNCHANGED
+
+
+def _diff_ignore_deletions(current: Any, modified: Any,
+                           field: str = "") -> Any:
+    """The delta half of CreateThreeWayMergePatch (diffMaps with
+    IgnoreDeletions): additions and UPDATES that bring `current` to
+    `modified`, with no null markers — so live drift on manifest-specified
+    fields is reverted, while fields only the server/controllers own (absent
+    from `modified`) survive."""
+    if isinstance(current, dict) and isinstance(modified, dict):
+        patch: Dict[str, Any] = {}
+        for k, v in modified.items():
+            if k not in current:
+                patch[k] = copy.deepcopy(v)
+            elif current[k] != v:
+                sub = _diff_ignore_deletions(current[k], v, field=k)
+                if sub is not _UNCHANGED:
+                    patch[k] = sub
+        return patch if patch else _UNCHANGED
+    if isinstance(current, list) and isinstance(modified, list):
+        key = _merge_key_for(field, current, modified)
+        if key is None or not (
+                all(isinstance(i, dict) for i in current)
+                and all(isinstance(i, dict) for i in modified)):
+            return copy.deepcopy(modified) \
+                if current != modified else _UNCHANGED
+        cur_by = _index_by(current, key)
+        items: List[dict] = []
+        for item in modified:
+            k = item.get(key)
+            if k in cur_by:
+                sub = _diff_ignore_deletions(cur_by[k], item, field=field)
+                if sub is not _UNCHANGED:
+                    sub = dict(sub) if isinstance(sub, dict) else {}
+                    sub[key] = k
+                    items.append(sub)
+            else:
+                items.append(copy.deepcopy(item))
+        return items if items else _UNCHANGED
+    return copy.deepcopy(modified) if current != modified else _UNCHANGED
+
+
+def three_way_merge(original: Any, modified: Any, current: Any,
+                    modified_for_delta: Any = None) -> Any:
+    """Apply's merge (CreateThreeWayMergePatch, patch.go:1958, + apply):
+    the patch is the union of
+
+      1. deletions from diff(original, modified) — fields/list items the
+         user's manifest dropped since last-applied, and
+      2. additions/updates from diff(current, modified) IGNORING deletions
+         — so a field the manifest specifies is driven to the manifest's
+         value even when the LIVE object drifted (a controller or manual
+         edit changed it) while last-applied matches the manifest,
+
+    played onto the LIVE object — fields the manifest never managed
+    (controller writes, server defaults) pass through untouched.
+
+    modified_for_delta: optional narrower view of `modified` for the delta
+    half — callers whose canonical encoding materializes DEFAULTS for
+    fields the user never wrote (decode->encode normalization) pass the
+    projection onto the manifest's actual keys here, the analog of
+    kubectl computing `modified` from the FILE bytes
+    (GetModifiedConfiguration) rather than a round-tripped object; without
+    it the delta would 'revert' server-owned fields to defaults."""
+    deletions = _diff_deletions_only(original or {}, modified or {})
+    delta = _diff_ignore_deletions(
+        current or {},
+        (modified_for_delta if modified_for_delta is not None
+         else modified) or {})
+    if deletions is _UNCHANGED and delta is _UNCHANGED:
         return copy.deepcopy(current)
+    if deletions is _UNCHANGED:
+        patch = delta
+    elif delta is _UNCHANGED:
+        patch = deletions
+    else:
+        # per-key disjoint by construction (a key deleted from `modified`
+        # cannot also appear in the delta), so the merge is a plain overlay
+        patch = strategic_merge_patch(deletions, delta)
     return strategic_merge_patch(current, patch)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -57,10 +58,16 @@ class EvalCache:
 
     MAX_PENDING = 4096
 
-    def __init__(self, lru_size: int = 100):
+    def __init__(self, lru_size: int = 100, result_size: int = 2048):
         from collections import OrderedDict
         self.lru_size = lru_size
+        self.result_size = result_size
         self._lru = OrderedDict()
+        self._results = OrderedDict()
+        self._results_ver = None  # results are reachable only within one
+        # snapshot-version window (rkey embeds the version); a version move
+        # clears the memo wholesale instead of letting up to result_size
+        # dead ~25KB (fits, scores) pairs rot in FIFO order
         self._pairs_version = -1
         self._pairs = None
         self._pending_pairs: set = set()
@@ -70,11 +77,26 @@ class EvalCache:
         self._sync_seen = False
         self.oracle_routes = 0  # diagnostics for tests/metrics
         self.builds = 0
+        self.result_hits = 0
+        # affinity-relevance generation, maintained by the owner (the
+        # extender backend): bumped whenever the set of cached pods that
+        # carry pod (anti-)affinity may have changed. Affinity-free
+        # encodings key on (vocab_gen, aff_gen) instead of the full
+        # snapshot version, so a stream of plain binds (scheduleOne compat
+        # mode) reuses them instead of re-tensorizing per capacity delta.
+        self.aff_gen = 0
+        # True when NO pod in the owner's cache carries pod (anti-)affinity
+        # — lets plain-pod evaluations skip pair collection + AffinityData
+        # entirely (the symmetry check has nothing to check). Owners that
+        # cannot prove this leave it False; everything still works, slower.
+        self.cluster_aff_free = False
 
     def on_sync(self) -> None:
         """Cluster state resynced (the sidecar's /cache/... endpoints) —
         queued request pairs may intern at the next evaluation."""
         self._sync_seen = True
+        self.aff_gen += 1
+        self._results.clear()
 
     def flush_pending(self, snap: ClusterSnapshot) -> None:
         """Intern the queued request vocab entries in ONE rebuild per vocab,
@@ -191,15 +213,25 @@ class EvalCache:
 
     # ------------------------------------------------------------------ LRU
 
-    def get_encoded(self, pod: Pod, snap: ClusterSnapshot, build,
-                    workloads: Sequence = ()):
-        """(ClassBatch, AffinityData) via the LRU; `build()` constructs on
-        miss. Key = (snapshot version, workload set identity, exact spec
-        class key)."""
-        from kubernetes_tpu.state.classes import pod_class_key
-        wkey = tuple(sorted((w.kind, w.namespace, w.name, w.resource_version)
+    @staticmethod
+    def _wkey(workloads: Sequence) -> tuple:
+        return tuple(sorted((w.kind, w.namespace, w.name, w.resource_version)
                             for w in workloads))
-        key = (snap.version, wkey, pod_class_key(pod))
+
+    def get_encoded(self, pod: Pod, snap: ClusterSnapshot, build,
+                    workloads: Sequence = (), ckey=None, aff_free=False):
+        """Encoded-class entry via the LRU; `build()` constructs on miss.
+
+        Key: affinity-FREE classes (no pod affinity, no workloads, cluster
+        proven affinity-free) key on (vocab_gen, aff_gen) — their encoding
+        reads only vocabs and the node order, so capacity deltas (binds)
+        don't invalidate them. Affinity-BEARING classes key on the full
+        snapshot version, exactly as the reference re-derives predicate
+        metadata against the live cache per pod."""
+        from kubernetes_tpu.state.classes import pod_class_key
+        wkey = self._wkey(workloads)
+        struct = (snap.vocab_gen, self.aff_gen) if aff_free else snap.version
+        key = (struct, wkey, ckey if ckey is not None else pod_class_key(pod))
         hit = self._lru.get(key)
         if hit is not None:
             self._lru.move_to_end(key)
@@ -210,6 +242,34 @@ class EvalCache:
         if len(self._lru) > self.lru_size:
             self._lru.popitem(last=False)
         return val
+
+    # ------------------------------------------------------------- results
+
+    def _roll_results(self, version) -> None:
+        if version != self._results_ver:
+            self._results.clear()
+            self._results_ver = version
+
+    def get_result(self, key):
+        """(fits, scores) memo for one (snapshot version, priority config,
+        class) — the fused-verb cache: /prioritize after /filter for the
+        same pod (or any equivalent pod at the same cluster state) returns
+        without touching the device. Invalidation is structural: the
+        snapshot version moving clears the whole window (old-version
+        entries can never hit again — version is monotonic), on_sync
+        clears outright."""
+        self._roll_results(key[0])
+        hit = self._results.get(key)
+        if hit is not None:
+            self._results.move_to_end(key)
+            self.result_hits += 1
+        return hit
+
+    def put_result(self, key, value) -> None:
+        self._roll_results(key[0])
+        self._results[key] = value
+        if len(self._results) > self.result_size:
+            self._results.popitem(last=False)
 
 
 class PlacementResult:
@@ -248,81 +308,43 @@ def _oracle_eval(pod, infos, snap, priorities, workloads, hard_weight,
     return m, s
 
 
-def evaluate_pod(pod: Pod, infos, snap: ClusterSnapshot,
-                 priorities: Tuple[Tuple[str, int], ...],
-                 workloads: Sequence = (), hard_weight: int = 1,
-                 volume_ctx=None, policy_algos=None, eval_cache=None
-                 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Per-node (fits [N] bool, scores [N] int32) for ONE pod against the
-    cluster state — the extender's /filter + /prioritize evaluation
-    (core/extender.go:100 Filter, :157 Prioritize). No state is committed:
-    a single pod has no in-batch carry, so the affinity/spread kernels run
-    with zero occupancy (the static side only — exactly what the reference's
-    per-pod predicate/priority calls see through the scheduler cache).
+class _EncodedClass:
+    """One LRU entry of the extender fast lane: the host encodings plus
+    their DEVICE-resident uploads, so repeat evaluations of an equivalent
+    pod re-dispatch the compiled kernel over buffers already in HBM instead
+    of re-tensorizing + re-transferring per request."""
 
-    `snap` must already be refreshed against `infos`. Falls back to the
-    exact host oracle when the pod's features over-approximate on device
-    (needs_host_check / affinity slot overflow)."""
+    __slots__ = ("batch", "adata", "parr", "aff")
+
+    def __init__(self, batch, adata, parr, aff):
+        self.batch = batch
+        self.adata = adata
+        self.parr = parr    # device pod-side pytree (shape-bucketed)
+        self.aff = aff      # device affinity pytree, or None when inert
+
+
+def _fused_eval(parr, narr, aff, priorities, weights, aff_mode):
+    """The single-pod [1,N] evaluation as ONE traced program: predicate
+    chain + weighted priorities + (when live) the zero-occupancy affinity/
+    spread kernels. Fusing matters on a tunneled TPU backend: the previous
+    eager composition dispatched every jnp op as its own RPC (~60+ round
+    trips per warm /filter — the bulk of the 935 ms p50 BENCH_r05 measured);
+    one jit call is one dispatch."""
     from kubernetes_tpu.ops.affinity import (
-        AffinityData,
-        collect_pod_pairs,
-        intern_topology_pairs,
+        interpod_score,
+        spread_score,
         step_fits,
         step_prio_counts,
         step_spread_counts,
-        interpod_score,
-        spread_score,
     )
     from kubernetes_tpu.ops.pallas_kernels import precompute_static_fast
-    from kubernetes_tpu.ops.predicates import fits_jit, node_arrays, pod_arrays
+    from kubernetes_tpu.ops.predicates import fits
 
-    if eval_cache is not None:
-        # queued churn pairs intern in one batch at a sync boundary
-        eval_cache.flush_pending(snap)
-        # vocab isolation: a pod that would grow any snapshot vocab must
-        # not touch the snapshot at all (EvalCache docstring)
-        if eval_cache.vocab_missing(pod, snap, volume_ctx=volume_ctx):
-            return _oracle_eval(pod, infos, snap, priorities, workloads,
-                                hard_weight, volume_ctx, policy_algos)
-        all_pairs, aff_pairs = eval_cache.pairs_for(snap, infos)
-
-        def _build():
-            b = ClassBatch([pod], snap)
-            a = AffinityData(b.reps, snap, all_pairs, aff_pairs,
-                             list(workloads), hard_weight)
-            return b, a
-
-        batch, adata = eval_cache.get_encoded(pod, snap, _build,
-                                              workloads=workloads)
-    else:
-        all_pairs, aff_pairs = collect_pod_pairs(infos)
-        intern_topology_pairs(snap, [pod], aff_pairs)
-        batch = ClassBatch([pod], snap)
-        adata = AffinityData(batch.reps, snap, all_pairs, aff_pairs,
-                             list(workloads), hard_weight)
-    n_real = len(snap.node_names)
-    if batch.reps_batch.needs_host_check[0] or adata.overflow[0] \
-            or (policy_algos is not None and policy_algos.active):
-        # exact object-level path (same routing as SchedulingEngine.schedule;
-        # Policy-configured algorithms always evaluate exactly here — one
-        # pod per extender call keeps the oracle cheap)
-        return _oracle_eval(pod, infos, snap, priorities, workloads,
-                            hard_weight, volume_ctx, policy_algos)
-    narr = node_arrays(snap)
-    parr = pod_arrays(batch.reps_batch)
-    w_ip = sum(w for nm, w in priorities if nm == "InterPodAffinityPriority")
-    w_sp = sum(w for nm, w in priorities if nm == "SelectorSpreadPriority")
-    plain = tuple((nm, w) for nm, w in priorities
-                  if nm not in prio.AFFINITY_PRIORITIES)
-    # same gate as schedule(): skip the whole affinity machinery (device
-    # upload + einsum traces) when nothing in the cluster or pod needs it
-    fits_on = adata.fits_needed
-    prio_on = bool(w_ip) and adata.prio_needed
-    spread_on = bool(w_sp) and adata.spread_needed
-    m = fits_jit(parr, narr)[0]
-    s = prio.score(parr, narr, plain)[0]
+    fits_on, prio_on, spread_on = aff_mode
+    w_ip, w_sp = weights
+    m = fits(parr, narr)[0]
+    s = prio.score(parr, narr, priorities)[0]
     if fits_on or prio_on or spread_on:
-        aff = adata.device_arrays()
         labels = narr["labels"]
         pre = precompute_static_fast(aff, labels)
         c_dim = aff["m_aff"].shape[0]
@@ -337,9 +359,175 @@ def evaluate_pod(pod: Pod, infos, snap: ClusterSnapshot,
         if spread_on:
             cnt = step_spread_counts(aff, 0, committed0)
             s = s + w_sp * spread_score(aff, aff["sp_has"][0], cnt, m)
-    m = np.array(m)  # copy: device buffers are read-only views
-    m[n_real:] = False
-    return m, np.asarray(s)
+    return m, s
+
+
+_fused_eval_jit = jax.jit(_fused_eval,
+                          static_argnames=("priorities", "weights",
+                                           "aff_mode"))
+
+
+def evaluate_pod(pod: Pod, infos, snap: ClusterSnapshot,
+                 priorities: Tuple[Tuple[str, int], ...],
+                 workloads: Sequence = (), hard_weight: int = 1,
+                 volume_ctx=None, policy_algos=None, eval_cache=None,
+                 device_nodes_provider=None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-node (fits [N] bool, scores [N] int32) for ONE pod against the
+    cluster state — the extender's /filter + /prioritize evaluation
+    (core/extender.go:100 Filter, :157 Prioritize). No state is committed:
+    a single pod has no in-batch carry, so the affinity/spread kernels run
+    with zero occupancy (the static side only — exactly what the reference's
+    per-pod predicate/priority calls see through the scheduler cache).
+
+    `snap` must already be refreshed against `infos`. Falls back to the
+    exact host oracle when the pod's features over-approximate on device
+    (needs_host_check / affinity slot overflow).
+
+    Score caveat (pre-dating the fast lane, preserved): the oracle route
+    normalizes reduce-priorities over the FILTERED set and reports 0 for
+    non-fitting nodes, while the device route scores every node with
+    fits=None normalization — so the two routes can differ on the exact
+    integers (never on fit verdicts). A single pod always takes ONE route
+    per call, and /filter+/prioritize share it via the result memo, so a
+    scheduler never sees mixed-route scores for one pod.
+
+    The warm fast lane (eval_cache given) is layered:
+      1. result memo — same class at the same snapshot version returns the
+         cached (m, s) with zero device work (the fused filter+prioritize
+         contract: the second verb rides the first's evaluation);
+      2. encoded-class LRU — holds device-RESIDENT pod/affinity arrays;
+         affinity-free classes survive capacity deltas (vocab_gen keying);
+      3. one fused jit dispatch over the caller's device-resident node
+         arrays (device_nodes_provider — CALLED only after vocab flushes,
+         so a label-matrix rebuild can never race a stale upload;
+         node_arrays(snap) uploads fresh when absent).
+    """
+    from kubernetes_tpu.ops.affinity import (
+        AffinityData,
+        _has_affinity,
+        collect_pod_pairs,
+        intern_topology_pairs,
+    )
+    from kubernetes_tpu.ops.predicates import pod_arrays_bucketed
+    from kubernetes_tpu.state.classes import pod_class_key
+    from kubernetes_tpu.utils.trace import COUNTERS, timed_span
+
+    w_ip = sum(w for nm, w in priorities if nm == "InterPodAffinityPriority")
+    w_sp = sum(w for nm, w in priorities if nm == "SelectorSpreadPriority")
+
+    if eval_cache is not None:
+        # queued churn pairs intern in one batch at a sync boundary
+        eval_cache.flush_pending(snap)
+        # vocab isolation: a pod that would grow any snapshot vocab must
+        # not touch the snapshot at all (EvalCache docstring)
+        if eval_cache.vocab_missing(pod, snap, volume_ctx=volume_ctx):
+            with timed_span("extender.oracle_eval"):
+                return _oracle_eval(pod, infos, snap, priorities, workloads,
+                                    hard_weight, volume_ctx, policy_algos)
+        ckey = pod_class_key(pod)
+        # priorities + hard_weight are part of BOTH cache keys: the
+        # encoding's `need` gate and the scores depend on them, and nothing
+        # forces a shared EvalCache to serve one fixed configuration
+        cfg = (priorities, hard_weight)
+        rkey = (snap.version, eval_cache._wkey(workloads), cfg, ckey)
+        hit = eval_cache.get_result(rkey)
+        if hit is not None:
+            COUNTERS.inc("extender.result_hit")
+            return hit
+        # a pod with no pod (anti-)affinity in a cluster with no
+        # affinity-carrying pods and no workloads has an all-zero
+        # AffinityData by construction — skip pair collection and the
+        # affinity build entirely, and key the encoding on the vocab
+        # generation so binds don't invalidate it
+        aff_free = (eval_cache.cluster_aff_free and not workloads
+                    and not _has_affinity(pod))
+        if aff_free:
+            def _build():
+                with timed_span("extender.encode"):
+                    b = ClassBatch([pod], snap)
+                    return _EncodedClass(b, None,
+                                         pod_arrays_bucketed(b.reps_batch),
+                                         None)
+        else:
+            with timed_span("extender.pairs"):
+                all_pairs, aff_pairs = eval_cache.pairs_for(snap, infos)
+
+            def _build():
+                with timed_span("extender.encode"):
+                    COUNTERS.inc("extender.affinity_data_build")
+                    b = ClassBatch([pod], snap)
+                    a = AffinityData(b.reps, snap, all_pairs, aff_pairs,
+                                     list(workloads), hard_weight)
+                    need = (a.fits_needed
+                            or (bool(w_ip) and a.prio_needed)
+                            or (bool(w_sp) and a.spread_needed))
+                    return _EncodedClass(
+                        b, a, pod_arrays_bucketed(b.reps_batch),
+                        a.device_arrays() if need else None)
+
+        enc = eval_cache.get_encoded(pod, snap, _build, workloads=workloads,
+                                     ckey=(cfg, ckey), aff_free=aff_free)
+        out = _eval_dispatch(pod, infos, snap, priorities, workloads,
+                             hard_weight, volume_ctx, policy_algos, enc,
+                             device_nodes_provider, w_ip, w_sp)
+        eval_cache.put_result(rkey, out)
+        return out
+
+    # uncached path (no EvalCache owner): build fresh per call, then the
+    # SAME dispatch tail — args-mode and the warm lane cannot drift
+    all_pairs, aff_pairs = collect_pod_pairs(infos)
+    intern_topology_pairs(snap, [pod], aff_pairs)
+    batch = ClassBatch([pod], snap)
+    adata = AffinityData(batch.reps, snap, all_pairs, aff_pairs,
+                         list(workloads), hard_weight)
+    need = (adata.fits_needed or (bool(w_ip) and adata.prio_needed)
+            or (bool(w_sp) and adata.spread_needed))
+    enc = _EncodedClass(batch, adata, pod_arrays_bucketed(batch.reps_batch),
+                        adata.device_arrays() if need else None)
+    return _eval_dispatch(pod, infos, snap, priorities, workloads,
+                          hard_weight, volume_ctx, policy_algos, enc,
+                          device_nodes_provider, w_ip, w_sp)
+
+
+def _eval_dispatch(pod, infos, snap, priorities, workloads, hard_weight,
+                   volume_ctx, policy_algos, enc: "_EncodedClass",
+                   device_nodes_provider, w_ip: int, w_sp: int):
+    """Shared routing tail of evaluate_pod: exact-oracle gate
+    (needs_host_check / slot overflow / Policy algorithms), then ONE fused
+    kernel dispatch over the caller's device-resident node arrays. Both the
+    warm fast lane and the uncached args-mode path end here, so the
+    dispatch contract cannot drift between them."""
+    from kubernetes_tpu.ops.predicates import node_arrays
+    from kubernetes_tpu.utils.trace import COUNTERS, timed_span
+
+    batch, adata = enc.batch, enc.adata
+    if batch.reps_batch.needs_host_check[0] \
+            or (adata is not None and adata.overflow[0]) \
+            or (policy_algos is not None and policy_algos.active):
+        # exact object-level path (same routing as SchedulingEngine.schedule;
+        # Policy-configured algorithms always evaluate exactly here — one
+        # pod per extender call keeps the oracle cheap)
+        with timed_span("extender.oracle_eval"):
+            return _oracle_eval(pod, infos, snap, priorities, workloads,
+                                hard_weight, volume_ctx, policy_algos)
+    plain = tuple((nm, w) for nm, w in priorities
+                  if nm not in prio.AFFINITY_PRIORITIES)
+    fits_on = adata is not None and adata.fits_needed
+    prio_on = adata is not None and bool(w_ip) and adata.prio_needed
+    spread_on = adata is not None and bool(w_sp) and adata.spread_needed
+    narr = device_nodes_provider() if device_nodes_provider is not None \
+        else node_arrays(snap)
+    with timed_span("extender.kernel"):
+        COUNTERS.inc("extender.fused_eval")
+        m, s = _fused_eval_jit(
+            enc.parr, narr,
+            enc.aff if (fits_on or prio_on or spread_on) else None,
+            plain, (w_ip, w_sp), (fits_on, prio_on, spread_on))
+        m = np.array(m)  # blocks; device buffers are read-only views
+        s = np.asarray(s)
+    m[len(snap.node_names):] = False
+    return m, s
 
 
 class SchedulingEngine:
@@ -648,6 +836,7 @@ class SchedulingEngine:
         snap = self.snapshot
         if self._device_nodes is None:
             self._device_nodes = {}
+        uploaded = 0
         for k in self._NODE_ARRAY_KEYS:
             if k == "port_bitmap":
                 host = snap.port_bitmap[:, :port_words]
@@ -657,6 +846,10 @@ class SchedulingEngine:
             if cur is None or cur.shape != host.shape or k in snap.dirty:
                 self._device_nodes[k] = jnp.asarray(
                     np.ascontiguousarray(host) if k == "port_bitmap" else host)
+                uploaded += 1
+        if uploaded:
+            from kubernetes_tpu.utils.trace import COUNTERS
+            COUNTERS.inc("engine.device_upload_arrays", uploaded)
         snap.dirty.clear()
         self._device_version = snap.version
         return self._device_nodes

@@ -719,6 +719,16 @@ class HollowKubelet:
             if not (status.all_running or status.completed_phase):
                 continue
             del self._starting[key]
+            if status.completed_phase:
+                # already terminal at relist time — e.g. a liveness-killed
+                # (137) pod whose restartPolicy Never forbids the fresh
+                # attempt: report Failed/Succeeded and release it, never
+                # "Running" (it would sit unready forever — the scripted
+                # completion sweep below only polls annotated workloads)
+                self._admitted.pop(key, None)
+                if self._write_status(pod, phase=status.completed_phase):
+                    wrote += 1
+                continue
             # a pod with a readiness probe starts NOT-ready; the probe
             # flips it (results_manager initial state)
             ready0 = not self.prober.has_readiness(key)

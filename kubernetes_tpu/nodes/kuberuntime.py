@@ -133,6 +133,15 @@ class RuntimeManager:
             if all(c.exit_code != 137 for c in latest.values()):
                 st.completed_phase = "Failed" if any(
                     c.exit_code != 0 for c in latest.values()) else "Succeeded"
+            elif pod.restart_policy == "Never":
+                # kubelet-killed (137) with restartPolicy Never: no fresh
+                # attempt will ever start (compute_pod_actions refuses), so
+                # without a terminal phase the pod would sit in the
+                # kubelet's _starting set unready forever. The reference
+                # resolves this in GetPhase (kuberuntime_manager.go /
+                # kubelet_pods.go:1311): stopped containers that cannot
+                # restart make the pod Failed.
+                st.completed_phase = "Failed"
         return st
 
     # ------------------------------------------------------------- decide

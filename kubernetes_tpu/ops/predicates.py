@@ -106,6 +106,51 @@ def pod_arrays(batch) -> Arrays:
     return {k: jnp.asarray(v) for k, v in _pod_arrays_np(batch).items()}
 
 
+# selector/preference slot axes sized by actual usage (PodBatch): key ->
+# (axis -> dim kind). Zero padding is inert on every one of them — padded
+# terms carry sel_term_valid/pref_valid False (the OR skips them) and padded
+# any-groups carry *_any_used False (the conjunct auto-passes).
+_SLOT_AXES = {
+    "sel_req_all": {1: "T"}, "sel_req_any": {1: "T", 2: "A"},
+    "sel_forbid": {1: "T"}, "sel_term_valid": {1: "T"},
+    "sel_any_used": {1: "T", 2: "A"}, "sel_unsat": {1: "T"},
+    "pref_req_all": {1: "TP"}, "pref_req_any": {1: "TP", 2: "A"},
+    "pref_forbid": {1: "TP"}, "pref_any_used": {1: "TP", 2: "A"},
+    "pref_valid": {1: "TP"}, "pref_unsat": {1: "TP"},
+    "pref_empty": {1: "TP"}, "pref_weight": {1: "TP"},
+    "pvaff_req_any": {1: "A"}, "pvaff_any_used": {1: "A"},
+}
+
+
+def pod_arrays_bucketed(batch) -> Arrays:
+    """pod_arrays with the selector-term / any-group / preferred-term axes
+    padded up to power-of-2 buckets. PodBatch sizes those axes to the batch's
+    actual usage, so [1,N] single-pod evaluations (the extender fast lane)
+    would otherwise compile one kernel variant per distinct term count;
+    bucketing bounds the variants at log2(slot caps) like every other batch
+    axis (bucket())."""
+    import numpy as _np
+    arrs = _pod_arrays_np(batch)
+    dims = {"T": bucket(arrs["sel_req_all"].shape[1], lo=1),
+            "A": bucket(arrs["sel_req_any"].shape[2], lo=1),
+            "TP": bucket(arrs["pref_req_all"].shape[1], lo=1)}
+    out = {}
+    for k, a in arrs.items():
+        axes = _SLOT_AXES.get(k)
+        if axes:
+            widths = [(0, 0)] * a.ndim
+            grow = False
+            for ax, kind in axes.items():
+                pad = dims[kind] - a.shape[ax]
+                if pad > 0:
+                    widths[ax] = (0, pad)
+                    grow = True
+            if grow:
+                a = _np.pad(a, widths)
+        out[k] = jnp.asarray(a)
+    return out
+
+
 def _pod_arrays_np(batch):
     """The pod-side arrays as host numpy, keyed like pod_arrays."""
     return {

@@ -73,7 +73,10 @@ class ExtenderHTTPServer:
                 return json.loads(self._read_raw() or b"{}")
 
             def _write_json(self, obj, code: int = 200):
-                body = json.dumps(obj).encode()
+                # compact separators: a 5k-node HostPriorityList is ~230KB
+                # of response; the default ", " padding costs measurable
+                # serialize+wire time at compat-mode request rates
+                body = json.dumps(obj, separators=(",", ":")).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -221,7 +224,27 @@ class TPUExtenderBackend:
     restricted to the candidate set the scheduler sent — exactly the
     contract of extender.go:100-198. Bind assumes into the local cache and
     delegates the apiserver write to `binder` (None = extender not configured
-    with BindVerb)."""
+    with BindVerb).
+
+    Warm fast lane (the cache-capable path): cluster state lives DEVICE-
+    resident between requests. The backend owns its SchedulerCache
+    exclusively — every mutation arrives through sync_nodes / sync_pods /
+    bind — so it tracks staleness itself instead of re-deriving it per
+    request:
+
+      - sync_* marks a FULL refresh (membership/spec may have moved) and
+        invalidates the EvalCache (on_sync);
+      - bind marks a TARGETED refresh of just the bound node
+        (snapshot.refresh changed_hint — one dynamic row, not an N-node
+        generation walk);
+      - a request with nothing dirty touches no cluster state at all: the
+        snapshot, the uploaded node arrays, the encoded classes and the
+        (fits, scores) result memo are all valid, so /prioritize after
+        /filter is a dict hit.
+
+    Node arrays ride SchedulingEngine._nodes_on_device (incremental
+    dirty-only host->HBM sync), so a bind re-uploads three small dynamic
+    arrays, not the 40MB+ snapshot."""
 
     def __init__(self, binder=None):
         # jax-dependent imports are local so the wire layer stays importable
@@ -242,39 +265,123 @@ class TPUExtenderBackend:
         # docstring; the reference amortizes the same work through its
         # scheduler cache + equivalence LRU)
         self.eval_cache = EvalCache()
+        # staleness ledger for the warm lane (class docstring); guarded by
+        # _lock — ThreadingHTTPServer serves each request on its own thread
+        self._lock = threading.RLock()
+        self._state_dirty = True          # full refresh needed
+        self._bind_hint: set = set()      # targeted refresh of these nodes
+        self._infos = None                # cached node_infos() view
+        self._aff_pod_count = 0           # cached pods carrying pod affinity
+        # pods assumed by bind BEFORE any sync shipped their spec: /bind
+        # carries only identifiers, so their accounting is spec-less until
+        # the bulk cache sync delivers the real object (and replaces it)
+        self._assumed_bare: Dict[str, Pod] = {}
+        self._last_cleanup = 0.0
+        self.eval_cache.cluster_aff_free = True
 
     # -- cache sync ---------------------------------------------------------
 
+    # assumed-pod TTL sweep cadence: the sidecar has no informer confirm
+    # loop — the bulk cache sync IS the confirmation — so a bind whose pod
+    # never reappears in a sync (deleted at the apiserver, write lost)
+    # must expire via the cache's own TTL or its phantom pod_count/capacity
+    # leaks for the process lifetime
+    CLEANUP_INTERVAL_S = 5.0
+
+    def _maybe_cleanup_assumed(self) -> None:
+        """Time-gated cleanup_assumed (cache.go:355 analog) — called with
+        the lock held from the sync/refresh paths."""
+        import time as _time
+        now = _time.monotonic()
+        if now - self._last_cleanup < self.CLEANUP_INTERVAL_S:
+            return
+        self._last_cleanup = now
+        expired = self.cache.cleanup_assumed()
+        if expired:
+            for k in expired:
+                self._assumed_bare.pop(k, None)
+            self._state_dirty = True  # released capacity: full re-walk
+
     def sync_nodes(self, nodes: List[Node]) -> None:
-        self.eval_cache.on_sync()
-        seen = set()
-        for n in nodes:
-            self.cache.update_node(n)
-            seen.add(n.name)
-        for name in list(self.cache.node_infos().keys()):
-            if name not in seen:
-                self.cache.remove_node(name)
+        with self._lock:
+            self.eval_cache.on_sync()
+            self._state_dirty = True
+            self._bind_hint.clear()
+            self._maybe_cleanup_assumed()
+            seen = set()
+            for n in nodes:
+                self.cache.update_node(n)
+                seen.add(n.name)
+            for name in list(self.cache.node_infos().keys()):
+                if name not in seen:
+                    self.cache.remove_node(name)
 
     def sync_pods(self, pods: List[Pod]) -> None:
-        self.eval_cache.on_sync()
-        seen = set()
-        for p in pods:
-            if not p.node_name:
-                continue
-            seen.add(p.key())
-            prev = self._known_pods.get(p.key())
-            if prev is None:
-                self.cache.add_pod(p)
-            else:
-                self.cache.update_pod(prev, p)
-            self._known_pods[p.key()] = p
-        # full-state semantics, like sync_nodes: pods absent from the
-        # snapshot were deleted — release their capacity
-        for key in list(self._known_pods):
-            if key not in seen:
-                self.cache.remove_pod(self._known_pods.pop(key))
+        from kubernetes_tpu.ops.affinity import _has_affinity
+        with self._lock:
+            self.eval_cache.on_sync()
+            self._state_dirty = True
+            self._bind_hint.clear()
+            self._maybe_cleanup_assumed()
+            seen = set()
+            for p in pods:
+                if not p.node_name:
+                    continue
+                seen.add(p.key())
+                prev = self._known_pods.get(p.key())
+                if prev is None:
+                    bare = self._assumed_bare.pop(p.key(), None)
+                    if bare is not None:
+                        # bind assumed this pod WITHOUT its spec (wire
+                        # carries identifiers only): swap the spec-less
+                        # accounting for the real object — the confirm
+                        # path alone would keep the zero-resource rows
+                        self.cache.remove_pod(bare)
+                    self.cache.add_pod(p)
+                else:
+                    self.cache.update_pod(prev, p)
+                self._known_pods[p.key()] = p
+            # full-state semantics, like sync_nodes: pods absent from the
+            # snapshot were deleted — release their capacity
+            for key in list(self._known_pods):
+                if key not in seen:
+                    self.cache.remove_pod(self._known_pods.pop(key))
+            self._aff_pod_count = sum(
+                1 for p in self._known_pods.values() if _has_affinity(p))
+            self.eval_cache.cluster_aff_free = self._aff_pod_count == 0
 
     # -- extender verbs -----------------------------------------------------
+
+    def _refresh_warm(self):
+        """Bring the persistent snapshot up to date with the cache, paying
+        only for what actually moved (class docstring). Returns the live
+        infos view."""
+        from kubernetes_tpu.utils.trace import timed_span
+        snap = self.engine.snapshot
+        self._maybe_cleanup_assumed()  # time-gated; a bind-only deployment
+        # (no syncs ever) must still expire unconfirmed assumptions
+        if self._state_dirty or self._infos is None:
+            with timed_span("extender.refresh_full"):
+                self._infos = self.cache.node_infos()
+                snap.refresh(self._infos)
+            self._state_dirty = False
+            self._bind_hint.clear()
+        elif self._bind_hint:
+            with timed_span("extender.refresh_hint"):
+                hint = tuple(self._bind_hint)
+                self._bind_hint.clear()
+                snap.refresh(self._infos, changed_hint=hint)
+        return self._infos
+
+    def _port_words_for(self, pod: Pod) -> int:
+        from kubernetes_tpu.ops.predicates import bucket
+        snap = self.engine.snapshot
+        words = snap.port_words_used()
+        for c in pod.containers:
+            for p in c.ports:
+                if p.host_port > 0:
+                    words = max(words, p.host_port // 32 + 1)
+        return bucket(max(words, 1), lo=1)
 
     def _eval(self, pod: Pod, nodes: Optional[List[Node]]):
         from kubernetes_tpu.engine.scheduler_engine import evaluate_pod
@@ -289,55 +396,119 @@ class TPUExtenderBackend:
             infos = node_info_map(nodes, [p for p in self._known_pods.values()])
             snap = ClusterSnapshot()
             snap.refresh(infos)
-        else:
-            snap = self.engine.snapshot
-            infos = self.cache.node_infos()
-            snap.refresh(infos)
+            m, s = evaluate_pod(
+                pod, infos, snap, self.engine.priorities,
+                workloads=self.engine.workloads_provider(),
+                hard_weight=self.engine.hard_pod_affinity_weight,
+                volume_ctx=self.engine.volume_ctx, eval_cache=None)
+            return snap, m, s
+        snap = self.engine.snapshot
+        infos = self._refresh_warm()
+        # deferred: evaluate_pod invokes this only after vocab flushes, so
+        # a label-matrix rebuild can never race a stale device upload
+        provider = (lambda: self.engine._nodes_on_device(
+            port_words=self._port_words_for(pod)))
         m, s = evaluate_pod(
             pod, infos, snap, self.engine.priorities,
             workloads=self.engine.workloads_provider(),
             hard_weight=self.engine.hard_pod_affinity_weight,
             volume_ctx=self.engine.volume_ctx,
-            eval_cache=self.eval_cache if nodes is None else None)
+            eval_cache=self.eval_cache, device_nodes_provider=provider)
         return snap, m, s
 
+    FAIL_REASON = "node(s) didn't satisfy TPU predicate kernel"
+
     def filter(self, pod, nodes, node_names):
-        snap, m, _ = self._eval(pod, nodes)
+        # response building runs OUTSIDE the lock: names/index/m are
+        # captured references (a refresh REPLACES the list/dict objects,
+        # never mutates them in place), so concurrent compat drivers only
+        # serialize on the evaluation itself
+        with self._lock:
+            snap, m, _ = self._eval(pod, nodes)
+            names = snap.node_names
+            idx = snap.node_index
+        if node_names is None and nodes is None:
+            # whole-cluster candidate set: vectorized split instead of
+            # a per-name dict-lookup loop over N nodes
+            import numpy as np
+            mask = m[:len(names)]
+            if mask.all():
+                return list(names), {}
+            passed = [names[i] for i in np.nonzero(mask)[0]]
+            failed = {names[i]: self.FAIL_REASON
+                      for i in np.nonzero(~mask)[0]}
+            return passed, failed
         candidates = node_names if node_names is not None else \
-            [n.name for n in nodes] if nodes is not None else snap.node_names
+            [n.name for n in nodes]
         passed, failed = [], {}
         for nm in candidates:
-            i = snap.node_index.get(nm, -1)
+            i = idx.get(nm, -1)
             if i >= 0 and m[i]:
                 passed.append(nm)
             else:
-                failed[nm] = "node(s) didn't satisfy TPU predicate kernel"
+                failed[nm] = self.FAIL_REASON
         return passed, failed
 
     def prioritize(self, pod, nodes, node_names):
-        snap, _, s = self._eval(pod, nodes)
+        with self._lock:
+            snap, _, s = self._eval(pod, nodes)
+            names = snap.node_names
+            idx = snap.node_index
+        sl = s.tolist()  # one bulk convert beats N np-scalar __int__s
+        if node_names is None and nodes is None:
+            return list(zip(names, sl[:len(names)]))
         candidates = node_names if node_names is not None else \
-            [n.name for n in nodes] if nodes is not None else snap.node_names
-        return [(nm, int(s[snap.node_index[nm]]))
-                for nm in candidates if nm in snap.node_index]
+            [n.name for n in nodes]
+        return [(nm, sl[idx[nm]]) for nm in candidates if nm in idx]
 
     def bind(self, pod_name, pod_namespace, pod_uid, node):
+        # NOTE on affinity: the /bind wire carries identifiers only
+        # (ExtenderBindingArgs), so a freshly bound pod's SPEC — including
+        # any pod (anti-)affinity — is unknown here and stays unknown
+        # until the bulk cache sync ships the real object. cluster_aff_free
+        # therefore changes only at sync boundaries (sync_pods recount):
+        # between bind and sync, NO evaluation path (fast lane or oracle)
+        # can see the unknown affinity, so the fast lane is exactly as
+        # informed as the slow one.
         import dataclasses
         key = f"{pod_namespace}/{pod_name}"
-        pod = self._known_pods.get(key)
-        if pod is None:
-            pod = Pod(name=pod_name, namespace=pod_namespace, uid=pod_uid)
-        pod = dataclasses.replace(pod, node_name=node)
-        try:
-            self.cache.assume_pod(pod)
-            self.cache.finish_binding(pod)
-        except KeyError:
-            pass  # already known
+        assumed_now = False
+        with self._lock:
+            pod = self._known_pods.get(key)
+            if pod is None:
+                pod = Pod(name=pod_name, namespace=pod_namespace, uid=pod_uid)
+            pod = dataclasses.replace(pod, node_name=node)
+            try:
+                self.cache.assume_pod(pod)
+                self.cache.finish_binding(pod)
+                assumed_now = True
+                if key not in self._known_pods:
+                    self._assumed_bare[key] = pod
+                # the warm lane's staleness ledger: exactly one node's
+                # dynamic row moved
+                self._bind_hint.add(node)
+            except KeyError:
+                pass  # already known (e.g. a client retry of a bind that
+                # succeeded) — do NOT treat the existing assumption as ours
+        # the apiserver write runs OUTSIDE the lock: a slow apiserver must
+        # not stall every concurrent /filter//prioritize for the duration
+        # of an external HTTP call. Concurrent evaluations meanwhile see
+        # the optimistic assume — exactly the reference's semantics
+        # (scheduler.go:224-250: assume first, bind async, forget on
+        # failure), compensated below.
         if self.binder is not None:
             try:
                 self.binder(pod_name, pod_namespace, pod_uid, node)
             except Exception as e:
-                self.cache.forget_pod(pod)
+                if assumed_now:
+                    # undo ONLY what this call assumed: a duplicate /bind
+                    # whose write fails must not forget a legitimately
+                    # bound pod (that would leak its capacity until the
+                    # next sync)
+                    with self._lock:
+                        self.cache.forget_pod(pod)
+                        self._assumed_bare.pop(key, None)
+                        self._bind_hint.add(node)
                 return str(e)
         return ""
 

@@ -87,7 +87,23 @@ def _canon_container(c) -> tuple:
 def pod_class_key(pod: Pod) -> tuple:
     """Canonical spec tuple covering every field read by tensorization
     (snapshot.PodBatch), the kernels, and host-path routing. Name/uid/rv are
-    deliberately excluded — identity never affects placement."""
+    deliberately excluded — identity never affects placement.
+
+    Memoized per pod object: building the nested tuple costs ~6us and the
+    drain keys 30k pods per round. The only spec field the scheduler
+    mutates IN PLACE after keying is node_name (engine assume), so the
+    cache is guarded on its identity; every other mutation path in the
+    control plane goes through dataclasses.replace / fresh decode, which
+    never carries the memo over."""
+    cached = pod.__dict__.get("_class_key")
+    if cached is not None and cached[0] is pod.node_name:
+        return cached[1]
+    key = _pod_class_key(pod)
+    pod.__dict__["_class_key"] = (pod.node_name, key)
+    return key
+
+
+def _pod_class_key(pod: Pod) -> tuple:
     return (
         pod.namespace,
         tuple(sorted(pod.labels.items())),

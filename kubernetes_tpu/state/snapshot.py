@@ -152,6 +152,13 @@ class ClusterSnapshot:
         self._generations: Dict[str, Tuple[int, int, int]] = {}
         self._shape_sig: Optional[Tuple[int, ...]] = None
         self.version = 0  # bumped on any array change (device cache key)
+        # encoding generation: bumped only when something that affects POD
+        # ENCODING changes — vocab widths/content (finalize_*) or node
+        # membership/order (_allocate; PodFitsHost rows store node indices).
+        # Capacity deltas (a bind) bump `version` but NOT `vocab_gen`, so
+        # the extender's per-class encodings (PodBatch arrays) stay valid
+        # across a scheduleOne stream of binds.
+        self.vocab_gen = 0
         self.dirty: set = set()
         self._label_index: Dict[str, set] = {}  # key -> values across nodes
         self._row_labels: List[Dict[str, str]] = []  # per-row node label maps
@@ -265,6 +272,7 @@ class ClusterSnapshot:
             self._image_vocab_dirty = False
             self.dirty.add("image_sizes")
             self.version += 1
+            self.vocab_gen += 1
         return self._images_width
 
     def ensure_conflict_key(self, key: str) -> int:
@@ -305,6 +313,7 @@ class ClusterSnapshot:
             self.dirty.update(("vol_present", "vol_rw", "pd_present",
                                "pd_kind"))
             self.version += 1
+            self.vocab_gen += 1
         return self._conflict_width, self._pd_width
 
     def finalize_labels(self) -> int:
@@ -322,6 +331,7 @@ class ClusterSnapshot:
             self._vocab_dirty = False
             self.dirty.add("labels")
             self.version += 1
+            self.vocab_gen += 1
             if self._shape_sig is not None:
                 # keep the shape signature in sync so the next refresh()
                 # doesn't mistake the widened label axis for a rebuild
@@ -331,15 +341,32 @@ class ClusterSnapshot:
         return self._labels_width
 
     def refresh(self, infos: Dict[str, NodeInfo],
-                volume_ctx: Optional[volmod.VolumeContext] = None) -> bool:
+                volume_ctx: Optional[volmod.VolumeContext] = None,
+                changed_hint: Optional[Sequence[str]] = None) -> bool:
         """Sync arrays with the cache. Returns True on full rebuild (shape or
         membership change), False for in-place delta. A PV/PVC change
         (volume_ctx.version moved) re-resolves every node's PD rows — the
-        ecache-style invalidation of factory.go:261-601 for PV/PVC events."""
+        ecache-style invalidation of factory.go:261-601 for PV/PVC events.
+
+        changed_hint: the caller ASSERTS node membership is unchanged and
+        only the named nodes may have moved (the extender's per-bind path,
+        where walking all N generation counters per request would dominate
+        a warm [1,N] evaluation). Verification is PARTIAL: spec/ports/
+        identity changes and unseen extended resources on the HINTED nodes,
+        plus a size change of the node set, fall back to the full scan —
+        but changes to non-hinted nodes (including an equal-size node swap)
+        are trusted, not checked; a caller that cannot uphold the assertion
+        must not pass a hint. TPUExtenderBackend upholds it by owning its
+        cache exclusively and escalating every sync to a full refresh."""
         if volume_ctx is not None:
             self.volume_ctx = volume_ctx
         vol_ctx_moved = self._vol_ctx_ver != self.volume_ctx.version
         self._vol_ctx_ver = self.volume_ctx.version
+        if changed_hint is not None and not vol_ctx_moved \
+                and self._shape_sig is not None \
+                and len(infos) == len(self.node_names) \
+                and self._refresh_hinted(infos, changed_hint):
+            return False
         # node-driven vocabs (taints, extended resources, avoid signatures) —
         # interned before shaping, re-scanned only for changed node specs.
         # The skip-cache keys on (spec_generation, node object identity): a
@@ -416,11 +443,43 @@ class ClusterSnapshot:
             self.version += 1
         return rebuild
 
+    def _refresh_hinted(self, infos: Dict[str, NodeInfo],
+                        changed_hint: Sequence[str]) -> bool:
+        """Targeted dynamic-row delta for `changed_hint`. Returns True when
+        the hint fully covered the update (pure capacity deltas on known
+        nodes); False to make the caller run the full generation scan."""
+        updates = []
+        for nm in changed_hint:
+            info = infos.get(nm)
+            i = self.node_index.get(nm, -1)
+            if info is None or i < 0:
+                return False  # membership drift — full scan
+            prev = self._generations.get(nm)
+            if prev is None or prev[3] is not info \
+                    or prev[1] != info.spec_generation \
+                    or prev[2] != info.ports_generation:
+                return False  # spec/ports/identity moved — needs interning
+            if any(self.ext_vocab.get(name, "") < 0
+                   for name in info.requested.extended):
+                return False  # unseen extended resource — needs interning
+            if prev[0] != info.generation:
+                updates.append((i, nm, info))
+        for i, nm, info in updates:
+            self._write_dynamic_row(i, info)
+            self._generations[nm] = (info.generation, info.spec_generation,
+                                     info.ports_generation, info)
+        if updates:
+            self.version += 1
+        return True
+
     # ------------------------------------------------------------- internals
 
     def _allocate(self, names: List[str], sig: Tuple[int, ...]) -> None:
         n, l, t, r = sig[:4]
         self._shape_sig = sig
+        # membership/order changed: PodBatch encodings store node indices
+        # (PodFitsHost) — every cached encoding keyed on vocab_gen is stale
+        self.vocab_gen += 1
         self.node_names = names
         self.node_index = {nm: i for i, nm in enumerate(names)}
         self._generations = {}

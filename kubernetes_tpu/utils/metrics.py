@@ -36,8 +36,14 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         # (value, multiplicity) samples for exact percentiles in benches —
-        # weighted so a 30k-pod batch round is one entry, not 30k appends
+        # weighted so a 30k-pod batch round is one entry, not 30k appends.
+        # observe_batch keeps its per-pod arrays as raw numpy chunks
+        # instead (zero per-value Python objects on the drain hot path —
+        # the r5 version built 30k (float, 1) tuples per round, a measured
+        # slice of the 0.559->0.898s headline regression); percentile()
+        # merges both stores.
         self._values: List[tuple] = []
+        self._chunks: List = []
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -74,7 +80,7 @@ class Histogram:
                 self._counts[i] += int(c)
             self._sum += float(arr.sum())
             self._count += len(values)
-            self._values.extend((float(v), 1) for v in arr)
+            self._chunks.append(arr)
 
     @property
     def count(self) -> int:
@@ -85,18 +91,31 @@ class Histogram:
         return self._sum
 
     def percentile(self, p: float) -> float:
+        """Exact percentile over both stores (weighted values + raw
+        chunks), merged with a two-pointer walk — sorting happens here, at
+        read time (benches call this a handful of times), never on the
+        observe hot path."""
+        import numpy as np
         with self._lock:
-            if not self._values:
-                return 0.0
             vs = sorted(self._values)
-            total = sum(n for _, n in vs)
+            arr = np.sort(np.concatenate(self._chunks)) if self._chunks \
+                else np.empty(0)
+            total = sum(n for _, n in vs) + len(arr)
+            if total == 0:
+                return 0.0
             target = min(int(p / 100.0 * total), total - 1)
             cum = 0
+            ai = 0
             for v, n in vs:
-                cum += n
-                if target < cum:
+                j = int(np.searchsorted(arr, v, side="left"))
+                if cum + (j - ai) > target:
+                    return float(arr[ai + target - cum])
+                cum += j - ai
+                ai = j
+                if target < cum + n:
                     return v
-            return vs[-1][0]
+                cum += n
+            return float(arr[ai + target - cum])
 
     def render(self) -> str:
         with self._lock:

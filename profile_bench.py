@@ -1,6 +1,15 @@
 """Profiling rig for the headline bench: times each phase of the drain.
 
-Not part of the framework; dev-only. Run: python profile_bench.py
+Not part of the framework; dev-only.
+
+  python profile_bench.py             # drain phase attribution (3 trials)
+  PROFILE_EXTENDER=1 python profile_bench.py
+                                      # warm extender round attribution:
+                                      # where does a /filter+/prioritize
+                                      # round spend its time (refresh,
+                                      # pairs, encode, kernel, HTTP), from
+                                      # the utils.trace.COUNTERS spans the
+                                      # fast lane emits
 """
 from __future__ import annotations
 
@@ -10,7 +19,68 @@ import time
 from bench import build
 
 
+def profile_extender():
+    """Attribute the warm extender round: in-process span times from the
+    fast lane (utils/trace.py COUNTERS) vs the HTTP wall clock, over
+    result-memo hits (repeat class), kernel re-evals (bind between
+    requests), and encode misses (fresh class per request)."""
+    import json
+    import http.client
+
+    from bench import _build_extender
+    from kubernetes_tpu.api import serde
+    from kubernetes_tpu.api.types import make_pod
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    rounds = int(os.environ.get("PROFILE_ROUNDS", 50))
+    backend, srv = _build_extender(n_nodes)
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+
+    def post(path, obj):
+        body = json.dumps(obj)
+        conn.request("POST", f"/scheduler/{path}", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return json.loads(resp.read())
+
+    def run(label, make, bind_between):
+        COUNTERS.reset()
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            pod = make(i)
+            enc = serde.encode_pod(pod)
+            post("filter", {"Pod": enc, "NodeNames": None, "Nodes": None})
+            post("prioritize", {"Pod": enc, "NodeNames": None,
+                                "Nodes": None})
+            if bind_between:
+                backend.bind(pod.name, pod.namespace, pod.uid,
+                             backend.engine.snapshot.node_names[i % n_nodes])
+        wall = time.perf_counter() - t0
+        print(f"\n{label}: {rounds} rounds, "
+              f"{wall / rounds * 1e3:.3f} ms/round wall (HTTP incl.)")
+        for name, (count, secs) in sorted(COUNTERS.snapshot().items()):
+            per = secs / rounds * 1e3
+            print(f"    {name:32s} x{count:<6d} {secs * 1e3:8.1f}ms total"
+                  f"  {per:7.3f} ms/round")
+
+    run("steady (repeat class, result-memo hits)",
+        lambda i: make_pod(f"steady-{i}", cpu=100, memory=256 << 20),
+        bind_between=False)
+    run("scheduleOne (bind between rounds -> kernel re-eval)",
+        lambda i: make_pod(f"so-{i}", cpu=100, memory=256 << 20),
+        bind_between=True)
+    run("fresh class per round (encode misses)",
+        lambda i: make_pod(f"fresh-{i}", cpu=100 + i, memory=256 << 20),
+        bind_between=False)
+    conn.close()
+    srv.stop()
+
+
 def main():
+    if os.environ.get("PROFILE_EXTENDER") == "1":
+        profile_extender()
+        return
     n_nodes = int(os.environ.get("BENCH_NODES", 5000))
     n_pods = int(os.environ.get("BENCH_PODS", 30000))
     profile = os.environ.get("BENCH_PROFILE", "density")

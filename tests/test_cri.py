@@ -108,6 +108,37 @@ def test_compute_pod_actions_fresh_pod_creates_sandbox():
     assert not again.create_sandbox and not again.containers_to_start
 
 
+def test_kubelet_killed_pod_with_restart_policy_never_reports_failed():
+    """ADVICE r5 low (kuberuntime.py:162): every latest attempt EXITED 137
+    (kubelet-killed) and restartPolicy Never forbids a fresh attempt —
+    pod_status must report a terminal Failed phase, or the pod sits in the
+    kubelet's _starting set unready forever. Mirrors the reference's
+    GetPhase: stopped containers that cannot restart fail the pod."""
+    rt, mgr, clock = mk_manager()
+    pod = make_pod("p", cpu=100)
+    pod.restart_policy = "Never"
+    mgr.sync_pod(pod)
+    mgr.restart_pod_containers(pod)  # liveness path: CRI kill -> exit 137
+    st = mgr.pod_status(pod)
+    assert st.completed_phase == "Failed"
+    # and compute_pod_actions still refuses a fresh attempt
+    actions = mgr.compute_pod_actions(pod, st)
+    assert not actions.containers_to_start and not actions.create_sandbox
+
+
+def test_kubelet_killed_pod_with_restartable_policy_stays_pending():
+    """Same 137 state under restartPolicy Always: NOT terminal — the next
+    sync starts a fresh attempt instead."""
+    rt, mgr, clock = mk_manager()
+    pod = make_pod("p", cpu=100)
+    mgr.sync_pod(pod)
+    mgr.restart_pod_containers(pod)
+    st = mgr.pod_status(pod)
+    assert st.completed_phase == ""
+    actions = mgr.compute_pod_actions(pod, st)
+    assert actions.containers_to_start  # fresh attempt queued
+
+
 def test_compute_pod_actions_restarts_killed_not_completed():
     rt, mgr, clock = mk_manager()
     pod = make_pod("p", cpu=100)

@@ -82,16 +82,53 @@ def test_three_way_prunes_manifest_removed_list_item():
 
 
 def test_three_way_preserves_controller_owned_fields():
-    """Manifest pins replicas=2 in both last-applied and new manifest; an
-    HPA moved live to 5 — apply must NOT stomp it (the defining 3-way
-    property; a 2-way diff would reset to 2)."""
-    original = {"replicas": 2, "labels": {"app": "web"}}
-    modified = {"replicas": 2, "labels": {"app": "web", "v": "2"}}
+    """An HPA moved live replicas to 5; the manifest does NOT manage
+    replicas — apply must not stomp it (the defining 3-way property:
+    manifest-UNSPECIFIED fields are controller-owned)."""
+    original = {"labels": {"app": "web"}}
+    modified = {"labels": {"app": "web", "v": "2"}}
     live = {"replicas": 5, "labels": {"app": "web"}, "status": "ok"}
     merged = three_way_merge(original, modified, live)
     assert merged["replicas"] == 5  # HPA's write survives
     assert merged["labels"] == {"app": "web", "v": "2"}
     assert merged["status"] == "ok"
+
+
+def test_three_way_reverts_live_drift_on_manifest_specified_fields():
+    """CreateThreeWayMergePatch's SECOND diff (patch.go:1958: diff
+    (current, modified) with IgnoreDeletions): a field the manifest DOES
+    manage is driven back to the manifest's value even when last-applied
+    already matches the manifest — kubectl apply reverts manual/live
+    drift (this is also why kubectl docs warn against pinning replicas
+    under an HPA). ADVICE r5 medium: the previous 2-diff-only merge left
+    the drift in place."""
+    original = {"replicas": 2, "image": "app:v1"}
+    modified = {"replicas": 2, "image": "app:v1"}
+    live = {"replicas": 2, "image": "app:drifted", "status": "ok"}
+    merged = three_way_merge(original, modified, live)
+    assert merged["image"] == "app:v1"  # drift reverted
+    assert merged["status"] == "ok"     # unmanaged field untouched
+
+
+def test_three_way_reverts_drift_inside_merge_keyed_list_item():
+    original = {"containers": [{"name": "app", "image": "v1"}]}
+    modified = {"containers": [{"name": "app", "image": "v1"}]}
+    live = {"containers": [{"name": "app", "image": "hand-edited",
+                            "requests": {"cpu": 100}}]}
+    merged = three_way_merge(original, modified, live)
+    c = merged["containers"][0]
+    assert c["image"] == "v1"           # drift reverted
+    assert c["requests"] == {"cpu": 100}  # live-only field kept
+
+
+def test_three_way_readds_manifest_field_controller_removed():
+    """A manifest-managed key removed from live comes back (the delta
+    half sees an addition)."""
+    original = {"labels": {"app": "web"}}
+    modified = {"labels": {"app": "web"}}
+    live = {"labels": {}}
+    merged = three_way_merge(original, modified, live)
+    assert merged["labels"] == {"app": "web"}
 
 
 def test_three_way_deletes_map_key_removed_from_manifest():
@@ -176,13 +213,19 @@ def test_apply_three_way_through_ktctl(tmp_path):
     assert len(dep.template.containers) == 2
     # a controller (HPA) scales live replicas to 5
     api.scale("Deployment", "default", "web", replicas=5)
-    # manifest drops the sidecar but still says replicas: 2
+    # manifest drops the sidecar but still says replicas: 2 — reference
+    # semantics (CreateThreeWayMergePatch second diff): the manifest
+    # MANAGES replicas, so apply drives it back to 2, reverting the
+    # HPA's live write (the documented kubectl-vs-HPA conflict; drop
+    # replicas from the manifest to hand it to the controller)
     m.write_text(DEPLOY_V2)
     assert kt.run(["apply", "-f", str(m)]) == 0
     dep = api.get("Deployment", "default", "web")
-    # removed list item pruned; controller-owned replicas survive
+    # removed list item pruned; manifest-pinned replicas enforced
     assert [c.name for c in dep.template.containers] == ["app"]
-    assert dep.replicas == 5
+    assert dep.replicas == 2
+    # server-owned counters the manifest never wrote stay server-owned
+    assert dep.resource_version > 0
     # idempotent re-apply reports unchanged
     out.truncate(0), out.seek(0)
     assert kt.run(["apply", "-f", str(m)]) == 0
@@ -290,3 +333,91 @@ def test_diff_previews_apply_without_writing(tmp_path):
     assert "sidecar" in out.getvalue()
     dep = api.get("Deployment", "default", "web")
     assert len(dep.template.containers) == 2  # live object untouched
+
+
+NODE_MANIFEST = """
+apiVersion: v1
+kind: Node
+metadata:
+  name: n1
+  labels: {pool: web}
+  annotations:
+    owner: team-a
+"""
+
+
+def test_apply_node_annotation_change_sticks(tmp_path):
+    """ADVICE r5 low (ktctl.py _decode_canon): user-requested Node
+    annotation changes must survive apply — the old code wholesale-restored
+    the live annotation map after the merge, silently discarding them.
+    Server-owned keys (TTL controller, attach-detach) still survive."""
+    api, kt, out = mk_cli()
+    m = tmp_path / "n.yaml"
+    m.write_text(NODE_MANIFEST)
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    # controllers write their own keys on the live object
+    live = api.get("Node", "", "n1")
+    live.annotations["node.alpha.kubernetes.io/ttl"] = "30"
+    live.annotations["volumes.kubernetes.io/attached"] = "vol-1"
+    api.update("Node", live)
+    # user changes one annotation and adds another
+    m.write_text(NODE_MANIFEST.replace("owner: team-a",
+                                       "owner: team-b\n    rack: r7"))
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    n = api.get("Node", "", "n1")
+    assert n.annotations["owner"] == "team-b"      # change applied
+    assert n.annotations["rack"] == "r7"           # addition applied
+    assert n.annotations["node.alpha.kubernetes.io/ttl"] == "30"
+    assert n.annotations["volumes.kubernetes.io/attached"] == "vol-1"
+
+
+def test_apply_node_annotation_removal_prunes(tmp_path):
+    """Dropping a previously-applied annotation from the manifest deletes
+    it (3-way deletions half), without touching controller-owned keys."""
+    api, kt, out = mk_cli()
+    m = tmp_path / "n.yaml"
+    m.write_text(NODE_MANIFEST)
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    live = api.get("Node", "", "n1")
+    live.annotations["node.alpha.kubernetes.io/ttl"] = "15"
+    api.update("Node", live)
+    m.write_text(NODE_MANIFEST.replace("\n  annotations:\n    owner: team-a",
+                                       ""))
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    n = api.get("Node", "", "n1")
+    assert "owner" not in n.annotations            # pruned
+    assert n.annotations["node.alpha.kubernetes.io/ttl"] == "15"
+
+
+POD_MANIFEST_FLAT = """
+kind: Pod
+name: flatp
+namespace: default
+labels: {app: flat}
+containers:
+- name: app
+  image: app:v1
+  requests: {cpu: 100}
+"""
+
+
+def test_apply_flat_shape_pod_manifest_updates_apply(tmp_path):
+    """decode_any accepts the flat native shape too; the delta projection
+    must tolerate the raw manifest not nesting metadata/spec the way the
+    canonical encoding does — a flat manifest's image bump must really
+    apply (regression: empty projection silently dropped every update
+    while still printing 'configured')."""
+    api, kt, out = mk_cli()
+    m = tmp_path / "p.yaml"
+    m.write_text(POD_MANIFEST_FLAT)
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    assert api.get("Pod", "default", "flatp").containers[0].image == "app:v1"
+    m.write_text(POD_MANIFEST_FLAT.replace("app:v1", "app:v2"))
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    p = api.get("Pod", "default", "flatp")
+    assert p.containers[0].image == "app:v2"
+    # and drift on a flat-manifest-specified field reverts
+    p.labels["app"] = "drifted"
+    api.update("Pod", p)
+    assert kt.run(["apply", "-f", str(m)]) == 0
+    assert api.get("Pod", "default", "flatp").labels["app"] == "flat"
