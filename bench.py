@@ -4,8 +4,10 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 
 Scenario (north star, BASELINE.md): 30,000 pending pods onto a 5,000-node
 hollow cluster, end-to-end through the control plane — apiserver-lite create,
-watch-driven queue fill, tensor snapshot, fused TPU batch placement with
-sequential assume semantics, per-pod bind writes, watch confirmation.
+watch-driven queue fill, tensor snapshot, fused TPU wave placement through
+the two-stage PIPELINED drain (wave k+1's device eval overlapping wave k's
+columnar assume/bind/watch-drain — engine/scheduler.py), bulk bind writes,
+watch confirmation.
 
 vs_baseline is the ratio against the reference's 100 pods/s warn-level
 scheduler throughput (test/integration/scheduler_perf/scheduler_test.go:35 —
@@ -54,8 +56,15 @@ def build(n_nodes: int, n_pods: int, profile: str):
 
 def run_once(n_nodes: int, n_pods: int, profile: str):
     api, sched = build(n_nodes, n_pods, profile)
+    # pipeline knobs: BENCH_PIPELINE=0 -> classic synchronous rounds;
+    # BENCH_OVERLAP=0 -> pipelined dataflow, sequential execution (the A/B
+    # debug mode); BENCH_CHUNK=<n> -> fixed wave size (default: auto)
+    pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
+    overlap = os.environ.get("BENCH_OVERLAP", "1") != "0"
+    chunk = int(os.environ.get("BENCH_CHUNK", "0"))
     t0 = time.monotonic()
-    totals = sched.run_until_drained()
+    totals = sched.run_until_drained(max_batch=chunk, pipeline=pipeline,
+                                     overlap=overlap)
     elapsed = time.monotonic() - t0
     return totals, elapsed, sched
 
@@ -255,16 +264,24 @@ def measure_compat_scheduleone(n_nodes: int, n_pods: int = 2000,
 
 
 def run_arrival(n_nodes: int, rate: float, duration_s: float,
-                profile: str = "density"):
+                profile: str = "density", pipeline: bool = True):
     """Arrival-stream scenario (VERDICT r5 weak #3): pods are CREATED at a
     configured rate while the scheduler runs, instead of pre-loaded and
     drained once — the reference's density suite semantics
     (test/integration/scheduler_perf/scheduler_test.go:34-39 per-interval
     sustained throughput; test/e2e/scalability/density.go:316-320 startup
-    latency under churn). Returns (intervals_pods_s, sustained_pods_s,
-    p50_ms, p99_ms, bound) where the percentiles are the now-MEANINGFUL
-    per-pod create->bound distribution (pods arriving in different rounds
-    see different queue states, so p50 != p99)."""
+    latency under churn). The scheduler consumes through the two-stage
+    pipelined drain (engine/scheduler.py _DrainPipeline) unless
+    pipeline=False.
+
+    Returns a dict: intervals (1s-bucket bound counts), offered_pods_s,
+    sustained_pods_s, p50_ms/p99_ms (per-pod create->bound — MEANINGFUL:
+    pods arriving in different rounds see different queue states, so
+    p50 != p99), bound, backlog_at_offer_end (queue depth the instant the
+    creator finished — the host-bound smoking gun a throughput number
+    alone would hide), and unbound (pods never placed). Offered vs
+    sustained vs backlog together make a host-bound run IMPOSSIBLE to
+    misread as keeping up with the offered rate."""
     from kubernetes_tpu.engine.scheduler import Scheduler
     from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes, load_cluster
     from kubernetes_tpu.server.apiserver_lite import ApiServerLite
@@ -304,28 +321,51 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     # silently truncates low-rate runs (empty rounds take microseconds),
     # returning a plausible-looking JSON over a partial window
     deadline = t0 + max(60.0, duration_s * 20)
-    while True:
-        r0 = time.monotonic() - t0
-        stats = sched.schedule_round()
-        r1 = time.monotonic() - t0
-        if stats["bound"]:
-            bound_log.append((r0, r1, stats["bound"]))
-        if created[0] >= total and stats["popped"] == 0 \
-                and sched.sync() == 0 and sched.queue.ready_count() == 0 \
-                and not sched.queue._deferred:
-            # the deferred (backoff) heap must drain too: a pod requeued
-            # after a transient bind error is RETRIABLE, and abandoning it
-            # would report percentiles over a silently partial population.
-            # Truly-unschedulable pods never stop re-entering the ready
-            # queue, so the wall-clock deadline above still bounds the run.
-            break
-        if time.monotonic() > deadline:
-            raise RuntimeError(
-                f"arrival run incomplete after {deadline - t0:.0f}s: "
-                f"created {created[0]}/{total}, bound "
-                f"{sum(n for _, _, n in bound_log)}")
-        if stats["popped"] == 0:
-            time.sleep(0.005)  # idle: wait for arrivals, don't busy-spin
+    pipe = sched.pipeline() if pipeline else None
+    backlog_at_offer_end = None
+    try:
+        while True:
+            r0 = time.monotonic() - t0
+            stats = pipe.step() if pipe is not None \
+                else sched.schedule_round()
+            r1 = time.monotonic() - t0
+            if stats["bound"]:
+                bound_log.append((r0, r1, stats["bound"]))
+            if backlog_at_offer_end is None and created[0] >= total:
+                # the offered stream just ended: whatever is still queued
+                # or mid-pipeline (popped into the in-flight wave but not
+                # yet harvested) is the backlog the scheduler could not
+                # keep up with
+                inflight = 0
+                if pipe is not None and pipe.inflight is not None:
+                    inflight = len(pipe.inflight.pods)
+                backlog_at_offer_end = len(sched.queue) + inflight
+            if created[0] >= total and stats["popped"] == 0 \
+                    and (pipe is None or pipe.idle) \
+                    and sched.sync() == 0 \
+                    and sched.queue.ready_count() == 0 \
+                    and not sched.queue._deferred:
+                # the deferred (backoff) heap must drain too: a pod requeued
+                # after a transient bind error is RETRIABLE, and abandoning
+                # it would report percentiles over a silently partial
+                # population. Truly-unschedulable pods never stop
+                # re-entering the ready queue, so the wall-clock deadline
+                # above still bounds the run.
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"arrival run incomplete after {deadline - t0:.0f}s: "
+                    f"created {created[0]}/{total}, bound "
+                    f"{sum(n for _, _, n in bound_log)}")
+            if stats["popped"] == 0 and stats["bound"] == 0:
+                time.sleep(0.005)  # idle: wait for arrivals, don't busy-spin
+    finally:
+        if pipe is not None:
+            leftover = pipe.close()
+            if leftover.get("bound"):
+                bound_log.append((time.monotonic() - t0,
+                                  time.monotonic() - t0,
+                                  leftover["bound"]))
     creator_thread.join(timeout=10)
     # per-interval sustained throughput (1s buckets; scheduler_test.go:34-39
     # reports per-interval scheduled counts). A round's binds are spread
@@ -358,8 +398,17 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     else:
         sustained = 0.0
     c2b = sched.metrics.create_to_bound
-    return (intervals, float(sustained), c2b.percentile(50) * 1e3,
-            c2b.percentile(99) * 1e3, sum(n for _, _, n in bound_log))
+    bound = sum(n for _, _, n in bound_log)
+    return {
+        "intervals": intervals,
+        "offered_pods_s": float(rate),
+        "sustained_pods_s": float(sustained),
+        "p50_ms": c2b.percentile(50) * 1e3,
+        "p99_ms": c2b.percentile(99) * 1e3,
+        "bound": int(round(bound)),
+        "backlog_at_offer_end": int(backlog_at_offer_end or 0),
+        "unbound": total - int(round(bound)),
+    }
 
 
 def measure_extender_latency(n_nodes: int, rounds: int = 20):
@@ -502,16 +551,22 @@ def main():
         "compat_bound": compat[3] if compat else None,
         "compat_unschedulable": compat[4] if compat else None,
         # arrival stream: rate-driven creates; sustained = median 1s-interval
-        # bound count; create->bound percentiles are per-pod and
-        # non-degenerate (pods arrive into different queue states)
-        "arrival_rate_pods_s": arrival_rate if arrival else None,
-        "arrival_sustained_pods_s": arrival[1] if arrival else None,
-        "arrival_intervals": arrival[0] if arrival else None,
-        "arrival_p50_create_to_bound_ms": round(arrival[2], 3)
+        # bound count; offered vs sustained vs backlog reported TOGETHER so
+        # a host-bound run can't silently read as keeping up (ISSUE 2);
+        # create->bound percentiles are per-pod and non-degenerate
+        "arrival_offered_pods_s": arrival["offered_pods_s"]
         if arrival else None,
-        "arrival_p99_create_to_bound_ms": round(arrival[3], 3)
+        "arrival_sustained_pods_s": arrival["sustained_pods_s"]
         if arrival else None,
-        "arrival_bound": arrival[4] if arrival else None,
+        "arrival_backlog_at_offer_end": arrival["backlog_at_offer_end"]
+        if arrival else None,
+        "arrival_unbound": arrival["unbound"] if arrival else None,
+        "arrival_intervals": arrival["intervals"] if arrival else None,
+        "arrival_p50_create_to_bound_ms": round(arrival["p50_ms"], 3)
+        if arrival else None,
+        "arrival_p99_create_to_bound_ms": round(arrival["p99_ms"], 3)
+        if arrival else None,
+        "arrival_bound": arrival["bound"] if arrival else None,
     }))
 
 

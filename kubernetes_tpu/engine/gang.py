@@ -147,6 +147,7 @@ def schedule_gangs(engine, ready: List[Tuple[str, List[Pod], int]],
         # ForgetPod, applied transactionally across the group)
         for r in ok:
             engine.cache.forget_pod(r.pod)
+            engine.note_node_dirty(r.pod.node_name)
             r.pod.node_name = ""
         results.append(GangResult(
             name, False, [], members,

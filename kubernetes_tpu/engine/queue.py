@@ -89,6 +89,22 @@ class SchedulingQueue:
                                   if p.key() != pod_key]
                 heapq.heapify(self._deferred)
 
+    def remove_many(self, pod_keys: List[str]) -> None:
+        """remove() for a batch under one lock. The bind-confirmation storm
+        calls this with keys that are almost never queued (the pods were
+        popped before binding), so absence costs one set probe per key and
+        the list rebuilds happen at most once per batch."""
+        with self._lock:
+            present = {k for k in pod_keys if k in self._keys}
+            if not present:
+                return
+            for k in present:
+                del self._keys[k]
+            self._fifo = [p for p in self._fifo if p.key() not in present]
+            self._deferred = [(t, s, p) for (t, s, p) in self._deferred
+                              if p.key() not in present]
+            heapq.heapify(self._deferred)
+
     def pop_batch(self, max_n: int = 0, wait: Optional[float] = None) -> List[Pod]:
         """Drain up to max_n (0 = all) ready pods; optionally block up to
         `wait` seconds for the first one."""

@@ -5,7 +5,25 @@ Structural mirror of the reference's scheduler loop
 factory's informer wiring, factory.go:120-601), TPU-batched: instead of a
 single-goroutine one-pod loop, each round drains the ready queue and places
 the whole batch in one device program (engine/batch.py), then binds each
-placement through the apiserver. Error paths preserved:
+placement through the apiserver.
+
+Two drain modes:
+
+- schedule_round: the classic SYNCHRONOUS round (device placement blocks
+  before host bookkeeping); still the path for gangs, preemption, policy
+  algorithms, and any batch the wave engine can't take.
+- run_until_drained / _DrainPipeline: the PIPELINED drain (ISSUE 2) —
+  wave k+1's fused device eval is dispatched (JAX async) before wave k's
+  device→host sync, so assume/bind/watch-drain of wave k overlap device
+  time of wave k+1. Wave k+1 is therefore encoded blind to wave k's
+  commits; harvest re-validates against post-k occupancy (the fence in
+  engine/scheduler_engine.harvest_waves) and capacity losers requeue —
+  the same optimistic-concurrency shape as assume/expire. Host phases are
+  columnar: the watch drain batches bind confirmations, assumes are
+  grouped per (node, class), binds go through one bulk write, and the
+  snapshot refresh rides the changed_hint / raw-delta fast paths.
+
+Error paths preserved:
 
 - no fitting node -> FailedScheduling event + backoff requeue
   (scheduler.go:174-181; factory.go:897 MakeDefaultErrorFunc)
@@ -77,7 +95,17 @@ class Scheduler:
             self.cache, priorities=priorities,
             workloads_provider=lambda: list(self._workloads.values()),
             policy_algos=self._policy_algos)
+        # this Scheduler owns its cache exclusively and routes every
+        # mutation through the engine's dirty notes, so refreshes may take
+        # the targeted changed_hint path instead of walking all N nodes
+        self.engine.track_dirty = True
         self.queue = SchedulingQueue(now=now)
+        # pipelined drain knobs (run_until_drained/run_arrival): chunk =
+        # pods per wave (double-buffered), set by PIPELINE_CHUNK-style
+        # callers; _pipeline is the live pipeline whose in-flight wave a
+        # capacity-unsafe watch event must flush before applying
+        self.pipeline_chunk = 4096
+        self._pipeline = None
         self.metrics = SchedulerMetrics()
         self.record_events = record_events
         self.events: List[Event] = []
@@ -131,7 +159,15 @@ class Scheduler:
 
     def sync(self, wait: float = 0.0) -> int:
         """Drain watch events into cache + queue (the informer event handlers
-        of factory.go:188-260). Returns number of events processed."""
+        of factory.go:188-260). Returns number of events processed.
+
+        Columnar drain: a bind storm's confirmation events (MODIFIED pod,
+        unbound -> bound — 30k of them per headline round) batch into ONE
+        queue sweep + ONE cache lock pass instead of a per-event dispatch
+        loop. Events that can invalidate an in-flight pipelined wave's
+        static assumptions (node spec/membership, PV/PVC) flush the pipeline
+        BEFORE being applied, so the wave's fence only ever needs the
+        capacity re-check."""
         if not self._started:
             self.start()
             return 0
@@ -140,63 +176,130 @@ class Scheduler:
                 ("Pod", "Node") + self.WORKLOAD_KINDS + self.VOLUME_KINDS,
                 self._rv, timeout=wait)
         except TooOldResourceVersion:
+            self._interrupt_pipeline()  # the in-flight wave belongs to the
+            # pre-relist engine; harvest it against that state first
             self._relist()
             return 0
-        for ev in events:
-            self._rv = ev.rv
-            if ev.kind == "Node":
-                self._on_node_event(ev.type, ev.obj)
-            elif ev.kind == "Pod":
-                self._on_pod_event(ev.type, ev.obj)
-            elif ev.kind in self.VOLUME_KINDS:
-                self._on_volume_event(ev.kind, ev.type, ev.obj)
-            else:
-                key = (ev.kind + "/" + getattr(ev.obj, "namespace", "")
-                       + "/" + ev.obj.name)
-                if ev.type == "DELETED":
-                    self._workloads.pop(key, None)
+        if not events:
+            return 0
+        confirms: List[Pod] = []
+        buffered: Dict[str, Pod] = {}  # key -> newest BUFFERED pod: the
+        # confirm gate must see pods buffered earlier in this batch, but
+        # self._pods only updates at flush so a mid-batch exception leaves
+        # it consistent with what was actually applied
+        simple_ok = not self._gang_waiting
+        pods_map = self._pods
+        # the cursor advances per PROCESSED event via a cheap local (an
+        # attribute store per event is measurable at 30k confirmations per
+        # round): buffered-but-unflushed confirms do NOT advance it, so a
+        # handler exception mid-batch rolls the cursor back to the last
+        # applied event and a retried sync() re-fetches the rest —
+        # re-applying a flushed confirm is idempotent, skipping one is not
+        last_rv = self._rv
+        try:
+            for ev in events:
+                kind = ev.kind
+                obj = ev.obj
+                if simple_ok and kind == "Pod" and ev.type == "MODIFIED" \
+                        and obj.node_name:
+                    key = obj.key()
+                    prev = buffered.get(key)
+                    if prev is None:
+                        prev = pods_map.get(key)
+                    if prev is not None and not prev.node_name:
+                        # unbound -> bound: a bind confirmation (ours or a
+                        # foreign scheduler's). Capacity effects are noted
+                        # by the bulk flush; no in-flight flush needed.
+                        buffered[key] = obj
+                        confirms.append(obj)
+                        continue
+                # slow path: apply any buffered confirms FIRST (per-pod
+                # event order preserved), then dispatch the handler
+                if confirms:
+                    self._flush_confirms(confirms, buffered)
+                    last_rv = ev.rv - 1
+                if kind == "Pod":
+                    self._on_pod_event(ev.type, obj)
+                elif kind == "Node":
+                    self._interrupt_pipeline()
+                    self._on_node_event(ev.type, obj)
+                elif kind in self.VOLUME_KINDS:
+                    self._interrupt_pipeline()
+                    self._on_volume_event(kind, ev.type, obj)
                 else:
-                    self._workloads[key] = to_workload_object(ev.kind, ev.obj)
+                    key = (kind + "/" + getattr(obj, "namespace", "")
+                           + "/" + obj.name)
+                    if ev.type == "DELETED":
+                        self._workloads.pop(key, None)
+                    else:
+                        self._workloads[key] = to_workload_object(kind, obj)
+                last_rv = ev.rv
+            if confirms:
+                self._flush_confirms(confirms, buffered)
+            self._rv = events[-1].rv
+        except BaseException:
+            self._rv = last_rv
+            raise
         return len(events)
+
+    def _flush_confirms(self, confirms: List[Pod],
+                        buffered: Dict[str, Pod]) -> None:
+        """Apply a run of bind confirmations columnar: one queue sweep, one
+        cache lock, one bookkeeping pass. Per-pod semantics identical to
+        _on_pod_event's unbound->bound branch, order preserved per pod.
+        Idempotent per pod, so a retried sync() may safely re-apply."""
+        keys = [p.key() for p in confirms]
+        self.queue.remove_many(keys)
+        touched = self.cache.add_pods_bulk(confirms)
+        if touched:  # foreign binds / moves mutated NodeInfos
+            self.engine.note_node_dirty(*touched)
+        pods_map = self._pods
+        fq = self._first_queued
+        for k, p in zip(keys, confirms):
+            pods_map[k] = p
+            fq.pop(k, None)
+        confirms.clear()
+        buffered.clear()
+
+    def _interrupt_pipeline(self) -> None:
+        """Harvest any in-flight pipelined wave NOW — called before applying
+        a watch event the wave's capacity fence cannot re-validate (node
+        spec/membership, volume topology)."""
+        if self._pipeline is not None:
+            self._pipeline.flush()
 
     # ------------------------------------------------------------ scheduling
 
     def schedule_round(self, max_batch: int = 0, wait: float = 0.0) -> Dict[str, int]:
         """One batch round: pop ready pods, place on device, bind. Mirrors
         scheduleOne (scheduler.go:253) over a whole batch, wrapped in a
-        slow-schedule trace (generic_scheduler.go:89-90's 100ms utiltrace)."""
+        slow-schedule trace (generic_scheduler.go:89-90's 100ms utiltrace).
+
+        This is the SYNCHRONOUS round: device placement blocks before the
+        host bookkeeping runs. run_until_drained/run_arrival use the
+        pipelined drain (wave k+1's device time overlapping wave k's host
+        phases) and fall back to this body per chunk when a batch needs the
+        strict/oracle machinery."""
         trace = Trace("Scheduling round")
         self.sync()
         trace.step("informer sync done")
         pods = self.queue.pop_batch(max_n=max_batch, wait=wait)
         pop_ts = time.monotonic()  # NextPod-pop instant (scheduler.go:289)
+        return self._process_batch(pods, pop_ts, trace)
+
+    def _process_batch(self, pods: List[Pod], pop_ts: float,
+                       trace: Optional[Trace] = None) -> Dict[str, int]:
+        if trace is None:
+            trace = Trace("Scheduling round")
         stats = {"popped": len(pods), "bound": 0, "unschedulable": 0,
                  "bind_errors": 0, "preemptions": 0}
         # gang (coscheduling) gating: pods in a group schedule atomically
         # once their quorum is in the queue (engine/gang.py); incomplete
         # gangs park in _gang_waiting until members arrive
         plain, gangs = gangmod.partition(pods)
-        # parked-too-long gangs surface even on empty rounds — a gang below
-        # quorum with no new arrivals would otherwise never reach the sweep
-        # (quorum may never come: members deleted, minAvailable typo);
-        # members re-queue with backoff — retried AND visible via events.
-        # A gang receiving members THIS round is exempt: the arrival may
-        # complete its quorum below, and evicting it first would turn an
-        # on-time completion into a spurious backoff cycle.
-        now = self._now()
-        for gname in [g for g, t0_ in self._gang_parked_at.items()
-                      if now - t0_ > self.GANG_WAIT_TIMEOUT_S
-                      and g not in gangs]:
-            waiting = self._gang_waiting.pop(gname, {})
-            self._gang_parked_at.pop(gname, None)
-            for m in waiting.values():
-                self._event(m, "Warning", "FailedScheduling",
-                            f"gang {gname} below quorum for "
-                            f"{self.GANG_WAIT_TIMEOUT_S:.0f}s")
-                self.queue.add_backoff(m)
+        self._sweep_parked_gangs(gangs)
         if not pods:
-            self.cache.cleanup_assumed()
-            self.queue.backoff.gc()
+            self._idle_gc()
             return stats
         trace.field("pods", len(pods))
         ready_gangs = []
@@ -250,13 +353,16 @@ class Scheduler:
         trace.step("batch placement computed (device)")
         placed = []
         unschedulable_pods = []
+        record = self.record_events
         for r in results:
             if r.node_name is None:
                 stats["unschedulable"] += 1
                 self.metrics.failed.inc()
-                self._event(r.pod, "Warning", "FailedScheduling",
-                            f"0/{len(self.engine.snapshot.node_names)} nodes "
-                            f"available (fit_count={r.fit_count})")
+                if record:
+                    self._event(
+                        r.pod, "Warning", "FailedScheduling",
+                        f"0/{len(self.engine.snapshot.node_names)} nodes "
+                        f"available (fit_count={r.fit_count})")
                 unschedulable_pods.append(r.pod)
                 self.queue.add_backoff(r.pod)
             else:
@@ -269,20 +375,10 @@ class Scheduler:
              for r in placed])
         bind_done = time.monotonic()
         t_bind = bind_done - tb0
-        bound_pods = []
-        for r, err in zip(placed, errs):
-            if err is not None:
-                # undo the optimistic assume (scheduler.go:234-245)
-                stats["bind_errors"] += 1
-                self.cache.forget_pod(r.pod)
-                self._event(r.pod, "Warning", "FailedBinding", err)
-                retry = dataclasses.replace(r.pod, node_name="")
-                self.queue.add_backoff(retry)
-                continue
-            bound_pods.append(r.pod)
-            stats["bound"] += 1
-            self._event(r.pod, "Normal", "Scheduled",
-                        f"Successfully assigned {r.pod.key()} to {r.node_name}")
+        bound_pods, n_errors = self._finish_binds(
+            [r.pod for r in placed], errs)
+        stats["bind_errors"] += n_errors
+        stats["bound"] += len(bound_pods)
         trace.step("bindings written")
         self.cache.finish_bindings_bulk(bound_pods)
         if unschedulable_pods and features.enabled("PodPriority"):
@@ -303,14 +399,43 @@ class Scheduler:
         self.metrics.create_to_bound.observe_batch(
             [bind_done - self._first_queued.pop(p.key(), pop_ts)
              for p in bound_pods])
-        self.cache.cleanup_assumed()
-        self.queue.backoff.gc()
+        self._idle_gc()
         # per-pod amortized threshold: a 30k-pod round is not "slow" the way
         # a 30k-pod-long one-pod trace would be; scale like the reference's
         # per-Schedule-call threshold
         trace.log_if_long(SCHEDULE_TRACE_THRESHOLD_S
                           * max(scheduled_count, 1))
         return stats
+
+    def _sweep_parked_gangs(self, gangs) -> None:
+        """Parked-too-long gangs surface even on empty rounds — a gang below
+        quorum with no new arrivals would otherwise never reach the sweep
+        (quorum may never come: members deleted, minAvailable typo);
+        members re-queue with backoff — retried AND visible via events.
+        A gang receiving members THIS round (`gangs`) is exempt: the arrival
+        may complete its quorum, and evicting it first would turn an on-time
+        completion into a spurious backoff cycle."""
+        if not self._gang_parked_at:
+            return
+        now = self._now()
+        for gname in [g for g, t0_ in self._gang_parked_at.items()
+                      if now - t0_ > self.GANG_WAIT_TIMEOUT_S
+                      and g not in gangs]:
+            waiting = self._gang_waiting.pop(gname, {})
+            self._gang_parked_at.pop(gname, None)
+            for m in waiting.values():
+                self._event(m, "Warning", "FailedScheduling",
+                            f"gang {gname} below quorum for "
+                            f"{self.GANG_WAIT_TIMEOUT_S:.0f}s")
+                self.queue.add_backoff(m)
+
+    def _idle_gc(self) -> None:
+        """Empty-round housekeeping: expire unconfirmed assumes, gc backoff
+        stamps. An expiry mutates NodeInfos the scheduler cannot attribute
+        to a node it tracked — force the next refresh to walk everything."""
+        if self.cache.cleanup_assumed():
+            self.engine.note_full_refresh()
+        self.queue.backoff.gc()
 
     def _preempt_round(self, unschedulable: List[Pod]) -> int:
         """Preemption pass (1.8 generic_scheduler.Preempt, feature-gated
@@ -376,18 +501,153 @@ class Scheduler:
             count += 1
         return count
 
+    # ------------------------------------------------------ pipelined drain
+
+    def _wave_eligible(self, pods: List[Pod]) -> bool:
+        """Cheap host-side gate before dispatch: gangs schedule atomically
+        through the classic round; the engine applies the deeper checks
+        (affinity, host-path classes, policy) itself."""
+        return all(gangmod.gang_name(p) is None for p in pods)
+
+    def _bind_bulk(self, pods: List[Pod]) -> List[Optional[str]]:
+        """One bulk binding write for already-placed pods. Prefers the
+        store's identifier-reading fast path; any bind_many-only API
+        implementation (the full authenticated apiserver, test doubles)
+        gets the classic Binding batch instead."""
+        bulk = getattr(self.api, "bind_pods_bulk", None)
+        if bulk is not None:
+            return bulk(pods)
+        return self.api.bind_many(
+            [Binding(p.name, p.namespace, p.uid, p.node_name)
+             for p in pods])
+
+    def _finish_binds(self, pods: List[Pod], errs) -> Tuple[List[Pod], int]:
+        """The shared bind-result tail of BOTH drain paths (classic round
+        and pipelined harvest): per-pod error rollback (ForgetPod + backoff
+        requeue, scheduler.go:234-245) or Scheduled event. Returns
+        (bound_pods, error_count)."""
+        bound_pods: List[Pod] = []
+        n_errors = 0
+        record = self.record_events  # 30k f-strings nobody reads would
+        # dominate this loop when event recording is off
+        for pod, err in zip(pods, errs):
+            if err is not None:
+                # undo the optimistic assume
+                n_errors += 1
+                self.cache.forget_pod(pod)
+                self.engine.note_node_dirty(pod.node_name)
+                self._event(pod, "Warning", "FailedBinding", err)
+                self.queue.add_backoff(
+                    dataclasses.replace(pod, node_name=""))
+                continue
+            bound_pods.append(pod)
+            if record:
+                self._event(pod, "Normal", "Scheduled",
+                            f"Successfully assigned {pod.key()} "
+                            f"to {pod.node_name}")
+        return bound_pods, n_errors
+
+    def _complete_wave(self, handle) -> Dict[str, int]:
+        """Host-side completion of one harvested wave: fence conflicts
+        requeue WITHOUT backoff (a capacity race with the blind wave, not
+        unschedulability), survivors bind in one bulk write, bookkeeping is
+        columnar. This is the work wave k+1's device time hides."""
+        res = self.engine.harvest_waves(handle)
+        out = {"popped": 0, "bound": 0, "bind_errors": 0, "preemptions": 0,
+               "unschedulable": len(res.unschedulable),
+               "fence_requeued": len(res.conflicts)}
+        record = self.record_events
+        for pod in res.conflicts:
+            self.queue.add(pod)  # node_name never set on a fenced pod
+        if res.unschedulable:
+            self.metrics.failed.inc(len(res.unschedulable))
+            for pod, fcnt in res.unschedulable:
+                if record:
+                    self._event(
+                        pod, "Warning", "FailedScheduling",
+                        f"0/{len(self.engine.snapshot.node_names)} nodes "
+                        f"available (fit_count={fcnt})")
+                self.queue.add_backoff(pod)
+        if not res.bound:
+            return out
+        tb0 = time.monotonic()
+        errs = self._bind_bulk(res.bound)
+        t_bind = time.monotonic() - tb0
+        bound_pods, n_errors = self._finish_binds(res.bound, errs)
+        out["bind_errors"] += n_errors
+        bind_done = time.monotonic()
+        keys = [p.key() for p in bound_pods]  # computed once, shared by the
+        # TTL pass and the latency harvest below
+        self.cache.finish_bindings_bulk(bound_pods, keys=keys)
+        n = len(bound_pods)
+        out["bound"] = n
+        self.metrics.scheduled.inc(n)
+        # honest per-wave spans: algorithm = the residual device wait this
+        # wave's overlap did NOT hide; e2e = pop -> bind-complete including
+        # the one-wave pipeline lag every pod in the chunk really waited
+        self.metrics.algorithm_latency.observe_many(res.t_block, n)
+        self.metrics.binding_latency.observe_many(t_bind, n)
+        self.metrics.e2e_latency.observe_many(bind_done - handle.pop_ts, n)
+        fq_pop = self._first_queued.pop
+        pop_ts = handle.pop_ts
+        self.metrics.create_to_bound.observe_batch(
+            [bind_done - fq_pop(k, pop_ts) for k in keys])
+        return out
+
+    def pipeline(self, chunk: int = 0, overlap: bool = True):
+        """A live two-stage drain pipeline (ISSUE 2). step() pops one chunk,
+        dispatches its fused wave eval WITHOUT blocking, then harvests the
+        PREVIOUS chunk — so wave k+1's device time overlaps wave k's host
+        bookkeeping. overlap=False is the sequential debug mode: identical
+        dataflow (same blind window, same fence), device forced to complete
+        before the host tail — placements are bit-identical, only the
+        wall-clock overlap is forfeited."""
+        return _DrainPipeline(self, chunk or self.pipeline_chunk, overlap)
+
     def run_until_drained(self, max_rounds: int = 10_000,
-                          max_batch: int = 0) -> Dict[str, int]:
-        """Bench helper: rounds until queue is empty and no watch events."""
+                          max_batch: int = 0,
+                          pipeline: Optional[bool] = None,
+                          overlap: bool = True) -> Dict[str, int]:
+        """Bench helper: rounds until queue is empty and no watch events.
+
+        pipeline=None auto-selects: wave mode without PodPriority drains
+        through the two-stage pipeline (chunked, overlapped); strict mode
+        and priority scheduling keep the classic synchronous rounds, and
+        any chunk the engine cannot wave-place falls back per chunk."""
         total = {"popped": 0, "bound": 0, "unschedulable": 0,
-                 "bind_errors": 0, "preemptions": 0}
-        for _ in range(max_rounds):
-            stats = self.schedule_round(max_batch=max_batch)
-            for k in total:
-                total[k] += stats[k]
-            if stats["popped"] == 0 and self.sync() == 0 \
-                    and self.queue.ready_count() == 0:
-                break
+                 "bind_errors": 0, "preemptions": 0, "fence_requeued": 0}
+        if pipeline is None:
+            pipeline = (self.batch_mode == "wave"
+                        and not features.enabled("PodPriority"))
+        if not pipeline:
+            for _ in range(max_rounds):
+                stats = self.schedule_round(max_batch=max_batch)
+                for k in stats:
+                    total[k] += stats[k]
+                if stats["popped"] == 0 and self.sync() == 0 \
+                        and self.queue.ready_count() == 0:
+                    break
+            return total
+        # chunk sizing: enough waves for the overlap to hide device time,
+        # few enough that per-wave fixed costs (refresh, encode reuse,
+        # group assume) stay amortized — a pre-loaded 30k queue drains as
+        # two double-buffered waves (measured optimum on the CPU box;
+        # PROFILE_r07.md)
+        ready = self.queue.ready_count()
+        chunk = max_batch or max(self.pipeline_chunk, -(-ready // 2))
+        pipe = self.pipeline(chunk=chunk, overlap=overlap)
+        try:
+            for _ in range(max_rounds):
+                stats = pipe.step()
+                for k in stats:
+                    total[k] += stats[k]
+                if stats["popped"] == 0 and pipe.idle \
+                        and self.sync() == 0 \
+                        and self.queue.ready_count() == 0:
+                    break
+        finally:
+            for k, v in pipe.close().items():
+                total[k] += v
         return total
 
     # ------------------------------------------------------------- handlers
@@ -425,6 +685,9 @@ class Scheduler:
         vctx.version += 1
 
     def _on_node_event(self, etype: str, node: Node) -> None:
+        # membership or spec moved: the targeted-refresh hint cannot name
+        # what changed (vocab interning, node order) — next refresh walks all
+        self.engine.note_full_refresh()
         if etype == "DELETED":
             self.cache.remove_node(node.name)
         else:
@@ -444,11 +707,13 @@ class Scheduler:
             self.queue.remove(key)
             if prev is not None and prev.node_name:
                 self.cache.remove_pod(prev)
+                self.engine.note_node_dirty(prev.node_name)
             return
         self._pods[key] = pod
         if etype == "ADDED":
             if pod.node_name:
                 self.cache.add_pod(pod)
+                self.engine.note_node_dirty(pod.node_name)
             elif self._responsible_for(pod):
                 self._first_queued.setdefault(key, time.monotonic())
                 self.queue.add(dataclasses.replace(pod))
@@ -461,10 +726,13 @@ class Scheduler:
             # foreign scheduler); our own binds already harvested it
             self.cache.add_pod(pod)  # confirms our assume, or records a
             # foreign scheduler's bind (cache.go:214)
+            self.engine.note_node_dirty(pod.node_name)
         elif was_bound and pod.node_name:
             self.cache.update_pod(prev, pod)
+            self.engine.note_node_dirty(prev.node_name, pod.node_name)
         elif was_bound and not pod.node_name:
             self.cache.remove_pod(prev)
+            self.engine.note_node_dirty(prev.node_name)
             if self._responsible_for(pod):
                 self._first_queued.setdefault(key, time.monotonic())
                 self.queue.add(dataclasses.replace(pod))
@@ -480,10 +748,15 @@ class Scheduler:
         confirmation are preserved by re-adding only confirmed state."""
         self.cache = SchedulerCache(ttl_seconds=self.cache._ttl, now=self._now)
         self._workloads = {}
+        pad_floor = self.engine.wave_pad_floor  # a live _DrainPipeline's
+        # compiled-shape pin must survive the engine swap, or every ragged
+        # arrival pop after a relist mints a fresh XLA compile
         self.engine = SchedulingEngine(
             self.cache, priorities=self.engine.priorities,
             workloads_provider=lambda: list(self._workloads.values()),
             policy_algos=self._policy_algos)
+        self.engine.track_dirty = True
+        self.engine.wave_pad_floor = pad_floor
         self.queue = SchedulingQueue(now=self._now)
         self._pods = {}
         self._gang_waiting = {}
@@ -503,3 +776,97 @@ class Scheduler:
         if not self.record_events:
             return
         self.events.append(Event(pod.key(), reason, message, etype))
+
+
+class _DrainPipeline:
+    """The two-stage drain of ISSUE 2: each step pops one chunk, launches
+    its fused wave eval via JAX async dispatch (encode + waves_loop, no
+    device→host sync), then harvests the PREVIOUS chunk — assume/bind/
+    watch-drain of wave k overlap the device time of wave k+1. Correctness
+    rides the harvest fence (engine.harvest_waves): wave k+1 was encoded
+    against the pre-k snapshot, so its placements re-validate against
+    post-k occupancy and capacity losers requeue.
+
+    overlap=False executes the SAME dataflow with the device forced to
+    finish before the host tail — bit-identical placements, no overlap —
+    the sequential debug mode the A/B fence test pins."""
+
+    def __init__(self, sched: Scheduler, chunk: int, overlap: bool):
+        self.sched = sched
+        self.chunk = max(int(chunk), 1)
+        self.overlap = overlap
+        self.inflight = None
+        self._pending: Dict[str, int] = {}  # stats from interrupt flushes
+        sched._pipeline = self
+        # one compiled wave shape per drain: ragged arrival pops pad up to
+        # the chunk bucket instead of compiling per power-of-2 size
+        sched.engine.wave_pad_floor = self.chunk
+
+    @property
+    def idle(self) -> bool:
+        return self.inflight is None
+
+    def flush(self) -> None:
+        """Harvest the in-flight wave NOW (watch-event interrupt, classic-
+        path barrier, shutdown). Its stats fold into the next step."""
+        h, self.inflight = self.inflight, None
+        if h is not None:
+            for k, v in self.sched._complete_wave(h).items():
+                self._pending[k] = self._pending.get(k, 0) + v
+
+    def step(self, wait: float = 0.0) -> Dict[str, int]:
+        s = self.sched
+        stats = {"popped": 0, "bound": 0, "unschedulable": 0,
+                 "bind_errors": 0, "preemptions": 0, "fence_requeued": 0}
+        s.sync()  # columnar; node/volume events flush the pipeline first
+        pods = s.queue.pop_batch(max_n=self.chunk, wait=wait)
+        stats["popped"] = len(pods)
+        handle = None
+        if not pods:
+            # parked-gang sweep on empty steps only: a pod-ful step either
+            # takes the wave path (no gang members by eligibility) and
+            # sweeps below, or falls back to _process_batch which runs the
+            # arrival-exempt sweep itself
+            s._sweep_parked_gangs(())
+        if pods:
+            pop_ts = time.monotonic()
+            if s._wave_eligible(pods):
+                handle = s.engine.dispatch_waves(pods, pop_ts)
+            if handle is None:
+                # chunk needs the strict/oracle machinery (gangs, affinity,
+                # host-check classes, policy): drain the pipeline so the
+                # synchronous path sees every commit, then run it classic
+                self.flush()
+                sub = s._process_batch(pods, pop_ts)
+                sub["popped"] = 0  # already counted
+                for k, v in sub.items():
+                    stats[k] = stats.get(k, 0) + v
+            elif not self.overlap:
+                # sequential mode: forfeit the overlap only. The span is
+                # the profiler's measure of RAW per-wave device time (no
+                # host work runs between dispatch and this block)
+                from kubernetes_tpu.utils.trace import timed_span
+                with timed_span("pipeline.device_sync"):
+                    handle.block()
+            if handle is not None:
+                s._sweep_parked_gangs(())  # wave chunks carry no gang pods
+        prev, self.inflight = self.inflight, handle
+        if prev is not None:
+            for k, v in s._complete_wave(prev).items():
+                stats[k] = stats.get(k, 0) + v
+        if self._pending:
+            for k, v in self._pending.items():
+                stats[k] = stats.get(k, 0) + v
+            self._pending = {}
+        if not pods:
+            s._idle_gc()
+        return stats
+
+    def close(self) -> Dict[str, int]:
+        """Drain the in-flight wave and detach from the scheduler; returns
+        any stats not yet reported through step()."""
+        self.flush()
+        out, self._pending = self._pending, {}
+        if self.sched._pipeline is self:
+            self.sched._pipeline = None
+        return out

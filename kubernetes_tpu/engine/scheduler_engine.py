@@ -18,6 +18,16 @@ take the exact object-level oracle path against the updated cache — the
 
 Device arrays are cached keyed on snapshot.version so an unchanged cluster
 uploads nothing between batches.
+
+The pipelined drain rides the dispatch_waves / harvest_waves pair instead
+of schedule(): dispatch encodes a chunk (vocab_gen-keyed encoding reuse),
+launches waves_loop WITHOUT the device→host sync, and returns a WaveHandle;
+harvest blocks on the handle, re-validates the blind wave's placements
+against current occupancy (the capacity fence), assumes survivors columnar
+(grouped per node+class, folded into the snapshot via raw-delta math), and
+hands conflicts back for requeue. schedule() remains the synchronous path
+for everything the wave engine can't take (pod affinity, host-check
+classes, Policy algorithms).
 """
 
 from __future__ import annotations
@@ -36,7 +46,11 @@ from kubernetes_tpu.ops import priorities as prio
 from kubernetes_tpu.ops.predicates import bucket
 from kubernetes_tpu.state.cache import SchedulerCache
 from kubernetes_tpu.state.classes import ClassBatch
-from kubernetes_tpu.state.snapshot import ClusterSnapshot
+from kubernetes_tpu.state.snapshot import (
+    ClusterSnapshot,
+    R_OVERLAY,
+    R_SCRATCH,
+)
 
 
 class EvalCache:
@@ -530,6 +544,89 @@ def _eval_dispatch(pod, infos, snap, priorities, workloads, hard_weight,
     return m, s
 
 
+class _WaveEncoding:
+    """Device-resident class encoding reused across pipelined drain chunks.
+
+    A 30k-pod storm arrives as ~8 pipelined chunks of the SAME handful of
+    spec classes; re-running ClassBatch/PodBatch per chunk would re-pay the
+    tensorization the equivalence classes exist to amortize. This caches the
+    padded device class arrays keyed on snapshot.vocab_gen (capacity deltas
+    never invalidate an encoding — only vocab growth / node-membership moves
+    do, same keying as the extender's affinity-free fast lane) plus the host
+    rows the harvest fence reads."""
+
+    __slots__ = ("vocab_gen", "key_index", "reps", "cls_arr", "num_classes",
+                 "c_pad", "req_rows", "special", "derived", "ports_max",
+                 "raw_rows", "delta_ok")
+
+    def __init__(self, vocab_gen, key_index, reps, cls_arr, num_classes,
+                 c_pad, req_rows, special, derived, ports_max):
+        self.vocab_gen = vocab_gen
+        self.key_index = key_index
+        self.reps = reps
+        self.cls_arr = cls_arr
+        self.num_classes = num_classes
+        self.c_pad = c_pad
+        self.req_rows = req_rows      # [C, R] int64, snapshot-quantized
+        self.special = special        # [C] bool: ports/volumes classes
+        self.derived = derived        # per-class (Resource, ncpu, nmem, ports)
+        self.ports_max = ports_max    # highest requested host port, or -1
+        # raw int64 per-class delta rows (requested cpu/mem/gpu/scratch/
+        # overlay + nonzero cpu/mem) for snapshot.apply_assume_delta, and
+        # which classes qualify for it (no ports/volumes/extended — those
+        # touch more than the seven raw columns)
+        self.raw_rows = np.empty((num_classes, 7), dtype=np.int64)
+        self.delta_ok = np.empty(num_classes, dtype=bool)
+        for c, (req, ncpu, nmem, ports) in enumerate(derived):
+            self.raw_rows[c] = (req.milli_cpu, req.memory, req.nvidia_gpu,
+                                req.storage_scratch, req.storage_overlay,
+                                ncpu, nmem)
+            self.delta_ok[c] = not (ports or req.extended or special[c])
+
+
+class WaveHandle:
+    """One in-flight pipelined wave: the un-fetched device result plus
+    everything the harvest fence needs. Holding this without calling
+    np.asarray on `packed` is the whole point — the device computes while
+    the host does the previous wave's bookkeeping."""
+
+    __slots__ = ("pods", "pc", "enc", "packed", "state_out", "counter_out",
+                 "nodes", "blind", "pop_ts", "dispatch_ts", "pad_floor")
+
+    def __init__(self, pods, pc, enc, packed, state_out, counter_out, nodes,
+                 blind, pop_ts, dispatch_ts, pad_floor=0):
+        self.pad_floor = pad_floor
+        self.pods = pods
+        self.pc = pc                  # host int32 [n] class index per pod
+        self.enc = enc
+        self.packed = packed          # device [3P+2] (see waves_loop)
+        self.state_out = state_out    # device NodeState after the waves
+        self.counter_out = counter_out  # device uint32 RR counter
+        self.nodes = nodes            # device node arrays at dispatch time
+        self.blind = blind            # node NAMES mutated since dispatch
+        self.pop_ts = pop_ts
+        self.dispatch_ts = dispatch_ts
+
+    def block(self) -> None:
+        """Force device completion now (sequential/debug mode): the values
+        are identical whenever fetched; only the overlap is forfeited."""
+        self.packed.block_until_ready()
+
+
+class WaveHarvest:
+    """Fenced result of one wave: pods to bind (node_name set, already
+    assumed), fence conflicts to requeue WITHOUT backoff (a capacity race
+    with the blind wave, not unschedulability), and unschedulable pods."""
+
+    __slots__ = ("bound", "conflicts", "unschedulable", "t_block")
+
+    def __init__(self, bound, conflicts, unschedulable, t_block):
+        self.bound = bound
+        self.conflicts = conflicts
+        self.unschedulable = unschedulable
+        self.t_block = t_block
+
+
 class SchedulingEngine:
     def __init__(self, cache: SchedulerCache,
                  priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
@@ -554,6 +651,25 @@ class SchedulingEngine:
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self._device_nodes = None
         self._device_version = -1
+        # targeted-refresh bookkeeping: when the OWNER (one Scheduler that
+        # routes every cache mutation through note_node_dirty/
+        # note_full_refresh) sets track_dirty, _refresh() passes the dirty
+        # node set as snapshot.refresh's changed_hint instead of walking all
+        # N generation counters per round. Default off: a bare engine whose
+        # cache is mutated behind its back (tests, ad-hoc callers) cannot
+        # uphold the hint's assertion.
+        self.track_dirty = False
+        self._pending_dirty: set = set()
+        self._need_full_refresh = True
+        # pipelined-drain state (dispatch_waves/harvest_waves)
+        self._wave_enc = None
+        self._rr_chain = None  # device RR counter chaining between waves
+        self._blind_listeners: List[set] = []  # per-inflight-wave touch sets
+        # pod-axis padding floor for dispatch_waves: the pipeline pins this
+        # to its chunk size so an arrival stream's ragged pops (345, 589,
+        # 100, ...) all reuse ONE compiled wave shape instead of paying a
+        # multi-second XLA compile per fresh power-of-2 bucket mid-stream
+        self.wave_pad_floor = 0
 
     # ------------------------------------------------------------------ api
 
@@ -570,8 +686,7 @@ class SchedulingEngine:
         """
         if not pods:
             return []
-        infos = self.cache.node_infos()
-        self.snapshot.refresh(infos, volume_ctx=self.volume_ctx)
+        infos = self._refresh()
         from kubernetes_tpu.ops.affinity import AffinityData, \
             collect_pod_pairs, intern_topology_pairs
         all_pairs, aff_pairs = collect_pod_pairs(infos)
@@ -725,6 +840,7 @@ class SchedulingEngine:
                                       *rep.nonzero_request(),
                                       rep.used_ports())
                 self.cache.assume_pods_bulk(placements, derived)
+                self._touch(p.node_name for p, _ in placements)
 
         # exact host path for over-approximated pods, AFTER device placements
         # so they see committed capacity (FIFO order within themselves)
@@ -815,6 +931,46 @@ class SchedulingEngine:
     def _assume(self, pod: Pod, node_name: str) -> None:
         pod.node_name = node_name
         self.cache.assume_pod(pod)
+        self._touch((node_name,))
+
+    # ------------------------------------------------- targeted refresh
+
+    def _touch(self, node_names) -> None:
+        """Record cache mutations for BOTH consumers: the targeted-refresh
+        dirty set (cleared each refresh) and any in-flight wave's blind set
+        (cleared at that wave's harvest — its fence must re-validate
+        against exactly these nodes)."""
+        if self.track_dirty or self._blind_listeners:
+            names = list(node_names)
+            if self.track_dirty:
+                self._pending_dirty.update(names)
+            for s in self._blind_listeners:
+                s.update(names)
+
+    def note_node_dirty(self, *node_names: str) -> None:
+        """The owner observed a cache mutation touching these nodes (watch
+        event applied, bind forgotten)."""
+        self._touch(node_names)
+
+    def note_full_refresh(self) -> None:
+        """The owner cannot name what changed (node membership/spec moved,
+        assumed-pod TTL expiry) — the next refresh walks everything."""
+        self._need_full_refresh = True
+
+    def _refresh(self) -> Dict[str, object]:
+        """Snapshot refresh with the targeted-hint fast path when the owner
+        tracks dirt (ISSUE 2: the batch drain's analog of the extender's
+        per-bind changed_hint). Returns the infos map."""
+        infos = self.cache.node_infos()
+        hint = None
+        if self.track_dirty and not self._need_full_refresh \
+                and self.snapshot._shape_sig is not None:
+            hint = sorted(self._pending_dirty)
+        self.snapshot.refresh(infos, volume_ctx=self.volume_ctx,
+                              changed_hint=hint)
+        self._pending_dirty.clear()
+        self._need_full_refresh = False
+        return infos
 
     _NODE_ARRAY_KEYS = ("alloc", "requested", "nonzero", "pod_count",
                         "allowed_pods", "schedulable", "mem_pressure",
@@ -844,7 +1000,13 @@ class SchedulingEngine:
                 host = getattr(snap, k)
             cur = self._device_nodes.get(k)
             if cur is None or cur.shape != host.shape or k in snap.dirty:
-                self._device_nodes[k] = jnp.asarray(
+                # jnp.array, NOT jnp.asarray: the CPU backend ZERO-COPIES
+                # aligned numpy buffers, and these snapshot arrays are
+                # mutated in place (refresh deltas, apply_assume_delta)
+                # while a pipelined wave may still be executing against
+                # them asynchronously — an alias here is a data race that
+                # shows up as placement flakes under load
+                self._device_nodes[k] = jnp.array(
                     np.ascontiguousarray(host) if k == "port_bitmap" else host)
                 uploaded += 1
         if uploaded:
@@ -853,3 +1015,282 @@ class SchedulingEngine:
         snap.dirty.clear()
         self._device_version = snap.version
         return self._device_nodes
+
+    # ------------------------------------------------- pipelined drain
+
+    def _kernel_priorities(self) -> Tuple[Tuple[str, int], ...]:
+        return tuple((nm, w) for nm, w in self.priorities
+                     if nm not in prio.AFFINITY_PRIORITIES)
+
+    def _wave_encoding(self, pods: Sequence[Pod]):
+        """(encoding, pod_class[n]) for a pipeline chunk, via the vocab_gen-
+        keyed reuse cache; None when any class is not wave-eligible (pod
+        (anti-)affinity or host-check routing — those chunks take the
+        classic synchronous path)."""
+        import dataclasses as _dc
+
+        from kubernetes_tpu.ops.affinity import _has_affinity
+        from kubernetes_tpu.ops.predicates import pod_arrays_padded
+        from kubernetes_tpu.state.classes import pod_class_key
+        from kubernetes_tpu.utils.trace import COUNTERS
+
+        snap = self.snapshot
+        enc = self._wave_enc
+        if enc is not None and enc.vocab_gen == snap.vocab_gen:
+            key_index = enc.key_index
+            pc = np.empty(len(pods), dtype=np.int32)
+            hit = True
+            for i, p in enumerate(pods):
+                c = key_index.get(pod_class_key(p), -1)
+                if c < 0:
+                    hit = False
+                    break
+                pc[i] = c
+            if hit:
+                COUNTERS.inc("engine.wave_encode_reuse")
+                return enc, pc
+        # rebuild over the union with the cached reps so chunks alternating
+        # between two class sets don't thrash the cache
+        seed: List[Pod] = []
+        if enc is not None and enc.vocab_gen == snap.vocab_gen:
+            seed = enc.reps
+        batch = ClassBatch(seed + list(pods), snap)
+        n_cls = batch.num_classes
+        if any(_has_affinity(p) for p in batch.reps):
+            return None
+        rb = batch.reps_batch
+        if rb.needs_host_check[:n_cls].any():
+            return None
+        COUNTERS.inc("engine.wave_encode_build")
+        c_pad = bucket(n_cls + 1)
+        cls_arr = pod_arrays_padded(rb, c_pad)
+        key_index = {pod_class_key(rep): c
+                     for c, rep in enumerate(batch.reps)}
+        special = ((rb.ports[:n_cls, 0] >= 0)
+                   | (rb.vol_hard[:n_cls].sum(axis=1)
+                      + rb.vol_ro[:n_cls].sum(axis=1)
+                      + rb.pd_req[:n_cls].sum(axis=1) > 0))
+        derived = [(rep.resource_request(), *rep.nonzero_request(),
+                    rep.used_ports()) for rep in batch.reps]
+        ports_max = int(rb.ports.max()) if np.any(rb.ports >= 0) else -1
+        # clone the reps for reuse: the originals get node_name assigned at
+        # assume time, which would corrupt their class key as seeds
+        reps = [_dc.replace(p) for p in batch.reps]
+        self._wave_enc = _WaveEncoding(
+            snap.vocab_gen, key_index, reps, cls_arr, n_cls, c_pad,
+            rb.req[:n_cls].astype(np.int64), special, derived, ports_max)
+        return self._wave_enc, batch.pod_class[len(seed):].copy()
+
+    def dispatch_waves(self, pods: Sequence[Pod],
+                       pop_ts: float = 0.0) -> Optional[WaveHandle]:
+        """Encode a chunk and launch its wave placement WITHOUT blocking —
+        the device computes while the caller does the previous wave's
+        bookkeeping (JAX async dispatch). The chunk is evaluated against the
+        snapshot as of NOW, which is blind to the still-unharvested wave's
+        commits; harvest_waves' fence re-validates. Returns None when the
+        chunk needs the classic path (policy algorithms, workloads/spreading,
+        any pod affinity in cluster or chunk, host-check classes) — the
+        caller must then flush the pipeline and run the synchronous engine."""
+        import time as _time
+
+        from kubernetes_tpu.utils.trace import COUNTERS, timed_span
+
+        if not pods:
+            return None
+        if self.policy_algos is not None and self.policy_algos.active:
+            return None
+        if self.workloads_provider():
+            return None
+        with timed_span("pipeline.dispatch"):
+            infos = self._refresh()
+            for info in infos.values():
+                if info.pods_with_affinity:
+                    return None
+            out = self._wave_encoding(pods)
+            if out is None:
+                return None
+            enc, pc = out
+            n = len(pods)
+            p_pad = bucket(max(n, self.wave_pad_floor or 1))
+            pc_pad = np.full(p_pad, enc.num_classes, dtype=np.int32)
+            pc_pad[:n] = pc
+            max_words = self.snapshot.port_words_used()
+            if enc.ports_max >= 0:
+                max_words = max(max_words, enc.ports_max // 32 + 1)
+            port_words = bucket(max(max_words, 1), lo=1)
+            nodes = dict(self._nodes_on_device(port_words=port_words))
+            state = NodeState(nodes["requested"], nodes["nonzero"],
+                              nodes["pod_count"], nodes["port_bitmap"],
+                              nodes["vol_present"], nodes["vol_rw"],
+                              nodes["pd_present"], nodes["pd_counts"])
+            counter = self._rr_chain if self._rr_chain is not None \
+                else jnp.uint32(self.rr.counter)
+            packed, state_out = waves.waves_loop(
+                enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
+                self._kernel_priorities(), 64)
+            counter_out = packed[3 * p_pad].astype(jnp.uint32)
+            self._rr_chain = counter_out
+            blind: set = set()
+            self._blind_listeners.append(blind)
+            COUNTERS.inc("engine.wave_dispatch")
+            return WaveHandle(list(pods), pc, enc, packed, state_out,
+                              counter_out, nodes, blind, pop_ts,
+                              _time.monotonic(), self.wave_pad_floor)
+
+    def harvest_waves(self, handle: WaveHandle) -> WaveHarvest:
+        """Block on one wave's device→host sync, fence its placements
+        against post-blind-window occupancy, and assume the survivors
+        (columnar). The fence is exact for resources and pod count (the
+        snapshot is re-refreshed here, so it reflects every commit and
+        watch event the device did not see); port/volume classes requeue
+        conservatively when their node was touched in the blind window.
+        Conflicting pods are returned for requeue WITHOUT backoff — they
+        lost a capacity race, they are not unschedulable."""
+        import time as _time
+
+        from kubernetes_tpu.utils.trace import timed_span
+
+        # the fence below compares against snapshot arrays — fold in any
+        # commits/events since the last dispatch (hinted: near-free when
+        # nothing moved)
+        self._refresh()
+        enc = handle.enc
+        snap = self.snapshot
+        n = len(handle.pods)
+        p_pad = bucket(max(n, handle.pad_floor or 1))
+        t0 = _time.perf_counter()
+        with timed_span("pipeline.device_block"):
+            packed_h = np.asarray(handle.packed)
+        t_block = _time.perf_counter() - t0
+        sel = packed_h[:n].copy()
+        fc = packed_h[p_pad:p_pad + n].copy()
+        act = packed_h[2 * p_pad:2 * p_pad + n].astype(bool)
+        counter_h = int(np.uint32(packed_h[3 * p_pad]))
+        if act.any():
+            # pathological interleaving exhausted max_waves — finish the
+            # stragglers via the strict scan against the wave's final device
+            # state (same fallback as waves.place_waves). The straggler RR
+            # draws land after the next wave's (already-chained) counter —
+            # deterministic in both pipelined and sequential modes, since
+            # dispatch k+1 always precedes harvest k in either.
+            idx = np.nonzero(act)[0]
+            n_strag = len(idx)
+            pcs = np.full(bucket(n_strag), enc.num_classes, dtype=np.int32)
+            pcs[:n_strag] = handle.pc[idx]
+            sel_s, fc_s, _st, rr_d = gather_place_batch(
+                enc.cls_arr, jnp.asarray(pcs), handle.nodes,
+                handle.state_out, jnp.uint32(counter_h),
+                self._kernel_priorities())
+            sel[idx] = np.asarray(sel_s)[:n_strag]
+            fc[idx] = np.asarray(fc_s)[:n_strag]
+            counter_h = int(rr_d)
+        if self._rr_chain is handle.counter_out:
+            self._rr_chain = None
+        self.rr.counter = counter_h
+        self._blind_listeners.remove(handle.blind)
+
+        pods = handle.pods
+        unschedulable = [(pods[i], int(fc[i]))
+                         for i in np.nonzero(sel < 0)[0].tolist()]
+        bound: List[Pod] = []
+        conflicts: List[Pod] = []
+        placed_idx = np.nonzero(sel >= 0)[0]
+        if placed_idx.size:
+            with timed_span("pipeline.fence"):
+                acc_idx, acc_node, acc_cls, conflict_idx = \
+                    self._fence(handle, sel, placed_idx)
+            conflicts = [pods[i] for i in conflict_idx]
+            if acc_idx.size:
+                names = snap.node_names
+                groups = []
+                acc_l = acc_idx.tolist()
+                node_l = acc_node.tolist()
+                cls_l = acc_cls.tolist()
+                change = np.nonzero((acc_node[1:] != acc_node[:-1])
+                                    | (acc_cls[1:] != acc_cls[:-1]))[0] + 1
+                bounds = [0] + change.tolist() + [len(acc_l)]
+                with timed_span("pipeline.assume"):
+                    for b0, b1 in zip(bounds[:-1], bounds[1:]):
+                        name = names[node_l[b0]]
+                        run = [pods[i] for i in acc_l[b0:b1]]
+                        for p in run:
+                            p.node_name = name
+                        groups.append((name, run) + enc.derived[cls_l[b0]])
+                    infos_touched = self.cache.assume_pods_grouped(groups)
+                    # fold the assumes into the snapshot WITHOUT a node
+                    # walk: classes with pure base-resource footprints go
+                    # through the exact raw-delta path (generation synced
+                    # so the next refresh skips these nodes); the rest take
+                    # the normal dirty-note rewrite
+                    dok = enc.delta_ok[acc_cls]
+                    dirty_names = {names[i] for i in
+                                   set(acc_node[~dok].tolist())}
+                    if dok.any():
+                        snap.apply_assume_delta(
+                            acc_node[dok], enc.raw_rows[acc_cls[dok]],
+                            [(nm, info) for nm, info in
+                             infos_touched.items()
+                             if nm not in dirty_names])
+                    if dirty_names:
+                        self._touch(dirty_names)
+                    blind_names = [nm for nm in infos_touched
+                                   if nm not in dirty_names]
+                    for s in self._blind_listeners:
+                        s.update(blind_names)
+                bound = [pods[i] for i in sorted(acc_l)]
+        return WaveHarvest(bound, conflicts, unschedulable, t_block)
+
+    def _fence(self, handle: WaveHandle, sel: np.ndarray,
+               placed_idx: np.ndarray):
+        """Vectorized re-validation of a blind wave's placements against
+        current occupancy. Returns (accepted original indices grouped by
+        (node, class) with FIFO order inside each node, their node indices,
+        their class indices, conflict original indices in FIFO order)."""
+        snap = self.snapshot
+        enc = handle.enc
+        node_of = sel[placed_idx]
+        order = np.argsort(node_of, kind="stable")
+        gidx = placed_idx[order]
+        gnode = node_of[order]
+        m = len(gidx)
+        seg_start = np.empty(m, dtype=bool)
+        seg_start[0] = True
+        seg_start[1:] = gnode[1:] != gnode[:-1]
+        starts = np.nonzero(seg_start)[0]
+        grp = np.cumsum(seg_start) - 1
+        rank = np.arange(m) - starts[grp]
+        cls_rows = handle.pc[gidx]
+        req = enc.req_rows[cls_rows]                      # [m, R] int64
+        csum = np.cumsum(req, axis=0)
+        prefix = csum - (csum[starts] - req[starts])[grp]  # incl., per node
+        alloc = snap.alloc[gnode].astype(np.int64)
+        used = snap.requested[gnode].astype(np.int64)
+        avail = alloc - used
+        ncols = alloc.shape[1]
+        plain = [c for c in range(ncols) if c not in (R_SCRATCH, R_OVERLAY)]
+        ok = (prefix[:, plain] <= avail[:, plain]).all(axis=1)
+        # storage fallback (predicates.go:590-604): overlay-less nodes charge
+        # overlay requests against scratch
+        no_ov = alloc[:, R_OVERLAY] == 0
+        scr_pref = prefix[:, R_SCRATCH] + np.where(no_ov,
+                                                   prefix[:, R_OVERLAY], 0)
+        scr_avail = avail[:, R_SCRATCH] - np.where(no_ov,
+                                                   used[:, R_OVERLAY], 0)
+        ok &= scr_pref <= scr_avail
+        ok &= no_ov | (prefix[:, R_OVERLAY] <= avail[:, R_OVERLAY])
+        ok &= (snap.pod_count[gnode].astype(np.int64) + rank + 1
+               <= snap.allowed_pods[gnode])
+        spc = enc.special[cls_rows]
+        if spc.any() and handle.blind:
+            # ports/volume predicates are per-object host state — exact
+            # vector re-check is not worth it for these rare classes; a
+            # touched node in the blind window requeues them conservatively
+            bl = np.zeros(snap.valid.shape[0], dtype=bool)
+            idx_map = snap.node_index
+            for nm in handle.blind:
+                i = idx_map.get(nm, -1)
+                if i >= 0:
+                    bl[i] = True
+            ok &= ~(spc & bl[gnode])
+        return (gidx[ok], gnode[ok], cls_rows[ok],
+                sorted(gidx[~ok].tolist()))

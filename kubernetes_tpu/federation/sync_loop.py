@@ -19,14 +19,20 @@ the SAME sync bodies into the repo's informer/workqueue machinery:
   child) self-heals from the member's own watch stream;
 - one deduplicating WorkQueue carries the keys; pump() drains it through
   the per-type sync bodies (per-object for the replica-planned kinds,
-  per-kind for the propagation kinds whose body is whole-kind).
+  per-kind for the propagation kinds whose body is whole-kind);
+- start() runs the same loop on a background worker thread (the
+  controller-manager's `go wait.Until(worker, ...)`) so a live deployment
+  needs NO caller-side pumping: cluster-loss rebalance happens from the
+  watch event alone. pump() remains the deterministic single-threaded
+  test hook.
 
 No caller ever needs sync_all(): cluster-loss rebalance happens from the
 watch event alone (tests/test_federation_watch.py)."""
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import threading
+from typing import Dict, Optional, Tuple
 
 from kubernetes_tpu.client.informer import SharedInformerFactory
 from kubernetes_tpu.client.workqueue import WorkQueue
@@ -63,6 +69,10 @@ class FederationSyncLoop:
     def __init__(self, plane: FederationControlPlane):
         self.plane = plane
         self.queue = WorkQueue()
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._pump_lock = threading.Lock()  # worker and test-hook pump()
+        # share one body; serialized so sync bodies never interleave
         self.rs_ctrl = FederatedReplicaSetController(plane)
         self.deploy_ctrl = FederatedDeploymentController(plane)
         self.ds_ctrl = FederatedDaemonSetController(plane)
@@ -182,21 +192,68 @@ class FederationSyncLoop:
     def pump(self, rounds: int = 1) -> int:
         """Deterministic single-threaded loop: step every informer (watch
         events fire the handlers above), then drain the queue through the
-        sync bodies. Returns syncs performed."""
+        sync bodies. Returns syncs performed. This is the TEST hook; a live
+        deployment runs the same body on the start() worker thread."""
         n = 0
-        for _ in range(rounds):
-            self._fed_factory.step_all()
-            for factory in list(self._member_factories.values()):
-                factory.step_all()
-            while len(self.queue):
-                try:
-                    key = self.queue.get(timeout=0)
-                except Exception:
-                    break
-                try:
-                    self._sync_key(key)
-                    self.syncs += 1
-                    n += 1
-                finally:
-                    self.queue.done(key)
+        with self._pump_lock:
+            for _ in range(rounds):
+                self._fed_factory.step_all()
+                for factory in list(self._member_factories.values()):
+                    factory.step_all()
+                while len(self.queue):
+                    try:
+                        key = self.queue.get(timeout=0)
+                    except Exception:
+                        break
+                    try:
+                        self._sync_key(key)
+                        self.syncs += 1
+                        n += 1
+                    finally:
+                        self.queue.done(key)
         return n
+
+    # -------------------------------------------------- background worker
+
+    def start(self, interval_s: float = 0.05) -> None:
+        """Run the pump on a daemon worker thread (the reference's
+        controller-manager workers, federated sync controller's
+        `go wait.Until`): watch events drain into syncs continuously with
+        no caller-side pump(rounds) — cluster-loss rebalance, member-drift
+        self-heal, and deletion propagation all happen on their own.
+        Idempotent while running; a restart after stop() always yields a
+        live worker, even if the previous one is still wedged in a hung
+        sync body (each worker watches its OWN stop token, so the orphan
+        exits when it unwedges and can never be revived; overlap is
+        serialized by _pump_lock)."""
+        if self._worker is not None and self._worker.is_alive() \
+                and not self._stop.is_set():
+            return  # already running
+        stop = threading.Event()
+        self._stop = stop
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.pump(1)
+                except Exception:  # a sync body failing on transient state
+                    # (member Conflict, mid-churn NotFound) must not kill
+                    # the worker — the queue re-delivers on the next event
+                    # or full reconcile, like a crashing controller worker
+                    # being restarted by wait.Until
+                    continue
+
+        self._worker = threading.Thread(target=loop, daemon=True,
+                                        name="federation-sync-worker")
+        self._worker.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=timeout)
+            if self._worker.is_alive():
+                # wedged in a hung sync body: keep the handle (its own stop
+                # token is set, so it exits when it unwedges; start() will
+                # create a fresh worker with a fresh token)
+                return
+            self._worker = None

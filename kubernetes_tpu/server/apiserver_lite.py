@@ -150,45 +150,63 @@ class ApiServerLite:
         """Batch of /binding POSTs under one lock acquisition (the scheduler
         issues one per placement; semantics per binding are identical to
         bind()). Returns one entry per binding: None on success, else the
-        error string ('conflict: ...' / 'not found: ...').
+        error string ('conflict: ...' / 'not found: ...')."""
+        return self._bind_batch((b.pod_namespace, b.pod_name, b.node_name)
+                                for b in bindings)
 
-        The happy path is inlined (no per-binding call/exception machinery,
-        one notify + one log trim for the whole batch) — this is the 30k-pod
-        storm's write burst, the analog of etcd3 txn batching."""
+    def bind_pods_bulk(self, pods: List[Pod]) -> List[Optional[str]]:
+        """bind_many over already-placed Pod objects (pod.node_name = the
+        chosen node): the columnar drain path reads the identifiers straight
+        off the pods instead of minting one Binding per placement. Error
+        strings and per-binding semantics identical to bind_many."""
+        return self._bind_batch((p.namespace, p.name, p.node_name)
+                                for p in pods)
+
+    def _bind_batch(self, triples) -> List[Optional[str]]:
+        """Shared body of bind_many/bind_pods_bulk over (namespace, name,
+        node_name) triples. The happy path is inlined (no per-binding call/
+        exception machinery, one notify + one log trim for the whole batch)
+        — this is the 30k-pod storm's write burst, the analog of etcd3 txn
+        batching."""
         out: List[Optional[str]] = []
+        append = out.append
         with self._lock:
             objects = self._objects
+            objects_get = objects.get
             log = self._log
+            log_append = log.append
+            durable = self._durable
+            mk = object.__new__
+            ev = WatchEvent
             rv = self._rv
             try:
-                for b in bindings:
-                    key = ("Pod", b.pod_namespace, b.pod_name)
-                    pod = objects.get(key)
+                for ns, name, node_name in triples:
+                    key = ("Pod", ns, name)
+                    pod = objects_get(key)
                     if pod is None:
-                        out.append(
-                            f"not found: pod {b.pod_namespace}/{b.pod_name}")
+                        append(f"not found: pod {ns}/{name}")
                         continue
                     if pod.node_name:
-                        out.append(f"conflict: pod {pod.key()} is already "
-                                   f"assigned to node {pod.node_name}")
+                        append(f"conflict: pod {pod.key()} is already "
+                               f"assigned to node {pod.node_name}")
                         continue
-                    new = object.__new__(Pod)
+                    new = mk(Pod)
                     new.__dict__.update(pod.__dict__)
-                    new.node_name = b.node_name
+                    new.node_name = node_name
                     rv += 1
                     new.resource_version = rv
                     objects[key] = new
-                    log.append(WatchEvent("MODIFIED", "Pod", new, rv))
-                    if self._durable is not None:
-                        self._durable.put(key, new, rv)
-                    out.append(None)
+                    log_append(ev("MODIFIED", "Pod", new, rv))
+                    if durable is not None:
+                        durable.put(key, new, rv)
+                    append(None)
             finally:
                 # even if a durable append raises mid-batch, rv must cover
                 # every binding already applied to objects/log — reissuing
                 # an rv would break the log's bisect-by-rv invariant
                 self._rv = rv
-            if self._durable is not None:
-                self._durable.flush()
+            if durable is not None:
+                durable.flush()
                 self._maybe_compact()
             if len(log) > self._max_log:
                 drop = len(log) - self._max_log

@@ -83,6 +83,63 @@ class SchedulerCache:
                 st.assumed = True
                 self._pod_states[key] = st
 
+    def assume_pods_grouped(self, groups) -> Dict[str, NodeInfo]:
+        """AssumePod for a whole wave under one lock, columnar: groups =
+        [(node_name, pods, req, ncpu, nmem, ports)] where each entry is a
+        run of spec-equal pods (pod.node_name already set) headed to ONE
+        node. One scaled NodeInfo update per (node, class) group instead of
+        one object walk per pod — semantics identical to assume_pod per
+        pod, in group order. Returns the touched NodeInfos by name so the
+        caller can sync snapshot generation bookkeeping."""
+        touched: Dict[str, NodeInfo] = {}
+        with self._lock:
+            states = self._pod_states
+            nodes_get = self._nodes.get
+            mk = _PodState
+            for node_name, pods, req, ncpu, nmem, ports in groups:
+                info = nodes_get(node_name)
+                if info is None:
+                    info = NodeInfo()
+                    self._nodes[node_name] = info
+                info.add_pods_same_class(pods, req, ncpu, nmem, ports)
+                touched[node_name] = info
+                for pod in pods:
+                    key = pod.key()
+                    if key in states:
+                        raise KeyError(f"pod {key} is already in the cache")
+                    st = mk(pod)
+                    st.assumed = True
+                    states[key] = st
+        return touched
+
+    def add_pods_bulk(self, pods: List[Pod]) -> List[str]:
+        """Informer-confirmed adds for a batch under ONE lock (the columnar
+        watch drain of a bind storm). Per-pod semantics identical to
+        add_pod(); returns the names of nodes whose NodeInfo was MUTATED
+        (confirming our own assume on the same node mutates nothing — the
+        common case — so the caller's targeted-refresh hint stays empty on
+        a pure confirmation stream)."""
+        touched: List[str] = []
+        with self._lock:
+            states = self._pod_states
+            for pod in pods:
+                key = pod.key()
+                st = states.get(key)
+                if st is not None and st.assumed:
+                    if st.pod.node_name != pod.node_name:
+                        self._remove_pod_locked(st.pod)
+                        self._add_pod_locked(pod)
+                        touched.append(st.pod.node_name)
+                        touched.append(pod.node_name)
+                    st.pod = pod
+                    st.assumed = False
+                    st.deadline = None
+                elif st is None:
+                    self._add_pod_locked(pod)
+                    states[key] = _PodState(pod)
+                    touched.append(pod.node_name)
+        return touched
+
     def finish_binding(self, pod: Pod) -> None:
         key = pod.key()
         with self._lock:
@@ -92,12 +149,17 @@ class SchedulerCache:
             st.binding_finished = True
             st.deadline = self._now() + self._ttl
 
-    def finish_bindings_bulk(self, pods: List[Pod]) -> None:
-        """FinishBinding for a batch under one lock; one clock read."""
+    def finish_bindings_bulk(self, pods: List[Pod],
+                             keys: Optional[List[str]] = None) -> None:
+        """FinishBinding for a batch under one lock; one clock read. `keys`
+        lets the caller share already-computed pod keys."""
         deadline = self._now() + self._ttl
+        if keys is None:
+            keys = [pod.key() for pod in pods]
         with self._lock:
-            for pod in pods:
-                st = self._pod_states.get(pod.key())
+            get = self._pod_states.get
+            for key in keys:
+                st = get(key)
                 if st is None or not st.assumed:
                     continue
                 st.binding_finished = True

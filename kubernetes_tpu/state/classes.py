@@ -78,10 +78,16 @@ def _canon_volume(v) -> tuple:
 
 def _canon_container(c) -> tuple:
     # limits matter: is_best_effort() reads them (types.py) and best_effort
-    # drives the CheckNodeMemoryPressure predicate
-    return (c.image, tuple(sorted(c.requests.items())),
-            tuple(sorted(c.limits.items())),
-            tuple((p.host_port, p.protocol) for p in c.ports))
+    # drives the CheckNodeMemoryPressure predicate. Empty/singleton dicts
+    # skip the sort — a one-element items() tuple IS its sorted form, and
+    # this runs 30k times per drain round.
+    req = c.requests
+    lim = c.limits
+    return (c.image,
+            tuple(req.items()) if len(req) < 2 else tuple(sorted(req.items())),
+            tuple(lim.items()) if len(lim) < 2 else tuple(sorted(lim.items())),
+            tuple((p.host_port, p.protocol) for p in c.ports) if c.ports
+            else ())
 
 
 def pod_class_key(pod: Pod) -> tuple:
@@ -104,15 +110,18 @@ def pod_class_key(pod: Pod) -> tuple:
 
 
 def _pod_class_key(pod: Pod) -> tuple:
+    labels = pod.labels
+    sel = pod.node_selector
     return (
         pod.namespace,
-        tuple(sorted(pod.labels.items())),
+        tuple(labels.items()) if len(labels) < 2
+        else tuple(sorted(labels.items())),
         tuple(_canon_container(c) for c in pod.containers),
-        tuple(_canon_volume(v) for v in pod.volumes),
+        tuple(_canon_volume(v) for v in pod.volumes) if pod.volumes else (),
         pod.node_name,
-        tuple(sorted(pod.node_selector.items())),
+        tuple(sel.items()) if len(sel) < 2 else tuple(sorted(sel.items())),
         _canon_affinity(pod.affinity),
-        tuple(pod.tolerations),
+        tuple(pod.tolerations) if pod.tolerations else (),
         pod.priority,
         pod.owner_kind,
         pod.owner_uid,

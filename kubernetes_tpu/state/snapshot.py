@@ -362,10 +362,12 @@ class ClusterSnapshot:
             self.volume_ctx = volume_ctx
         vol_ctx_moved = self._vol_ctx_ver != self.volume_ctx.version
         self._vol_ctx_ver = self.volume_ctx.version
+        from kubernetes_tpu.utils.trace import COUNTERS
         if changed_hint is not None and not vol_ctx_moved \
                 and self._shape_sig is not None \
                 and len(infos) == len(self.node_names) \
                 and self._refresh_hinted(infos, changed_hint):
+            COUNTERS.inc("snapshot.refresh_hinted")
             return False
         # node-driven vocabs (taints, extended resources, avoid signatures) —
         # interned before shaping, re-scanned only for changed node specs.
@@ -401,6 +403,8 @@ class ClusterSnapshot:
         sig = (n_pad, self._labels_width, _pad(len(self.taint_vocab)),
                self.num_resources, _pad(len(self.avoid_vocab), 4))
         rebuild = sig != self._shape_sig or names != self.node_names
+        COUNTERS.inc("snapshot.refresh_rebuild" if rebuild
+                     else "snapshot.refresh_scan")
         if rebuild:
             self._allocate(names, sig)
             self._label_index = {}
@@ -423,11 +427,17 @@ class ClusterSnapshot:
             # 5k-node snapshot)
             self._write_rows_bulk(names, infos)
         else:
+            dyn_only = []
             for nm in changed:
                 i = self.node_index[nm]
                 info = infos[nm]
                 prev = self._generations.get(nm, (-1, -1, -1, None))
                 fresh = prev[3] is not info
+                if not fresh and info.spec_generation == prev[1] \
+                        and info.ports_generation == prev[2]:
+                    # pure capacity delta: vectorized batch write below
+                    dyn_only.append((i, nm, info))
+                    continue
                 self._write_dynamic_row(i, info)
                 if fresh or info.spec_generation != prev[1]:
                     self._write_static_row(i, info)
@@ -437,6 +447,8 @@ class ClusterSnapshot:
                 self._generations[nm] = (info.generation,
                                          info.spec_generation,
                                          info.ports_generation, info)
+            if dyn_only:
+                self._write_dynamic_rows_bulk(dyn_only)
         if label_index_stale:
             self._rebuild_label_index(infos, names)
         if changed or rebuild:
@@ -449,28 +461,121 @@ class ClusterSnapshot:
         the hint fully covered the update (pure capacity deltas on known
         nodes); False to make the caller run the full generation scan."""
         updates = []
+        gens = self._generations
+        index = self.node_index
         for nm in changed_hint:
             info = infos.get(nm)
-            i = self.node_index.get(nm, -1)
+            i = index.get(nm, -1)
             if info is None or i < 0:
                 return False  # membership drift — full scan
-            prev = self._generations.get(nm)
+            prev = gens.get(nm)
             if prev is None or prev[3] is not info \
                     or prev[1] != info.spec_generation \
                     or prev[2] != info.ports_generation:
                 return False  # spec/ports/identity moved — needs interning
-            if any(self.ext_vocab.get(name, "") < 0
-                   for name in info.requested.extended):
+            if info.requested.extended \
+                    and any(self.ext_vocab.get(name, "") < 0
+                            for name in info.requested.extended):
                 return False  # unseen extended resource — needs interning
             if prev[0] != info.generation:
                 updates.append((i, nm, info))
-        for i, nm, info in updates:
-            self._write_dynamic_row(i, info)
-            self._generations[nm] = (info.generation, info.spec_generation,
-                                     info.ports_generation, info)
         if updates:
+            self._write_dynamic_rows_bulk(updates)
             self.version += 1
         return True
+
+    def apply_assume_delta(self, rows: np.ndarray, delta: np.ndarray,
+                           gen_items) -> None:
+        """Fold a wave of assumes into the dynamic arrays WITHOUT touching
+        the NodeInfos: the caller (the pipelined harvest) knows the exact
+        per-placement raw delta (class request + nonzero rows), so the
+        mirror applies it to the raw int64 accumulators and re-quantizes
+        the touched rows — bit-identical to a full row rewrite from the
+        cache, at numpy speed. gen_items = [(name, info)] syncs the
+        generation bookkeeping so the next refresh() does not re-walk
+        these nodes for a change the mirror already has.
+
+        rows may repeat (one entry per placement); delta is int64 [k, 7]:
+        requested cpu/mem/gpu/scratch/overlay, nonzero cpu/mem. Callers
+        must route placements with ports/volumes/extended resources through
+        the normal dirty-note path instead — those touch more than the
+        seven raw columns."""
+        np.add.at(self._raw_dyn, rows, delta)
+        np.add.at(self.pod_count, rows, 1)
+        touched = np.unique(rows)
+        raw = self._raw_dyn[touched]
+        shift = self.mem_shift
+        requested = self.requested
+        requested[touched, R_CPU] = self._i32(raw[:, 0])
+        requested[touched, R_MEM] = self._i32(-((-raw[:, 1]) >> shift))
+        requested[touched, R_GPU] = self._i32(raw[:, 2])
+        requested[touched, R_SCRATCH] = self._i32(-((-raw[:, 3]) >> shift))
+        requested[touched, R_OVERLAY] = self._i32(-((-raw[:, 4]) >> shift))
+        self.nonzero[touched, 0] = self._i32(raw[:, 5])
+        self.nonzero[touched, 1] = self._i32(-((-raw[:, 6]) >> shift))
+        gens = self._generations
+        for nm, info in gen_items:
+            prev = gens.get(nm)
+            if prev is not None:  # unseen node: next refresh rewrites it
+                gens[nm] = (info.generation, prev[1], prev[2], info)
+        self.dirty.update(self.DYNAMIC)
+        self.version += 1
+
+    def _write_dynamic_rows_bulk(self, updates) -> None:
+        """The work of _write_dynamic_row over a BATCH of (row, name, info)
+        triples in vectorized column math — the pipelined drain rewrites
+        every assumed-onto node once per wave, so the per-row Python writer
+        (resource_row + per-column quantization calls) would dominate the
+        round. Rows with extended resources or volume-bearing pods take the
+        exact per-row writer; generations update for all."""
+        slow = []
+        fast = []
+        for item in updates:
+            info = item[2]
+            if info.requested.extended or info.vol_count \
+                    or self._row_vol_conflicts[item[0]] \
+                    or self._row_vol_pds[item[0]]:
+                slow.append(item)
+            else:
+                fast.append(item)
+        if fast:
+            n = len(fast)
+            idx = np.empty(n, dtype=np.intp)
+            base = np.empty((n, 5), dtype=np.int64)
+            nz = np.empty((n, 2), dtype=np.int64)
+            cnt = np.empty(n, dtype=np.int32)
+            for j, (i, _nm, info) in enumerate(fast):
+                idx[j] = i
+                req = info.requested
+                base[j] = (req.milli_cpu, req.memory, req.nvidia_gpu,
+                           req.storage_scratch, req.storage_overlay)
+                nz[j] = (info.nonzero_cpu, info.nonzero_mem)
+                cnt[j] = len(info.pods)
+            shift = self.mem_shift
+            requested = self.requested
+            requested[idx, R_CPU] = self._i32(base[:, 0])
+            requested[idx, R_MEM] = self._i32(-((-base[:, 1]) >> shift))
+            requested[idx, R_GPU] = self._i32(base[:, 2])
+            requested[idx, R_SCRATCH] = self._i32(-((-base[:, 3]) >> shift))
+            requested[idx, R_OVERLAY] = self._i32(-((-base[:, 4]) >> shift))
+            if requested.shape[1] > NUM_BASE_RESOURCES:
+                # a node whose last extended-resource pod just left arrives
+                # via `slow` (extended keeps zeroed keys); rows here never
+                # carry extended requests — clear any stale columns
+                requested[idx[:, None],
+                          np.arange(NUM_BASE_RESOURCES,
+                                    requested.shape[1])] = 0
+            self.nonzero[idx, 0] = self._i32(nz[:, 0])
+            self.nonzero[idx, 1] = self._i32(-((-nz[:, 1]) >> shift))
+            self._raw_dyn[idx, :5] = base
+            self._raw_dyn[idx, 5:7] = nz
+            self.pod_count[idx] = cnt
+            self.dirty.update(self.DYNAMIC)
+        for i, _nm, info in slow:
+            self._write_dynamic_row(i, info)
+        for i, nm, info in updates:
+            self._generations[nm] = (info.generation, info.spec_generation,
+                                     info.ports_generation, info)
 
     # ------------------------------------------------------------- internals
 
@@ -486,6 +591,12 @@ class ClusterSnapshot:
         self.alloc = np.zeros((n, r), dtype=np.int32)
         self.requested = np.zeros((n, r), dtype=np.int32)
         self.nonzero = np.zeros((n, 2), dtype=np.int32)
+        # raw (unquantized) mirror of the dynamic columns: requested
+        # cpu/mem/gpu/scratch/overlay + nonzero cpu/mem — the substrate
+        # apply_assume_delta accumulates into so incremental quantization
+        # stays bit-identical to a full rewrite (ceil of the TOTAL, not a
+        # sum of per-pod ceils)
+        self._raw_dyn = np.zeros((n, 7), dtype=np.int64)
         self.pod_count = np.zeros(n, dtype=np.int32)
         self.allowed_pods = np.zeros(n, dtype=np.int32)
         self.schedulable = np.zeros(n, dtype=bool)
@@ -614,6 +725,8 @@ class ClusterSnapshot:
         self.requested[:n, R_OVERLAY] = self._i32(-((-base[:, 1, 4]) >> shift))
         self.nonzero[:n, 0] = self._i32(nonzero[:, 0])
         self.nonzero[:n, 1] = self._i32(-((-nonzero[:, 1]) >> shift))
+        self._raw_dyn[:n, :5] = base[:, 1]
+        self._raw_dyn[:n, 5:7] = nonzero
         self._scatter_labels(n)
         self.dirty.update(self.DYNAMIC)
         self.dirty.update(self.STATIC)
@@ -656,6 +769,10 @@ class ClusterSnapshot:
 
     def _write_dynamic_row(self, i: int, info: NodeInfo) -> None:
         r = self.num_resources
+        req_ = info.requested
+        self._raw_dyn[i] = (req_.milli_cpu, req_.memory, req_.nvidia_gpu,
+                            req_.storage_scratch, req_.storage_overlay,
+                            info.nonzero_cpu, info.nonzero_mem)
         self.requested[i] = self.resource_row(
             milli_cpu=info.requested.milli_cpu, memory=info.requested.memory,
             gpu=info.requested.nvidia_gpu, scratch=info.requested.storage_scratch,

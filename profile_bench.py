@@ -2,7 +2,12 @@
 
 Not part of the framework; dev-only.
 
-  python profile_bench.py             # drain phase attribution (3 trials)
+  python profile_bench.py             # pipelined drain attribution: spans
+                                      # per phase + measured device-idle
+                                      # fraction (overlap vs sequential)
+  PROFILE_CLASSIC=1 python profile_bench.py
+                                      # classic synchronous rounds, the
+                                      # r06-era per-phase attribution
   PROFILE_EXTENDER=1 python profile_bench.py
                                       # warm extender round attribution:
                                       # where does a /filter+/prioritize
@@ -77,9 +82,91 @@ def profile_extender():
     srv.stop()
 
 
+def profile_pipeline():
+    """Attribute the PIPELINED drain (ISSUE 2): per-phase wall from the
+    engine's spans + scheduler wrappers, then the measured device-idle
+    story — sequential mode exposes raw device time (pipeline.device_sync:
+    no host work runs inside that window), overlapped mode exposes the
+    residual un-hidden wait (pipeline.device_block), and hidden fraction =
+    1 - residual/raw."""
+    import gc
+    import time as _time
+
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    n_pods = int(os.environ.get("BENCH_PODS", 30000))
+    profile = os.environ.get("BENCH_PROFILE", "density")
+    api, sched = build(n_nodes, n_pods, profile)
+    sched.run_until_drained()  # warm compile
+
+    def run(overlap):
+        api, sched = build(n_nodes, n_pods, profile)
+        phases = {}
+
+        def timed(name, fn):
+            def wrap(*a, **k):
+                t0 = _time.perf_counter()
+                r = fn(*a, **k)
+                phases[name] = phases.get(name, 0.0) \
+                    + _time.perf_counter() - t0
+                return r
+            return wrap
+
+        sched.sync = timed("sync(columnar)", sched.sync)
+        sched.queue.pop_batch = timed("pop_batch", sched.queue.pop_batch)
+        sched.api.bind_pods_bulk = timed("bind_bulk",
+                                         sched.api.bind_pods_bulk)
+        sched.cache.finish_bindings_bulk = timed(
+            "finish_bulk", sched.cache.finish_bindings_bulk)
+        COUNTERS.reset()
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            t0 = _time.perf_counter()
+            totals = sched.run_until_drained(overlap=overlap)
+            elapsed = _time.perf_counter() - t0
+        finally:
+            gc.enable()
+            gc.unfreeze()
+        for name, (_c, secs) in COUNTERS.snapshot().items():
+            if name.startswith("pipeline."):
+                phases["  " + name] = secs
+        return elapsed, totals, phases
+
+    seq_device = []
+    for trial in range(3):
+        elapsed, totals, phases = run(overlap=True)
+        print(f"overlap trial {trial}: elapsed={elapsed:.3f}s "
+              f"bound={totals['bound']} "
+              f"fence_requeued={totals['fence_requeued']}")
+        for k, v in sorted(phases.items(), key=lambda kv: -kv[1]):
+            print(f"    {k:28s} {v * 1e3:7.1f}ms")
+        residual = phases.get("  pipeline.device_block", 0.0)
+        print(f"    {'(residual device wait)':28s} {residual * 1e3:7.1f}ms")
+    for trial in range(2):
+        elapsed, totals, phases = run(overlap=False)
+        dev = phases.get("  pipeline.device_sync", 0.0)
+        seq_device.append((elapsed, dev))
+        print(f"sequential trial {trial}: elapsed={elapsed:.3f}s raw "
+              f"device={dev * 1e3:.0f}ms "
+              f"(idle-if-serial={dev / elapsed * 100:.0f}% of wall)")
+    if seq_device:
+        el, dev = min(seq_device)
+        print(f"device-idle story: sequential wall {el:.3f}s carries "
+              f"{dev * 1e3:.0f}ms of exposed device wait; the overlapped "
+              f"runs above show the residual (pipeline.device_block) the "
+              f"pipeline failed to hide — hidden fraction = "
+              f"1 - residual/raw.")
+
+
 def main():
     if os.environ.get("PROFILE_EXTENDER") == "1":
         profile_extender()
+        return
+    if os.environ.get("PROFILE_CLASSIC") != "1":
+        profile_pipeline()
         return
     n_nodes = int(os.environ.get("BENCH_NODES", 5000))
     n_pods = int(os.environ.get("BENCH_PODS", 30000))
@@ -87,7 +174,7 @@ def main():
 
     # warmup (compile) run
     api, sched = build(n_nodes, n_pods, profile)
-    sched.run_until_drained()
+    sched.run_until_drained(pipeline=False)
 
     for trial in range(3):
         api, sched = build(n_nodes, n_pods, profile)
@@ -141,7 +228,7 @@ def main():
         gc.disable()
         try:
             t0 = time.perf_counter()
-            totals = sched.run_until_drained()
+            totals = sched.run_until_drained(pipeline=False)
             elapsed = time.perf_counter() - t0
         finally:
             gc.enable()

@@ -24,11 +24,17 @@ def test_arrival_stream_distribution_is_not_degenerate():
     # warm pass compiles the kernels so the measured pass isn't skewed by
     # a mid-stream compile burst
     bench.run_arrival(200, rate=200, duration_s=1)
-    intervals, sustained, p50, p99, bound = bench.run_arrival(
-        200, rate=300, duration_s=3)
-    assert bound == 900
+    out = bench.run_arrival(200, rate=300, duration_s=3)
+    assert out["bound"] == 900
     # intervals spread each round's binds over its duration (rounded to
     # 0.1), so the sum matches up to rounding
-    assert abs(sum(intervals) - 900) < 1.0
-    assert sustained > 0
-    assert p50 < p99, "per-pod create->bound must be a real distribution"
+    assert abs(sum(out["intervals"]) - 900) < 1.0
+    assert out["sustained_pods_s"] > 0
+    assert out["p50_ms"] < out["p99_ms"], \
+        "per-pod create->bound must be a real distribution"
+    # the host-bound honesty fields (ISSUE 2): offered rate, end-of-offer
+    # backlog and unbound count are reported explicitly, and a fully-kept-up
+    # run reports zero unbound
+    assert out["offered_pods_s"] == 300.0
+    assert out["unbound"] == 0
+    assert out["backlog_at_offer_end"] >= 0

@@ -155,6 +155,46 @@ def test_loop_never_deletes_unmanaged_member_objects():
         pass
 
 
+def _wait_until(fn, timeout=10.0, interval=0.02):
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return True
+        except NotFound:
+            pass
+        time.sleep(interval)
+    return False
+
+
+def test_background_worker_rebalances_without_pump():
+    """VERDICT item #8 (first half): the sync loop runs on its OWN worker
+    thread — create a federated RS, kill a cluster, and replicas move with
+    NO test-side pump(rounds) anywhere. pump() stays available as the
+    deterministic hook (every other test here), but a live deployment only
+    calls start()."""
+    plane, members = mk_plane("alpha", "beta")
+    loop = FederationSyncLoop(plane)
+    loop.start(interval_s=0.01)
+    try:
+        plane.api.create(FEDERATED_RS_KIND, mk_frs(10))
+        assert _wait_until(
+            lambda: members["alpha"].get("ReplicaSet", "default",
+                                         "web").replicas
+            + members["beta"].get("ReplicaSet", "default", "web").replicas
+            == 10), "worker never reconciled the federated RS"
+        # beta dies: only the API write happens; the worker must react
+        plane.mark_ready("beta", False)
+        assert _wait_until(
+            lambda: members["alpha"].get("ReplicaSet", "default",
+                                         "web").replicas == 10), \
+            "worker never rebalanced after cluster loss"
+    finally:
+        loop.stop()
+    assert loop.syncs > 0
+
+
 def test_propagated_kinds_flow_through_the_loop():
     plane, members = mk_plane("alpha", "beta")
     loop = FederationSyncLoop(plane)

@@ -1,0 +1,188 @@
+"""Pipelined drain (ISSUE 2): overlap correctness fence + perf guards.
+
+The drain's two-stage pipeline (engine/scheduler.py _DrainPipeline +
+engine/scheduler_engine.py dispatch_waves/harvest_waves) launches wave k+1's
+device eval before wave k's host bookkeeping runs, so wave k+1 is encoded
+BLIND to wave k's commits. These tests pin the correctness fence (blind
+capacity losers requeue and converge), the A/B contract (overlap on/off is
+bit-identical — the fence, not the timing, decides placements), and the
+warm-round performance invariants via span counters so a later PR cannot
+quietly reintroduce the eager path (re-tensorization per chunk, full
+snapshot walks per bind, per-op dispatch)."""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    ContainerPort,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes, load_cluster
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.utils.trace import COUNTERS
+
+Gi = 1 << 30
+
+
+def mk_sched(nodes, pods, chunk):
+    api = ApiServerLite()
+    load_cluster(api, nodes, pods)
+    s = Scheduler(api, record_events=False)
+    s.pipeline_chunk = chunk
+    s.start()
+    return api, s
+
+
+def placements(api):
+    return {p.name: p.node_name for p in api.list("Pod")[0]}
+
+
+# --------------------------------------------------------------- the fence
+
+
+def test_blind_capacity_conflict_requeues_and_converges():
+    """Wave k exhausts a node's capacity; wave k+1 (encoded pre-k) placed
+    optimistically onto the same nodes. The fence must requeue the losers
+    WITHOUT marking them unschedulable, and the retry must converge with
+    capacity exactly respected."""
+    def build():
+        nodes = [make_node(f"n{i:03d}", cpu=2000, memory=8 * Gi, pods=110)
+                 for i in range(16)]  # each node fits exactly 2 pods
+        pods = [make_pod(f"p{i:03d}", cpu=1000, memory=256 << 20)
+                for i in range(40)]
+        return mk_sched(nodes, pods, chunk=8)
+
+    api, s = build()
+    tot = s.run_until_drained()
+    assert tot["bound"] == 32
+    assert tot["unschedulable"] >= 8  # 40 pods, 32 slots
+    assert tot["fence_requeued"] > 0, \
+        "blind waves over 2-pod nodes must hit the fence"
+    per_node = Counter(p.node_name for p in api.list("Pod")[0]
+                       if p.node_name)
+    assert all(v <= 2 for v in per_node.values()), per_node
+
+    # THE A/B: identical dataflow with overlap forced off must produce
+    # bit-identical final placements — the fence, not scheduling luck,
+    # decides every conflict
+    api2, s2 = build()
+    tot2 = s2.run_until_drained(overlap=False)
+    assert placements(api) == placements(api2)
+    assert tot2["fence_requeued"] == tot["fence_requeued"]
+
+
+def test_blind_port_conflict_requeues_conservatively():
+    """Special classes (host ports) cannot be re-validated by the vector
+    capacity fence; a blind-window touch on their target node requeues them
+    conservatively. End state: both port pods bound, never colliding."""
+    nodes = [make_node(f"n{i}", cpu=4000, memory=16 * Gi, pods=110)
+             for i in range(2)]
+    pods = []
+    for i in range(2):
+        p = make_pod(f"port-{i}", cpu=100, memory=128 << 20)
+        p.containers[0].ports = [ContainerPort(host_port=8080)]
+        pods.append(p)
+    api, s = mk_sched(nodes, pods, chunk=1)  # one pod per wave -> blind pair
+    tot = s.run_until_drained()
+    assert tot["bound"] == 2
+    assert {p.node_name for p in api.list("Pod")[0]} == {"n0", "n1"}
+
+
+def test_required_anti_affinity_falls_back_to_strict_and_converges():
+    """Chunks carrying required pod anti-affinity are not wave-eligible:
+    the pipeline must flush and route them through the classic synchronous
+    engine, and the result must match the classic drain exactly."""
+    def build():
+        nodes = [make_node(f"n{i:02d}", cpu=8000, memory=32 * Gi, pods=110,
+                           labels={"host": f"h{i}"}) for i in range(8)]
+        pods = []
+        for i in range(8):
+            p = make_pod(f"iso-{i}", cpu=100, memory=128 << 20,
+                         labels={"app": "iso"})
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "iso"}),
+                    namespaces=[], topology_key="host")]))
+            pods.append(p)
+        return mk_sched(nodes, pods, chunk=3)
+
+    api, s = build()
+    tot = s.run_until_drained()
+    assert tot["bound"] == 8
+    assert len({p.node_name for p in api.list("Pod")[0]}) == 8  # 1 per host
+    api2, s2 = build()
+    s2.run_until_drained(pipeline=False)
+    assert placements(api) == placements(api2)
+
+
+def test_pipelined_equals_sequential_on_seeded_density():
+    """Seeded A/B at a non-trivial shape: the overlapped pipeline and its
+    sequential (overlap=False) execution are bit-identical in FINAL
+    placements — overlap changes wall clock, never results."""
+    def build():
+        nodes = hollow_nodes(96, seed=7)
+        pods = PROFILES["density"](700)
+        return mk_sched(nodes, pods, chunk=128)
+
+    api1, s1 = build()
+    t1 = s1.run_until_drained()
+    api2, s2 = build()
+    t2 = s2.run_until_drained(overlap=False)
+    assert t1["bound"] == t2["bound"] == 700
+    assert placements(api1) == placements(api2)
+
+
+# ------------------------------------------------------------ perf guards
+
+
+def test_warm_round_invariants_via_span_counters():
+    """The regression tripwire (ISSUE 2 satellite): a WARM pipelined drain
+    must (a) re-tensorize nothing (cached class encodings reused), (b) make
+    exactly one fused device dispatch per wave, and (c) refresh the
+    snapshot via the targeted hint, never a full node walk — so the next
+    PR can't quietly reintroduce the eager path."""
+    nodes = hollow_nodes(64)
+    pods = PROFILES["density"](256)
+    api, s = mk_sched(nodes, pods, chunk=128)
+    tot = s.run_until_drained(max_batch=128)  # warm: compiles + builds enc
+    assert tot["bound"] == 256
+
+    # second storm of the SAME pod class arrives
+    for p in PROFILES["density"](256):
+        p.name = "warm2-" + p.name
+        api.create("Pod", p)
+    COUNTERS.reset()
+    tot = s.run_until_drained(max_batch=128)
+    assert tot["bound"] == 256
+    snap = COUNTERS.snapshot()
+
+    # (a) no re-tensorization of cached pod classes
+    assert snap.get("engine.wave_encode_build", (0, 0))[0] == 0, snap
+    assert snap.get("engine.wave_encode_reuse", (0, 0))[0] >= 2
+    # (b) one fused dispatch per wave: 256 pods / 128 chunk = 2 waves
+    assert snap.get("engine.wave_dispatch", (0, 0))[0] == 2, snap
+    # (c) targeted refresh only — a full scan or rebuild after a plain bind
+    # is the regression this test exists to catch
+    assert snap.get("snapshot.refresh_scan", (0, 0))[0] == 0, snap
+    assert snap.get("snapshot.refresh_rebuild", (0, 0))[0] == 0, snap
+    assert snap.get("snapshot.refresh_hinted", (0, 0))[0] >= 2
+
+
+def test_fence_requeue_is_not_backoff():
+    """A fence conflict is a capacity race, not unschedulability: the loser
+    must retry in the immediately following waves (plain queue add), not
+    sit in the backoff heap."""
+    nodes = [make_node(f"n{i:02d}", cpu=1000, memory=4 * Gi, pods=110)
+             for i in range(4)]  # 1 pod per node
+    pods = [make_pod(f"p{i}", cpu=1000, memory=128 << 20) for i in range(4)]
+    api, s = mk_sched(nodes, pods, chunk=2)
+    tot = s.run_until_drained()
+    assert tot["bound"] == 4, tot  # nobody parked in backoff: all 4 landed
+    assert tot["unschedulable"] == 0
