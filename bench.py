@@ -448,6 +448,57 @@ def measure_extender_latency(n_nodes: int, rounds: int = 20):
         srv.stop()
 
 
+def measure_mixed_affinity(n_nodes: int, n_pods: int, warmup: bool = True):
+    """The ISSUE 3 headline scenario: the standard drain protocol over the
+    `mixed_affinity` profile (>=15% required (anti-)affinity pods — hostname
+    anti riding the wave path, zone affinity through the seeded strict
+    tail, symmetry targets in the plain stream). Collects the wave-path
+    observability counters so silent routing regressions (affinity quietly
+    flushing the pipeline again, or quietly skipping the strict tail) are
+    visible in the bench JSON, not only in tests."""
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    if warmup:
+        run_once(n_nodes, n_pods, "mixed_affinity")
+    import gc
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    COUNTERS.reset()
+    try:
+        totals, elapsed, sched = run_once(n_nodes, n_pods, "mixed_affinity")
+    finally:
+        gc.enable()
+        gc.unfreeze()
+    snap = COUNTERS.snapshot()
+
+    def cnt(name):
+        return snap.get(name, (0, 0.0))[0]
+
+    bound = totals["bound"]
+    c2b = sched.metrics.create_to_bound
+    return {
+        "mixed_pods_s": round(bound / elapsed, 1) if elapsed > 0 else 0.0,
+        "mixed_elapsed_s": round(elapsed, 3),
+        "mixed_bound": bound,
+        "mixed_unschedulable": totals["unschedulable"],
+        "mixed_fence_requeued": totals.get("fence_requeued", 0),
+        "mixed_p50_create_to_bound_ms": round(c2b.percentile(50) * 1e3, 3),
+        "mixed_p99_create_to_bound_ms": round(c2b.percentile(99) * 1e3, 3),
+        # wave-path routing observability (ISSUE 3 satellite): how many
+        # pods the wave pass could NOT absorb, and how many placements the
+        # topology fence re-validated away
+        "mixed_affinity_strict_tail": cnt("engine.affinity_strict_tail"),
+        "mixed_affinity_fence_requeues":
+            cnt("engine.affinity_fence_requeues"),
+        "mixed_affinity_straggler_requeues":
+            cnt("engine.affinity_straggler_requeues"),
+        "mixed_wave_dispatch": cnt("engine.wave_dispatch"),
+        "mixed_wave_tail_dispatch": cnt("engine.wave_tail_dispatch"),
+        "mixed_wave_encode_build": cnt("engine.wave_encode_build"),
+    }
+
+
 def main():
     n_nodes = int(os.environ.get("BENCH_NODES", 5000))
     n_pods = int(os.environ.get("BENCH_PODS", 30000))
@@ -522,11 +573,24 @@ def main():
             import sys
             print(f"bench: arrival measurement failed: {e}", file=sys.stderr)
 
+    # mixed-affinity drain (ISSUE 3 headline): same box, same protocol,
+    # >=15% required (anti-)affinity pods (BENCH_MIXED=0 to skip)
+    mixed = None
+    if os.environ.get("BENCH_MIXED", "1") != "0":
+        try:
+            mixed = measure_mixed_affinity(
+                n_nodes, int(os.environ.get("BENCH_MIXED_PODS", n_pods)),
+                warmup=warmup)
+        except Exception as e:
+            import sys
+            print(f"bench: mixed-affinity measurement failed: {e}",
+                  file=sys.stderr)
+
     bound = totals["bound"]
     pods_per_s = bound / elapsed if elapsed > 0 else 0.0
     c2b = sched.metrics.create_to_bound  # honest per-pod distribution:
     # first-seen-unscheduled -> bind-complete, queue wait included
-    print(json.dumps({
+    out = dict({
         "metric": f"pods scheduled/sec ({profile}, {n_nodes} nodes, {n_pods} pods, create->bound)",
         "value": round(pods_per_s, 1),
         "unit": "pods/s",
@@ -567,7 +631,25 @@ def main():
         "arrival_p99_create_to_bound_ms": round(arrival["p99_ms"], 3)
         if arrival else None,
         "arrival_bound": arrival["bound"] if arrival else None,
-    }))
+    }, **(mixed or {}))
+    print(json.dumps(out))
+
+    # resume the bench trajectory (ISSUE 3 satellite): persist this round's
+    # numbers as the BENCH_r08 artifact — same {cmd, rc, parsed} shape as
+    # the driver-written BENCH_r01..r05 files, so trajectory readers keep
+    # working. BENCH_ARTIFACT= (empty) disables, or names another round.
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r08.json")
+    if artifact:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            artifact)
+        try:
+            with open(path, "w") as f:
+                json.dump({"n": 1, "cmd": "python bench.py", "rc": 0,
+                           "parsed": out}, f, indent=2)
+                f.write("\n")
+        except OSError as e:
+            import sys
+            print(f"bench: artifact write failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -449,6 +449,16 @@ class Pod:
             self.__dict__["_key"] = k
         return k
 
+    def has_pod_affinity(self) -> bool:
+        """Any pod (anti-)affinity term, required or preferred. The ONE
+        definition behind both the cache's aff_seq bumps and the engine's
+        encoding-staleness accounting (ops/affinity._has_affinity) — the
+        two counters must agree pod-for-pod or encoding reuse either
+        thrashes or trusts stale topology arrays."""
+        a = self.affinity
+        return a is not None and (a.pod_affinity is not None
+                                  or a.pod_anti_affinity is not None)
+
     def resource_request(self) -> Resource:
         """Sum of container requests — GetResourceRequest
         (reference: predicates.go:478 computePodResourceRequest; init

@@ -147,6 +147,36 @@ def _commit(state: NodeState, sel: jnp.ndarray, ok: jnp.ndarray,
                      vol_present, vol_rw, pd_present, pd_counts)
 
 
+def _batch_pre(pods: Arrays, nodes: Arrays,
+               priorities) -> Tuple[jnp.ndarray, ...]:
+    """The [*, N] capacity-independent tensors place_batch consumes:
+    static predicate mask, reduce-priority count matrices, static priority
+    score. Shape-generic over the leading axis — gather_place_batch calls
+    this at CLASS level and gathers per-pod rows, because a strict tail of
+    P pods over C << P classes repeats each class row P/C times and the
+    label-axis matmuls in here (selector_fit, node_affinity_counts) scale
+    with the cluster once hostname domains are interned: computing them
+    per POD was the dominant hidden cost of the r08 affinity tail
+    (PROFILE_r08.md §3)."""
+    static_fit = preds.static_fits(pods, nodes)
+    tt_cnt = jnp.einsum("pt,nt->pn", pods["intolerated_pref"],
+                        nodes["taints_pref"].astype(jnp.int8),
+                        preferred_element_type=jnp.int32)
+    na_cnt = prio.node_affinity_counts(pods, nodes["labels"]) \
+        if any(nm == "NodeAffinityPriority" for nm, _ in priorities) \
+        else jnp.zeros(static_fit.shape, dtype=jnp.int32)
+    static_score = jnp.zeros(static_fit.shape, dtype=jnp.int32)
+    for name, weight in priorities:
+        if name in _STATIC_PRIORITIES:
+            static_score = static_score + \
+                prio.PRIORITY_REGISTRY[name](pods, nodes, None) * weight
+    if "policy_score" in pods:
+        # Policy-configured NodeLabel / ServiceAntiAffinity priorities
+        # (weights pre-folded; ops/policy_algos.py)
+        static_score = static_score + pods["policy_score"]
+    return static_fit, tt_cnt, na_cnt, static_score
+
+
 @functools.partial(jax.jit, static_argnames=("priorities", "aff_mode"))
 def gather_place_batch(cls_arr: Arrays, pc: jnp.ndarray, nodes: Arrays,
                        state: "NodeState", rr: jnp.ndarray, priorities,
@@ -157,11 +187,16 @@ def gather_place_batch(cls_arr: Arrays, pc: jnp.ndarray, nodes: Arrays,
     index per pod). The gather runs inside the jit so padding/bucketed
     shapes cost no standalone eager-op compiles. `aff` stays class-level
     (the scan indexes it by pc per step — gathering [P, S, L] per-pod rows
-    would blow memory at 30k pods); `extra_score` is class-level [C, N]."""
+    would blow memory at 30k pods); `extra_score` is class-level [C, N].
+    The capacity-independent [C, N] tensors are computed ONCE at class
+    level and gathered — identical rows, a fraction of the matmuls."""
     parr = jax.tree.map(lambda a: a[pc], cls_arr)
     ex = extra_score[pc] if extra_score is not None else None
+    pre_c = _batch_pre(cls_arr, nodes, priorities)
+    pre = tuple(a[pc] for a in pre_c)
     return place_batch(parr, nodes, state, rr, priorities, aff=aff, pc=pc,
-                       aff_mode=aff_mode, aff_init=aff_init, extra_score=ex)
+                       aff_mode=aff_mode, aff_init=aff_init, extra_score=ex,
+                       pre=pre)
 
 
 @functools.partial(jax.jit, static_argnames=("priorities", "aff_mode"))
@@ -171,6 +206,7 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
                 aff: Arrays = None, pc: jnp.ndarray = None,
                 aff_mode: Tuple[bool, bool, bool] = (False, False, False),
                 aff_init=None, extra_score: jnp.ndarray = None,
+                pre: Tuple[jnp.ndarray, ...] = None,
                 ) -> Tuple[jnp.ndarray, jnp.ndarray, NodeState, jnp.ndarray]:
     """Place every pod in the batch sequentially on device.
 
@@ -201,7 +237,9 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
                if nm == "InterPodAffinityPriority") if prio_on else 0
     w_sp = sum(w for nm, w in priorities
                if nm == "SelectorSpreadPriority") if spread_on else 0
-    static_fit = preds.static_fits(pods, nodes)  # [P,N] — MXU batch
+    if pre is None:
+        pre = _batch_pre(pods, nodes, priorities)
+    static_fit, tt_cnt, na_cnt, static_score = pre  # [P,N] — MXU batch
     alloc = nodes["alloc"]
     allowed = nodes["allowed_pods"]
     n = alloc.shape[0]
@@ -209,7 +247,14 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
     idx_n = jnp.arange(n, dtype=jnp.int32)
     if any_aff:
         c_dim = aff["m_aff"].shape[0]
-        labels = nodes["labels"]
+        # labels_aff (when present) is the PROJECTED domain incidence the
+        # aff arrays' domain axes are sliced to (the pipelined tail's
+        # column projection, engine/scheduler_engine._aff_tail_arrays) —
+        # the occupancy contractions then run at Lp = O(referenced
+        # domains) instead of the full label width. The predicate/priority
+        # arrays in `pods`/`nodes` keep the full label matrix.
+        labels = aff["labels_aff"] if "labels_aff" in aff \
+            else nodes["labels"]
         l_dim = labels.shape[1]
         # deliberately the jnp einsum, NOT the Pallas incidence kernel
         # (ops/pallas_kernels.precompute_static_fast): this path also runs
@@ -233,24 +278,6 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
         commdom0 = jnp.zeros((c_dim, l_dim), dtype=jnp.int32)
         committed0 = jnp.zeros((c_dim, n), dtype=jnp.int32)
         comm_cnt0 = jnp.zeros(c_dim, dtype=jnp.int32)
-    # reduce-priority count matrices (batched MXU work, consumed per-step)
-    tt_cnt = jnp.einsum("pt,nt->pn", pods["intolerated_pref"],
-                        nodes["taints_pref"].astype(jnp.int8),
-                        preferred_element_type=jnp.int32)
-    na_cnt = prio.node_affinity_counts(pods, nodes["labels"]) \
-        if any(nm == "NodeAffinityPriority" for nm, _ in priorities) \
-        else jnp.zeros((p_count, n), dtype=jnp.int32)
-    # carry/reduce-independent priorities: fold into one static score matrix
-    static_score = jnp.zeros((p_count, n), dtype=jnp.int32)
-    for name, weight in priorities:
-        if name in _STATIC_PRIORITIES:
-            static_score = static_score + \
-                prio.PRIORITY_REGISTRY[name](pods, nodes, None) * weight
-    if "policy_score" in pods:
-        # Policy-configured NodeLabel / ServiceAntiAffinity priorities
-        # (weights pre-folded; ops/policy_algos.py)
-        static_score = static_score + pods["policy_score"]
-
     pd_kind = nodes["pd_kind"]
     pd_max = nodes["pd_max"]
 

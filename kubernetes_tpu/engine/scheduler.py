@@ -22,6 +22,13 @@ Two drain modes:
   columnar: the watch drain batches bind confirmations, assumes are
   grouped per (node, class), binds go through one bulk write, and the
   snapshot refresh rides the changed_hint / raw-delta fast paths.
+  Required (anti-)affinity chunks are wave-eligible since ISSUE 3: the
+  engine evaluates their masks per wave from device-resident topology
+  occupancy, routes counter-inexpressible shapes to a seeded strict tail
+  inside the harvest, and the fence re-validates topology occupancy the
+  same way it re-validates capacity — only gangs, Policy algorithms,
+  workload spreading, and host-check/slot-overflow classes still flush
+  to the classic round.
 
 Error paths preserved:
 
@@ -506,7 +513,8 @@ class Scheduler:
     def _wave_eligible(self, pods: List[Pod]) -> bool:
         """Cheap host-side gate before dispatch: gangs schedule atomically
         through the classic round; the engine applies the deeper checks
-        (affinity, host-path classes, policy) itself."""
+        itself (host-path classes, policy, affinity slot overflow —
+        required (anti-)affinity itself rides the wave path, ISSUE 3)."""
         return all(gangmod.gang_name(p) is None for p in pods)
 
     def _bind_bulk(self, pods: List[Pod]) -> List[Optional[str]]:
@@ -833,9 +841,10 @@ class _DrainPipeline:
             if s._wave_eligible(pods):
                 handle = s.engine.dispatch_waves(pods, pop_ts)
             if handle is None:
-                # chunk needs the strict/oracle machinery (gangs, affinity,
-                # host-check classes, policy): drain the pipeline so the
-                # synchronous path sees every commit, then run it classic
+                # chunk needs the strict/oracle machinery (gangs,
+                # host-check classes, affinity slot overflow, policy):
+                # drain the pipeline so the synchronous path sees every
+                # commit, then run it classic
                 self.flush()
                 sub = s._process_batch(pods, pop_ts)
                 sub["popped"] = 0  # already counted

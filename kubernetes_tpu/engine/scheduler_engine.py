@@ -544,6 +544,88 @@ def _eval_dispatch(pod, infos, snap, priorities, workloads, hard_weight,
     return m, s
 
 
+def _aff_node_views(adata, snap):
+    """(key_node [C, A, N] int8, static_forbid_hit [C, N] int8): the
+    per-NODE projections of the anti-term keymasks and static forbid rows.
+    Wave-eligible classes have singleton domains, so "node n is in a
+    forbidden domain of term (c, a)" reduces to "a matching pod sits ON n
+    and n carries the term's key" — these two views are all the per-wave
+    mask needs, and neither carries the label axis (which scales with the
+    cluster once hostname keys are interned). Computed once per encoding
+    build as dense float64 GEMMs restricted to the NONZERO rows (BLAS,
+    counts far below 2^53 — exact)."""
+    lab_t = snap.labels.astype(np.float64).T              # [L, N]
+    C, A, L = adata.anti_keymask.shape
+    n = lab_t.shape[1]
+    km = adata.anti_keymask.reshape(C * A, L)
+    key_node = np.zeros((C * A, n), dtype=np.int8)
+    rows = np.nonzero(km.any(axis=1))[0]
+    if rows.size:
+        key_node[rows] = (km[rows].astype(np.float64) @ lab_t) > 0
+    fs = adata.forbid_static
+    static_hit = np.zeros((C, n), dtype=np.int8)
+    frows = np.nonzero(fs.any(axis=1))[0]
+    if frows.size:
+        static_hit[frows] = (fs[frows].astype(np.float64) @ lab_t) > 0
+    return key_node.reshape(C, A, n), static_hit
+
+
+def _aff_tail_cols(adata, prio_on: bool) -> np.ndarray:
+    """Label columns the SEEDED STRICT TAIL can actually read: domains of
+    the wave_strict classes' own terms (allow + anti + static rows), of
+    terms TARGETING them (the symmetry sources), and — when preferred
+    scoring is live — of every priority-side keymask. Everything else in
+    the label axis (hostname columns interned for the wave classes' anti
+    terms, selector vocab) is provably inert inside the tail's
+    step_fits/step_prio_counts contractions, so the tail runs at
+    Lp = O(referenced domains), not L = O(cluster)."""
+    sc = adata.wave_strict
+    L = adata.forbid_static.shape[1]
+    use = np.zeros(L, dtype=bool)
+    if sc.any():
+        use |= adata.aff_keymask[sc].astype(bool).any(axis=(0, 1))
+        use |= adata.aff_allow[sc].astype(bool).any(axis=(0, 1))
+        use |= adata.anti_keymask[sc].astype(bool).any(axis=(0, 1))
+        use |= adata.forbid_static[sc].astype(bool).any(axis=0)
+        tgt = adata.m_anti[:, :, sc].astype(bool).any(axis=2)   # [C, A]
+        use |= (adata.anti_keymask.astype(bool)
+                & tgt[:, :, None]).any(axis=(0, 1))
+    if prio_on:
+        use |= adata.p_keymask.astype(bool).any(axis=(0, 1))
+        use |= adata.q_keymask.astype(bool).any(axis=(0, 1))
+        use |= adata.prio_static.astype(bool).any(axis=0)
+    cols = np.nonzero(use)[0]
+    if cols.size == 0:
+        cols = np.zeros(1, dtype=np.int64)  # degenerate: keep shapes sane
+    return cols
+
+
+_AFF_SLICE3 = ("aff_allow", "aff_keymask", "anti_keymask", "p_keymask",
+               "q_keymask")
+_AFF_SLICE2 = ("forbid_static", "prio_static")
+
+
+def _aff_tail_arrays(adata, snap, cols: np.ndarray):
+    """AffinityData device arrays with every domain axis sliced to the
+    tail's column projection, plus the matching `labels_aff` [N, Lp] node
+    incidence the scan contracts against (place_batch swaps it in for
+    nodes["labels"] on the affinity side only)."""
+    out = {}
+    for k in ("fail_all", "forbid_static", "aff_active", "aff_allow",
+              "aff_has_static", "aff_self", "aff_keymask", "anti_active",
+              "anti_keymask", "m_aff", "m_anti", "prio_static", "p_w",
+              "p_keymask", "mp", "q_w", "q_keymask", "mq", "sp_static",
+              "sp_cls", "sp_has", "Z", "node_has_zone", "wave_gate"):
+        a = getattr(adata, k)
+        if k in _AFF_SLICE3:
+            a = a[:, :, cols]
+        elif k in _AFF_SLICE2:
+            a = a[:, cols]
+        out[k] = jnp.asarray(a)
+    out["labels_aff"] = jnp.asarray(snap.labels[:, cols])
+    return out
+
+
 class _WaveEncoding:
     """Device-resident class encoding reused across pipelined drain chunks.
 
@@ -553,15 +635,44 @@ class _WaveEncoding:
     padded device class arrays keyed on snapshot.vocab_gen (capacity deltas
     never invalidate an encoding — only vocab growth / node-membership moves
     do, same keying as the extender's affinity-free fast lane) plus the host
-    rows the harvest fence reads."""
+    rows the harvest fence reads.
 
-    __slots__ = ("vocab_gen", "key_index", "reps", "cls_arr", "num_classes",
+    Affinity chunks (ISSUE 3) add the AffinityData for the class set — its
+    STATIC topology arrays (vs already-bound cluster pods) plus a host
+    accumulator committed_nodes [C, N] recording this engine's OWN
+    fence-accepted commits since the build, so each dispatch seeds the
+    device wave loop with exact current occupancy without ever re-walking
+    the bound-pod set. The occupancy axis is PER NODE, not per label
+    column: wave-eligible classes have singleton domains (domain == node),
+    and a [C, L] form would drag the label axis — which scales with the
+    cluster once hostname keys are interned — through every wave and fence
+    (the PR-start collapse, PROFILE_r08.md). The strict tail gets a
+    PROJECTED domain view instead (tail_cols: only columns its classes'
+    terms touch). Validity is (vocab_gen, cache.aff_seq) plus — for
+    affinity encodings, whose topology views bake label CONTENT —
+    snapshot.labels_gen: the engine folds its own assumes into aff_seq
+    expectations, so a mismatch means FOREIGN affinity churn (watch
+    add/remove, TTL expiry, forgotten bind, node relabel) and the static
+    arrays rebuild at the next dispatch."""
+
+    __slots__ = ("vocab_gen", "labels_gen", "key_index", "reps", "cls_arr",
+                 "num_classes",
                  "c_pad", "req_rows", "special", "derived", "ports_max",
-                 "raw_rows", "delta_ok")
+                 "raw_rows", "delta_ok", "adata", "wave_strict",
+                 "has_aff_pod", "fits_on", "prio_on", "aff_seq",
+                 "committed_nodes", "key_node", "static_forbid_hit",
+                 "tail_cols", "aff_wave_dev", "aff_tail_dev")
 
     def __init__(self, vocab_gen, key_index, reps, cls_arr, num_classes,
-                 c_pad, req_rows, special, derived, ports_max):
+                 c_pad, req_rows, special, derived, ports_max,
+                 adata=None, fits_on=False, prio_on=False,
+                 has_aff_pod=None, aff_seq=0, aff_wave_dev=None,
+                 aff_tail_dev=None, key_node=None, static_forbid_hit=None,
+                 tail_cols=None, n_pad=0, labels_gen=0):
         self.vocab_gen = vocab_gen
+        self.labels_gen = labels_gen  # snapshot.labels_gen at build: the
+        # topology views (key_node/static_forbid_hit/labels_aff) bake
+        # label CONTENT, which vocab_gen does not cover (delta relabel)
         self.key_index = key_index
         self.reps = reps
         self.cls_arr = cls_arr
@@ -571,6 +682,23 @@ class _WaveEncoding:
         self.special = special        # [C] bool: ports/volumes classes
         self.derived = derived        # per-class (Resource, ncpu, nmem, ports)
         self.ports_max = ports_max    # highest requested host port, or -1
+        self.adata = adata            # AffinityData at c_pad, or None
+        self.fits_on = fits_on        # required (anti-)affinity live
+        self.prio_on = prio_on        # preferred-affinity scoring live
+        self.wave_strict = adata.wave_strict if adata is not None \
+            else np.zeros(c_pad, dtype=bool)
+        self.has_aff_pod = has_aff_pod if has_aff_pod is not None \
+            else np.zeros(c_pad, dtype=bool)
+        self.aff_seq = aff_seq        # expected cache.aff_seq (own folds in)
+        # device bundles: the wave loop's per-node form and the strict
+        # tail's projected-domain form (see _wave_encoding)
+        self.aff_wave_dev = aff_wave_dev
+        self.aff_tail_dev = aff_tail_dev
+        self.key_node = key_node                    # np int8 [C, A, N]
+        self.static_forbid_hit = static_forbid_hit  # np int8 [C, N]
+        self.tail_cols = tail_cols                  # np int64 [Lp]
+        self.committed_nodes = np.zeros((c_pad, n_pad), dtype=np.int32) \
+            if fits_on else None
         # raw int64 per-class delta rows (requested cpu/mem/gpu/scratch/
         # overlay + nonzero cpu/mem) for snapshot.apply_assume_delta, and
         # which classes qualify for it (no ports/volumes/extended — those
@@ -591,10 +719,12 @@ class WaveHandle:
     the host does the previous wave's bookkeeping."""
 
     __slots__ = ("pods", "pc", "enc", "packed", "state_out", "counter_out",
-                 "nodes", "blind", "pop_ts", "dispatch_ts", "pad_floor")
+                 "nodes", "blind", "pop_ts", "dispatch_ts", "pad_floor",
+                 "committed_out", "strict_idx")
 
     def __init__(self, pods, pc, enc, packed, state_out, counter_out, nodes,
-                 blind, pop_ts, dispatch_ts, pad_floor=0):
+                 blind, pop_ts, dispatch_ts, pad_floor=0,
+                 committed_out=None, strict_idx=None):
         self.pad_floor = pad_floor
         self.pods = pods
         self.pc = pc                  # host int32 [n] class index per pod
@@ -606,6 +736,11 @@ class WaveHandle:
         self.blind = blind            # node NAMES mutated since dispatch
         self.pop_ts = pop_ts
         self.dispatch_ts = dispatch_ts
+        self.committed_out = committed_out  # device [C,N] topology occupancy
+        # pods routed to the seeded strict tail (wave_strict classes) —
+        # inactive on the wave path, placed by harvest's tail scan
+        self.strict_idx = strict_idx if strict_idx is not None \
+            else np.empty(0, dtype=np.int64)
 
     def block(self) -> None:
         """Force device completion now (sequential/debug mode): the values
@@ -1022,21 +1157,32 @@ class SchedulingEngine:
         return tuple((nm, w) for nm, w in self.priorities
                      if nm not in prio.AFFINITY_PRIORITIES)
 
-    def _wave_encoding(self, pods: Sequence[Pod]):
-        """(encoding, pod_class[n]) for a pipeline chunk, via the vocab_gen-
-        keyed reuse cache; None when any class is not wave-eligible (pod
-        (anti-)affinity or host-check routing — those chunks take the
-        classic synchronous path)."""
+    def _wave_encoding(self, pods: Sequence[Pod], infos):
+        """(encoding, pod_class[n]) for a pipeline chunk, via the
+        (vocab_gen, aff_seq)-keyed reuse cache; None when any class is not
+        wave-eligible (host-check routing, affinity slot overflow — those
+        chunks take the classic synchronous path). Affinity-bearing chunks
+        ARE wave-eligible (ISSUE 3): classes the topology counters express
+        run per-wave on device, the rest route to the seeded strict tail."""
         import dataclasses as _dc
 
-        from kubernetes_tpu.ops.affinity import _has_affinity
+        from kubernetes_tpu.ops.affinity import (
+            AffinityData,
+            _has_affinity,
+            collect_pod_pairs,
+            intern_topology_pairs,
+            spec_overflow,
+        )
         from kubernetes_tpu.ops.predicates import pod_arrays_padded
         from kubernetes_tpu.state.classes import pod_class_key
         from kubernetes_tpu.utils.trace import COUNTERS
 
         snap = self.snapshot
         enc = self._wave_enc
-        if enc is not None and enc.vocab_gen == snap.vocab_gen:
+        if enc is not None and enc.vocab_gen == snap.vocab_gen \
+                and enc.aff_seq == self.cache.aff_seq \
+                and (enc.adata is None
+                     or enc.labels_gen == snap.labels_gen):
             key_index = enc.key_index
             pc = np.empty(len(pods), dtype=np.int32)
             hit = True
@@ -1050,19 +1196,67 @@ class SchedulingEngine:
                 COUNTERS.inc("engine.wave_encode_reuse")
                 return enc, pc
         # rebuild over the union with the cached reps so chunks alternating
-        # between two class sets don't thrash the cache
+        # between two class sets don't thrash the cache. Seeding FIRST also
+        # keeps prior class indices stable, so a mid-drain rebuild leaves
+        # any in-flight handle's class rows meaningful.
         seed: List[Pod] = []
         if enc is not None and enc.vocab_gen == snap.vocab_gen:
             seed = enc.reps
+        aff_seq0 = self.cache.aff_seq
+        chunk_aff = any(_has_affinity(p) for p in seed) \
+            or any(_has_affinity(p) for p in pods)
+        cluster_aff = any(bool(i.pods_with_affinity) for i in infos.values())
+        if (chunk_aff or cluster_aff) and any(
+                spec_overflow(p, self.hard_pod_affinity_weight)
+                for p in seed + list(pods)):
+            # known slot overflow: the full build would only rediscover it
+            # after collect_pod_pairs + intern + ClassBatch + AffinityData
+            return None  # classic path (exact oracle)
+        all_pairs: list = []
+        aff_pairs: list = []
+        if chunk_aff or cluster_aff:
+            # topology keys referenced by ANY affinity term must be interned
+            # BEFORE the label matrix finalizes (the r2 symmetry bug), same
+            # ordering contract as schedule()
+            all_pairs, aff_pairs = collect_pod_pairs(infos)
+            intern_topology_pairs(snap, seed + list(pods), aff_pairs)
         batch = ClassBatch(seed + list(pods), snap)
         n_cls = batch.num_classes
-        if any(_has_affinity(p) for p in batch.reps):
-            return None
         rb = batch.reps_batch
         if rb.needs_host_check[:n_cls].any():
             return None
-        COUNTERS.inc("engine.wave_encode_build")
         c_pad = bucket(n_cls + 1)
+        adata = None
+        fits_on = prio_on = False
+        has_aff_pod = None
+        aff_wave_dev = aff_tail_dev = None
+        key_node = static_forbid_hit = tail_cols = None
+        if chunk_aff or cluster_aff:
+            COUNTERS.inc("engine.wave_aff_build")
+            adata = AffinityData(batch.reps, snap, all_pairs, aff_pairs,
+                                 (), self.hard_pod_affinity_weight,
+                                 c_pad=c_pad)
+            if adata.overflow[:n_cls].any():
+                return None  # slot overflow -> classic path (exact oracle)
+            w_ip = sum(w for nm, w in self.priorities
+                       if nm == "InterPodAffinityPriority")
+            fits_on = adata.fits_needed
+            prio_on = bool(w_ip) and adata.prio_needed
+            has_aff_pod = np.zeros(c_pad, dtype=bool)
+            for c, rep in enumerate(batch.reps):
+                has_aff_pod[c] = _has_affinity(rep)
+            if fits_on:
+                key_node, static_forbid_hit = _aff_node_views(adata, snap)
+                aff_wave_dev = {
+                    "m_anti": jnp.asarray(adata.m_anti),
+                    "key_node": jnp.asarray(key_node),
+                    "static_forbid": jnp.asarray(static_forbid_hit),
+                    "wave_gate": jnp.asarray(adata.wave_gate),
+                }
+            if fits_on or prio_on:
+                tail_cols = _aff_tail_cols(adata, prio_on)
+                aff_tail_dev = _aff_tail_arrays(adata, snap, tail_cols)
+        COUNTERS.inc("engine.wave_encode_build")
         cls_arr = pod_arrays_padded(rb, c_pad)
         key_index = {pod_class_key(rep): c
                      for c, rep in enumerate(batch.reps)}
@@ -1078,7 +1272,13 @@ class SchedulingEngine:
         reps = [_dc.replace(p) for p in batch.reps]
         self._wave_enc = _WaveEncoding(
             snap.vocab_gen, key_index, reps, cls_arr, n_cls, c_pad,
-            rb.req[:n_cls].astype(np.int64), special, derived, ports_max)
+            rb.req[:n_cls].astype(np.int64), special, derived, ports_max,
+            adata=adata, fits_on=fits_on, prio_on=prio_on,
+            has_aff_pod=has_aff_pod, aff_seq=aff_seq0,
+            aff_wave_dev=aff_wave_dev, aff_tail_dev=aff_tail_dev,
+            key_node=key_node, static_forbid_hit=static_forbid_hit,
+            tail_cols=tail_cols, n_pad=snap.valid.shape[0],
+            labels_gen=snap.labels_gen)
         return self._wave_enc, batch.pod_class[len(seed):].copy()
 
     def dispatch_waves(self, pods: Sequence[Pod],
@@ -1087,10 +1287,15 @@ class SchedulingEngine:
         the device computes while the caller does the previous wave's
         bookkeeping (JAX async dispatch). The chunk is evaluated against the
         snapshot as of NOW, which is blind to the still-unharvested wave's
-        commits; harvest_waves' fence re-validates. Returns None when the
-        chunk needs the classic path (policy algorithms, workloads/spreading,
-        any pod affinity in cluster or chunk, host-check classes) — the
-        caller must then flush the pipeline and run the synchronous engine."""
+        commits; harvest_waves' fence re-validates (capacity AND topology
+        occupancy). Required (anti-)affinity chunks are wave-eligible
+        (ISSUE 3): counter-expressible classes re-evaluate their masks per
+        wave on device, inexpressible ones ride as inactive rows and the
+        harvest finishes them via the seeded strict tail. Returns None only
+        when the chunk needs the classic path (policy algorithms,
+        workloads/spreading, host-check classes, affinity slot overflow) —
+        the caller must then flush the pipeline and run the synchronous
+        engine."""
         import time as _time
 
         from kubernetes_tpu.utils.trace import COUNTERS, timed_span
@@ -1103,10 +1308,7 @@ class SchedulingEngine:
             return None
         with timed_span("pipeline.dispatch"):
             infos = self._refresh()
-            for info in infos.values():
-                if info.pods_with_affinity:
-                    return None
-            out = self._wave_encoding(pods)
+            out = self._wave_encoding(pods, infos)
             if out is None:
                 return None
             enc, pc = out
@@ -1125,9 +1327,42 @@ class SchedulingEngine:
                               nodes["pd_present"], nodes["pd_counts"])
             counter = self._rr_chain if self._rr_chain is not None \
                 else jnp.uint32(self.rr.counter)
-            packed, state_out = waves.waves_loop(
-                enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
-                self._kernel_priorities(), 64)
+            extra = None
+            if enc.prio_on:
+                # preferred-affinity scores, frozen against the encoding's
+                # static topology view (the wave-mode approximation, same
+                # as the classic _run_wave's batch-frozen extra_score) —
+                # over the tail's projected domain axis, which covers every
+                # priority-side keymask column by construction
+                w_ip = sum(w for nm, w in self.priorities
+                           if nm == "InterPodAffinityPriority")
+                extra = waves.frozen_affinity_scores(
+                    enc.cls_arr, nodes, state, enc.aff_tail_dev, (w_ip, 0))
+            strict_idx = np.empty(0, dtype=np.int64)
+            committed_out = None
+            if enc.fits_on:
+                ser = enc.wave_strict[pc]
+                strict_idx = np.nonzero(ser)[0]
+                act = np.zeros(p_pad, dtype=bool)
+                act[:n] = ~ser
+                # jnp.array, NOT jnp.asarray: the CPU backend zero-copies
+                # aligned numpy uploads, and the harvest FOLD mutates
+                # committed_nodes in place while this wave may still be
+                # executing against it asynchronously (the same race
+                # _nodes_on_device documents)
+                packed, state_out, committed_out = waves.waves_loop(
+                    enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
+                    self._kernel_priorities(), 64, extra_score=extra,
+                    aff=enc.aff_wave_dev,
+                    committed0=jnp.array(enc.committed_nodes),
+                    active0=jnp.asarray(act))
+                if strict_idx.size:
+                    COUNTERS.inc("engine.affinity_strict_tail",
+                                 int(strict_idx.size))
+            else:
+                packed, state_out = waves.waves_loop(
+                    enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
+                    self._kernel_priorities(), 64, extra_score=extra)
             counter_out = packed[3 * p_pad].astype(jnp.uint32)
             self._rr_chain = counter_out
             blind: set = set()
@@ -1135,7 +1370,9 @@ class SchedulingEngine:
             COUNTERS.inc("engine.wave_dispatch")
             return WaveHandle(list(pods), pc, enc, packed, state_out,
                               counter_out, nodes, blind, pop_ts,
-                              _time.monotonic(), self.wave_pad_floor)
+                              _time.monotonic(), self.wave_pad_floor,
+                              committed_out=committed_out,
+                              strict_idx=strict_idx)
 
     def harvest_waves(self, handle: WaveHandle) -> WaveHarvest:
         """Block on one wave's device→host sync, fence its placements
@@ -1148,7 +1385,7 @@ class SchedulingEngine:
         lost a capacity race, they are not unschedulable."""
         import time as _time
 
-        from kubernetes_tpu.utils.trace import timed_span
+        from kubernetes_tpu.utils.trace import COUNTERS, timed_span
 
         # the fence below compares against snapshot arrays — fold in any
         # commits/events since the last dispatch (hinted: near-free when
@@ -1166,23 +1403,68 @@ class SchedulingEngine:
         fc = packed_h[p_pad:p_pad + n].copy()
         act = packed_h[2 * p_pad:2 * p_pad + n].astype(bool)
         counter_h = int(np.uint32(packed_h[3 * p_pad]))
-        if act.any():
-            # pathological interleaving exhausted max_waves — finish the
-            # stragglers via the strict scan against the wave's final device
-            # state (same fallback as waves.place_waves). The straggler RR
-            # draws land after the next wave's (already-chained) counter —
-            # deterministic in both pipelined and sequential modes, since
-            # dispatch k+1 always precedes harvest k in either.
-            idx = np.nonzero(act)[0]
-            n_strag = len(idx)
-            pcs = np.full(bucket(n_strag), enc.num_classes, dtype=np.int32)
-            pcs[:n_strag] = handle.pc[idx]
+        tail_idx = np.nonzero(act)[0]
+        straggler_idx = np.empty(0, dtype=np.int64)
+        if enc.adata is not None and tail_idx.size:
+            # max-waves stragglers may NOT ride the seeded tail in an
+            # affinity chunk: the tail's domain projection carries only
+            # the wave_strict classes' columns (_aff_tail_cols), so a
+            # straggler's own anti terms — and the symmetry sources
+            # targeting its labels — would be invisible to the scan.
+            # Requeue without backoff instead; the next dispatch re-waves
+            # them against the updated occupancy (each re-dispatch of the
+            # bottleneck commits at least one pod, so this terminates).
+            straggler_idx = tail_idx
+            tail_idx = np.empty(0, dtype=np.int64)
+            COUNTERS.inc("engine.affinity_straggler_requeues",
+                         int(straggler_idx.size))
+        if handle.strict_idx.size:
+            # wave_strict classes (own required affinity, multi-node-domain
+            # anti shapes, fail_all) never entered the waves: finish them —
+            # together with any max_waves stragglers (affinity-free
+            # encodings only, see above) — via ONE seeded strict scan, in
+            # FIFO order, against the wave's final device state AND its
+            # final topology occupancy, exactly what the classic
+            # _run_wave's strict branch would have seen.
+            tail_idx = np.unique(np.concatenate([tail_idx,
+                                                 handle.strict_idx]))
+        if tail_idx.size:
+            # the straggler/tail RR draws land after the next wave's
+            # (already-chained) counter — deterministic in both pipelined
+            # and sequential modes, since dispatch k+1 always precedes
+            # harvest k in either.
+            n_tail = len(tail_idx)
+            pcs = np.full(bucket(n_tail), enc.num_classes, dtype=np.int32)
+            pcs[:n_tail] = handle.pc[tail_idx]
+            aff_arrays = None
+            aff_init = None
+            aff_mode = (False, False, False)
+            tail_prios = self._kernel_priorities()
+            if enc.adata is not None and (enc.fits_on or enc.prio_on):
+                aff_arrays = enc.aff_tail_dev
+                committed0 = handle.committed_out.astype(jnp.int32) \
+                    if handle.committed_out is not None else jnp.zeros(
+                        (enc.c_pad, int(handle.nodes["alloc"].shape[0])),
+                        dtype=jnp.int32)
+                # project the wave's per-node occupancy onto the tail's
+                # domain columns: commdom[c, j] = committed @ labels[:, j]
+                # (device GEMM over the SMALL projected axis)
+                commdom0 = jnp.matmul(
+                    committed0, aff_arrays["labels_aff"].astype(jnp.int32),
+                    preferred_element_type=jnp.int32)
+                aff_init = (commdom0, committed0, committed0.sum(axis=1))
+                aff_mode = (enc.fits_on, enc.prio_on, False)
+                if enc.prio_on:
+                    tail_prios = tuple(
+                        (nm, w) for nm, w in self.priorities
+                        if nm != "SelectorSpreadPriority")
+            COUNTERS.inc("engine.wave_tail_dispatch")
             sel_s, fc_s, _st, rr_d = gather_place_batch(
                 enc.cls_arr, jnp.asarray(pcs), handle.nodes,
-                handle.state_out, jnp.uint32(counter_h),
-                self._kernel_priorities())
-            sel[idx] = np.asarray(sel_s)[:n_strag]
-            fc[idx] = np.asarray(fc_s)[:n_strag]
+                handle.state_out, jnp.uint32(counter_h), tail_prios,
+                aff=aff_arrays, aff_mode=aff_mode, aff_init=aff_init)
+            sel[tail_idx] = np.asarray(sel_s)[:n_tail]
+            fc[tail_idx] = np.asarray(fc_s)[:n_tail]
             counter_h = int(rr_d)
         if self._rr_chain is handle.counter_out:
             self._rr_chain = None
@@ -1190,16 +1472,18 @@ class SchedulingEngine:
         self._blind_listeners.remove(handle.blind)
 
         pods = handle.pods
+        strag = set(straggler_idx.tolist())
         unschedulable = [(pods[i], int(fc[i]))
-                         for i in np.nonzero(sel < 0)[0].tolist()]
+                         for i in np.nonzero(sel < 0)[0].tolist()
+                         if i not in strag]
         bound: List[Pod] = []
-        conflicts: List[Pod] = []
+        conflicts: List[Pod] = [pods[i] for i in straggler_idx.tolist()]
         placed_idx = np.nonzero(sel >= 0)[0]
         if placed_idx.size:
             with timed_span("pipeline.fence"):
                 acc_idx, acc_node, acc_cls, conflict_idx = \
                     self._fence(handle, sel, placed_idx)
-            conflicts = [pods[i] for i in conflict_idx]
+            conflicts += [pods[i] for i in conflict_idx]
             if acc_idx.size:
                 names = snap.node_names
                 groups = []
@@ -1237,15 +1521,34 @@ class SchedulingEngine:
                                    if nm not in dirty_names]
                     for s in self._blind_listeners:
                         s.update(blind_names)
+                if enc.adata is not None and enc is self._wave_enc:
+                    # fold fence-accepted commits into the encoding's
+                    # cumulative per-node topology occupancy — the host
+                    # mirror the next dispatch seeds the device loop from —
+                    # and into its aff_seq expectation (assume_pods_grouped
+                    # just bumped cache.aff_seq once per affinity pod). A
+                    # stale enc skips both: its aff_seq mismatch forces the
+                    # next dispatch to rebuild from the live NodeInfos,
+                    # which already contain these assumes.
+                    if enc.committed_nodes is not None:
+                        np.add.at(enc.committed_nodes, (acc_cls, acc_node),
+                                  1)
+                    enc.aff_seq += int(enc.has_aff_pod[acc_cls].sum())
                 bound = [pods[i] for i in sorted(acc_l)]
         return WaveHarvest(bound, conflicts, unschedulable, t_block)
 
     def _fence(self, handle: WaveHandle, sel: np.ndarray,
                placed_idx: np.ndarray):
         """Vectorized re-validation of a blind wave's placements against
-        current occupancy. Returns (accepted original indices grouped by
-        (node, class) with FIFO order inside each node, their node indices,
-        their class indices, conflict original indices in FIFO order)."""
+        current occupancy: exact prefix-capacity + pod-count math, plus the
+        TOPOLOGY mirror (ISSUE 3) — required (anti-)affinity placements
+        made against the pre-k occupancy re-check against the engine's
+        post-k commdom and requeue conservatively instead of colliding.
+        Returns (accepted original indices grouped by (node, class) with
+        FIFO order inside each node, their node indices, their class
+        indices, conflict original indices in FIFO order)."""
+        from kubernetes_tpu.utils.trace import COUNTERS
+
         snap = self.snapshot
         enc = handle.enc
         node_of = sel[placed_idx]
@@ -1263,10 +1566,14 @@ class SchedulingEngine:
         req = enc.req_rows[cls_rows]                      # [m, R] int64
         csum = np.cumsum(req, axis=0)
         prefix = csum - (csum[starts] - req[starts])[grp]  # incl., per node
-        alloc = snap.alloc[gnode].astype(np.int64)
-        used = snap.requested[gnode].astype(np.int64)
+        # slice snapshot columns to the ENCODING's resource width: vocab
+        # growth between dispatch and harvest appends columns these classes
+        # cannot request (their rows predate the column), so ignoring the
+        # suffix is exact — and indexing with the live width would tear
+        ncols = enc.req_rows.shape[1]
+        alloc = snap.alloc[gnode][:, :ncols].astype(np.int64)
+        used = snap.requested[gnode][:, :ncols].astype(np.int64)
         avail = alloc - used
-        ncols = alloc.shape[1]
         plain = [c for c in range(ncols) if c not in (R_SCRATCH, R_OVERLAY)]
         ok = (prefix[:, plain] <= avail[:, plain]).all(axis=1)
         # storage fallback (predicates.go:590-604): overlay-less nodes charge
@@ -1292,5 +1599,91 @@ class SchedulingEngine:
                 if i >= 0:
                     bl[i] = True
             ok &= ~(spc & bl[gnode])
+        if enc.fits_on and enc.adata is not None:
+            aff_bad = self._fence_affinity(enc, cls_rows, gnode)
+            if aff_bad is not None:
+                n_rej = int((aff_bad & ok).sum())
+                if n_rej:
+                    COUNTERS.inc("engine.affinity_fence_requeues", n_rej)
+                ok &= ~aff_bad
         return (gidx[ok], gnode[ok], cls_rows[ok],
                 sorted(gidx[~ok].tolist()))
+
+    def _fence_affinity(self, enc: "_WaveEncoding", cls_rows: np.ndarray,
+                        gnode: np.ndarray) -> Optional[np.ndarray]:
+        """Topology half of the fence: re-evaluate required (anti-)affinity
+        for the wave's placements against the engine's CURRENT cumulative
+        occupancy (every prior harvest folded). Exactly mirrors the device
+        mask (waves._wave_aff_mask) plus the allow side for strict-tail
+        classes; in-harvest interactions need no re-check — they ran inside
+        one device program against a shared carry. Returns a bool [m] "must
+        requeue" mask, or None when no placement is affinity-relevant. A
+        STALE encoding (foreign affinity churn since dispatch, detected via
+        cache.aff_seq) conservatively requeues every relevant placement —
+        the retry re-dispatches against a rebuilt encoding."""
+        ad = enc.adata
+        rel = ad.wave_relevant[cls_rows]
+        if not rel.any():
+            return None
+        if enc is not self._wave_enc or enc.aff_seq != self.cache.aff_seq \
+                or enc.labels_gen != self.snapshot.labels_gen:
+            return rel.copy()
+        snap = self.snapshot
+        cn = enc.committed_nodes.astype(np.float64)           # [C, N]
+        C_, A_ = ad.m_anti.shape[:2]
+        m2 = ad.m_anti.reshape(C_ * A_, C_).astype(np.float64)
+        kn = enc.key_node.reshape(C_ * A_, -1)                # [C*A, N]
+        # anti side, per-node form (float64 GEMMs — exact for these counts)
+        occ = (m2 @ cn).reshape(C_, A_, -1)
+        own_forb = (occ * enc.key_node).sum(axis=1)           # [C, N]
+        sym = (m2.T @ (kn * np.repeat(cn, A_, axis=0)))       # [C, N]
+        forb = own_forb + sym + enc.static_forbid_hit
+        aff_bad = forb[cls_rows, gnode] > 0
+        cols = enc.tail_cols
+        lab_p = cd = None
+        if cols is not None and cols.size:
+            lab_p = snap.labels[:, cols].astype(np.float64)   # [N, Lp]
+            cd = cn @ lab_p                                   # [C, Lp]
+            # anti + symmetry over the PROJECTED DOMAIN columns: the
+            # per-node form above is exact only for singleton domains
+            # (the wave-eligibility invariant); a strict-tail class's
+            # zone-scoped term forbids the whole DOMAIN, and a blind
+            # placement can land on a DIFFERENT node of a domain another
+            # chunk's harvest just occupied. Multi-domain terms — own and
+            # symmetry sources — always project into tail_cols
+            # (_aff_tail_cols includes wave_strict classes' anti rows and
+            # every term targeting them), so this closes the window the
+            # per-node mirror cannot see. Hostname columns double-count
+            # with the per-node form; harmless in a bool requeue mask.
+            m3 = ad.m_anti.astype(np.float64)
+            kp = ad.anti_keymask[:, :, cols].astype(np.float64)
+            occ_dom = np.einsum("cad,dl->cal", m3, cd)
+            own_dom = (occ_dom * kp).sum(axis=1)              # [C, Lp]
+            sym_dom = np.einsum("dac,dal->cl", m3,
+                                kp * cd[:, None, :])          # [C, Lp]
+            aff_bad |= np.einsum("ml,ml->m",
+                                 (own_dom + sym_dom)[cls_rows],
+                                 lab_p[gnode]) > 0
+        own = ad.aff_active.any(axis=1)
+        own_rows = np.nonzero(own[cls_rows])[0]
+        if own_rows.size and lab_p is not None:
+            # allow side (strict-tail classes only), over the tail's
+            # projected domain columns: a blind-window bootstrap or
+            # co-location choice re-validates against domains occupied NOW
+            # — monotone growth can only widen the allow set, so the one
+            # true hazard is two chunks bootstrapping the same group into
+            # different domains
+            c_r = cls_rows[own_rows]
+            lab_r = lab_p[gnode[own_rows]]
+            m_aff = ad.m_aff.astype(np.float64)
+            occp = (np.einsum("csd,dl->csl", m_aff, cd)
+                    * ad.aff_keymask[:, :, cols])
+            dyn = np.einsum("msl,ml->ms", occp[c_r], lab_r) > 0
+            stat = np.einsum(
+                "msl,ml->ms",
+                ad.aff_allow[c_r][:, :, cols].astype(np.float64), lab_r) > 0
+            dyn_total = np.einsum("csd,d->cs", m_aff, cn.sum(axis=1))
+            boot = ad.aff_self & ~ad.aff_has_static & (dyn_total == 0)
+            ok_terms = (~ad.aff_active[c_r]) | stat | dyn | boot[c_r]
+            aff_bad[own_rows] |= ~ok_terms.all(axis=1)
+        return aff_bad & rel

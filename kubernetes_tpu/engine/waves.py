@@ -46,7 +46,7 @@ storm is C=1: one [1,N] score row + O(P) index math per wave.
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple, Tuple
+from typing import Dict, NamedTuple, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -220,23 +220,55 @@ def _dyn_at(total_cpu: jnp.ndarray, total_mem: jnp.ndarray,
     return out
 
 
+def _wave_aff_mask(aff: Arrays, committed: jnp.ndarray) -> jnp.ndarray:
+    """Per-wave required-anti-affinity mask [C, N] from the PER-NODE
+    occupancy carry (ISSUE 3). Wave-eligible anti classes have singleton
+    topology domains (AffinityData.wave_strict routes everything else to
+    the seeded strict tail), so domain occupancy IS per-node occupancy —
+    the mask never touches the label axis, whose width scales with the
+    cluster when hostname keys are interned (a [C, L] form here cost
+    ~100x at 5k nodes; see PROFILE_r08.md). A node n is forbidden for
+    class c when it carries (a) a static forbid (existing pods' matching
+    anti terms — precomputed [C, N] at encoding build), (b) a committed
+    pod matching one of c's own required anti terms whose key n has, or
+    (c) a committed pod of class d whose anti term matches c (the
+    symmetry direction, predicates.go:1146) under a key n has.
+    key_node[c, a, n] = node n has term (c, a)'s topology key — the
+    singleton-domain analog of the keymask."""
+    m_anti = aff["m_anti"].astype(jnp.int32)           # [C, A, C]
+    kn = aff["key_node"].astype(jnp.int32)             # [C, A, N]
+    # own anti: committed pods matching (c, a) resident on n, key present
+    occ = jnp.einsum("cad,dn->can", m_anti, committed)
+    own = (occ * kn).sum(axis=1)                       # [C, N]
+    # symmetry: committed pods of class d at n whose term a matches c
+    sym = jnp.einsum("dac,dan->cn", m_anti, kn * committed[:, None, :])
+    forb = own + sym + aff["static_forbid"].astype(jnp.int32)
+    return forb == 0
+
+
 def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
                pre: Arrays, pod_class: jnp.ndarray, active: jnp.ndarray,
                counter: jnp.ndarray,
                priorities: Tuple[Tuple[str, int], ...],
+               aff: Arrays = None,
+               committed: jnp.ndarray = None,
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
-                          NodeState, jnp.ndarray]:
+                          NodeState, jnp.ndarray, jnp.ndarray]:
     """One wave (pure traceable body — jitted standalone as wave_step and
     iterated on device by waves_loop). `pre` carries the hoisted
-    state-independent tensors (see precompute). Returns (selected [P]
-    (-1 = no fit), accepted [P] bool, fit_count [P] int32, new state,
-    new counter)."""
+    state-independent tensors (see precompute). With `aff` given, the
+    required-anti mask is re-evaluated against the per-node occupancy
+    carry each wave and commits update it (the on-device topology
+    AssumePod — ISSUE 3). Returns (selected [P] (-1 = no fit), accepted
+    [P] bool, fit_count [P] int32, new state, new counter, new committed)."""
     P = pod_class.shape[0]
     N = nodes["alloc"].shape[0]
     iota = jnp.arange(P, dtype=jnp.int32)
     idx_n = jnp.arange(N, dtype=jnp.int32)
 
     fits = pre["static_fit"] & _dynamic_fits(cls, nodes, state)  # [C,N]
+    if aff is not None:
+        fits = fits & _wave_aff_mask(aff, committed)
     fitcnt = fits.sum(axis=1).astype(jnp.int32)  # [C]
     scores = _wave_scores(cls, nodes, state, pre, fits, priorities)
     masked = jnp.where(fits, scores, jnp.int32(-1))
@@ -280,9 +312,17 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     cap = _class_capacity(cls, nodes, state)  # [C,N]
     safe_sel = jnp.maximum(s_sel, 0)
     cap_lim = jnp.minimum(cap[s_class, safe_sel], K_WAVE)
-    special = ((cls["ports"][:, 0] >= 0)
-               | (cls["vol_hard"].sum(axis=1) + cls["vol_ro"].sum(axis=1)
-                  + cls["pd_req"].sum(axis=1) > 0))[s_class]
+    special_cls = ((cls["ports"][:, 0] >= 0)
+                   | (cls["vol_hard"].sum(axis=1) + cls["vol_ro"].sum(axis=1)
+                      + cls["pd_req"].sum(axis=1) > 0))
+    if aff is not None:
+        # self-anti classes commit at most one pod per node per wave: the
+        # second pod of the same FIFO run would land in a domain its first
+        # just made forbidden (singleton domains make per-node the exact
+        # granularity; AffinityData.wave_gate). The specials' port/volume
+        # scatters below are no-ops for these classes (no ports, no vols).
+        special_cls = special_cls | aff["wave_gate"]
+    special = special_cls[s_class]
     # score-aware window: node score after r commits of this class must stay
     # >= the frozen runner-up (max score over non-tie nodes). Overflow-safe:
     # r_eff*nz is bounded either by cap (r*req <= alloc per resources_fit)
@@ -353,7 +393,15 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
 
     new_state = NodeState(requested, nonzero, pod_count, port_bitmap,
                           vol_present, vol_rw, pd_present, pd_counts)
-    return sel, accepted, fc, new_state, new_counter
+    if aff is not None:
+        # topology-occupancy commit: each accepted pod ticks its (class,
+        # node) cell, making it visible to the NEXT wave's mask (and to
+        # the seeded strict tail / harvest fence afterwards). Scatter-add
+        # accumulates duplicate (class, node) pairs; rejected rows land on
+        # the dropped N column.
+        committed = committed.at[
+            s_class, jnp.where(acc_s, s_sel, N)].add(gain, mode="drop")
+    return sel, accepted, fc, new_state, new_counter, committed
 
 
 @functools.partial(jax.jit, static_argnames=("priorities",))
@@ -361,7 +409,7 @@ def wave_step(cls, nodes, state, pod_class, active, counter, priorities):
     """Standalone single wave (tests/debugging); waves_loop is the fast path."""
     pre = precompute(cls, nodes, priorities)
     return _wave_once(cls, nodes, state, pre, pod_class, active, counter,
-                      priorities)
+                      priorities)[:5]
 
 
 @functools.partial(jax.jit, static_argnames=("weights",))
@@ -372,9 +420,10 @@ def frozen_affinity_scores(cls: Arrays, nodes: Arrays, state: NodeState,
     batch-frozen cluster state, for the wave engine's additive static score
     (weights = (w_interpod, w_spread)). Wave semantics score these once per
     BATCH, not per wave — within-batch drift of preferred-affinity/spread
-    counts is a documented wave-mode approximation; classes with REQUIRED
-    (anti-)affinity never take this path (AffinityData.serialize routes
-    them to the strict scan). Pure int32 — no x64 required."""
+    counts is a documented wave-mode approximation that also applies to
+    required-(anti-)affinity classes riding the waves (ISSUE 3) — only the
+    REQUIRED fit side is re-evaluated per wave; the preferred score stays
+    batch-frozen. Pure int32 — no x64 required."""
     from kubernetes_tpu.ops import affinity as aff_ops
 
     w_ip, w_sp = weights
@@ -385,7 +434,10 @@ def frozen_affinity_scores(cls: Arrays, nodes: Arrays, state: NodeState,
         # computed with the node axis sharded over a mesh (test_mesh.py),
         # and a pallas_call is a custom call the SPMD partitioner cannot
         # split. The single-chip evaluate_pod path uses the kernel.
-        pre = aff_ops.precompute_static(aff, nodes["labels"])
+        # labels_aff (when present) is the projected domain incidence the
+        # caller's aff arrays are sliced to (engine _aff_tail_arrays).
+        lab = aff["labels_aff"] if "labels_aff" in aff else nodes["labels"]
+        pre = aff_ops.precompute_static(aff, lab)
         extra = extra + w_ip * aff_ops.interpod_score(pre["prio_counts"],
                                                       fits)
     if w_sp:
@@ -400,42 +452,67 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
                priorities: Tuple[Tuple[str, int], ...],
                max_waves: int = 32,
                extra_score: jnp.ndarray = None,
-               ) -> Tuple[jnp.ndarray, NodeState]:
+               aff: Arrays = None,
+               committed0: jnp.ndarray = None,
+               active0: jnp.ndarray = None,
+               ) -> Union[Tuple[jnp.ndarray, NodeState],
+                          Tuple[jnp.ndarray, NodeState, jnp.ndarray]]:
     """The whole wave iteration as ONE device program (lax.while_loop over
     _wave_once) — a single dispatch + a single [3P+2] host fetch regardless
     of wave count; device sync latency dominates small fetches on a tunneled
     TPU, so per-wave host round-trips would swamp the kernel time.
 
-    Returns (packed, final state) with packed = [selected(P), fit_count(P),
-    still_active(P), counter, waves_used]; still_active pods exhausted
-    max_waves (the host finishes them via the strict scan)."""
+    With `aff` (ISSUE 3): committed0 seeds the [C, N] per-node topology
+    occupancy carry (the engine's cumulative fence-accepted commits, so
+    earlier chunks' placements are visible) and the per-wave mask +
+    occupancy commit run inside the loop; active0 masks out pods routed to
+    the seeded strict tail (AffinityData.wave_strict) — they exit with
+    selected = -1 and still_active = 0 and the harvest places them.
+
+    Returns (packed, final state[, committed]) with packed =
+    [selected(P), fit_count(P), still_active(P), counter, waves_used];
+    still_active pods exhausted max_waves (the host finishes them via the
+    strict scan). The trailing occupancy is returned only when `aff` is
+    given."""
     P = pod_class.shape[0]
     pre = precompute(cls, nodes, priorities)  # hoisted: while_loop bodies
     # re-execute everything every iteration; XLA cannot hoist for us
     if extra_score is not None:  # batch-frozen spread/interpod scores
         pre = dict(pre, static_score=pre["static_score"] + extra_score)
+    if aff is not None:
+        committed0 = committed0.astype(jnp.int32)
+    else:  # inert carry keeps ONE loop structure for both trace variants
+        committed0 = jnp.zeros((1, 1), dtype=jnp.int32)
 
     def cond(carry):
-        _, active, _, _, _, w = carry
+        _, active, _, _, _, _, w = carry
         return (w < max_waves) & active.any()
 
     def body(carry):
-        state, active, counter, fsel, ffc, w = carry
-        sel, accepted, fc, state2, counter2 = _wave_once(
-            cls, nodes, state, pre, pod_class, active, counter, priorities)
+        state, active, counter, fsel, ffc, committed, w = carry
+        sel, accepted, fc, state2, counter2, committed2 = _wave_once(
+            cls, nodes, state, pre, pod_class, active, counter, priorities,
+            aff=aff, committed=committed)
+        if aff is None:
+            committed2 = committed
         placed = active & accepted
         fsel = jnp.where(placed, sel, fsel)
         ffc = jnp.where(active, fc, ffc)
         active2 = active & ~accepted & (sel >= 0)
-        return (state2, active2, counter2, fsel, ffc, w + 1)
+        return (state2, active2, counter2, fsel, ffc, committed2, w + 1)
 
-    init = (state, jnp.ones(P, dtype=bool), counter,
+    init = (state,
+            jnp.ones(P, dtype=bool) if active0 is None else active0,
+            counter,
             jnp.full(P, -1, dtype=jnp.int32), jnp.zeros(P, dtype=jnp.int32),
-            jnp.int32(0))
-    state, active, counter, fsel, ffc, w = lax.while_loop(cond, body, init)
+            committed0, jnp.int32(0))
+    (state, active, counter, fsel, ffc, committed, w) = \
+        lax.while_loop(cond, body, init)
     packed = jnp.concatenate([fsel, ffc, active.astype(jnp.int32),
                               counter.astype(jnp.int32)[None], w[None]])
-    return packed, state
+    if aff is None:
+        return packed, state
+    return packed, state, committed
 
 
 def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,

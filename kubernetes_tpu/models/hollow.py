@@ -14,8 +14,12 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
     Node,
     Pod,
+    PodAffinity,
+    PodAffinityTerm,
     Taint,
     TaintEffect,
     Toleration,
@@ -95,6 +99,57 @@ def affinity_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
     return out
 
 
+HOSTNAME_KEY = "kubernetes.io/hostname"
+ZONE_KEY = "failure-domain.beta.kubernetes.io/zone"
+
+
+def mixed_affinity_pods(n: int, seed: int = 0,
+                        namespace: str = "bench") -> List[Pod]:
+    """ISSUE 3 headline mix: a density drain where required pod
+    (anti-)affinity is a first-class share of the load instead of a
+    corner case.
+
+      15%  "one replica per host": required anti-affinity on the hostname
+           key against the pod's own app label (6 apps) — the shape the
+           wave path's per-topology occupancy counters absorb.
+       2%  "pack into one zone": required affinity on the zone key against
+           the pod's own app (4 apps) — zone domains span many nodes and
+           the group bootstraps from nothing, so these route to the
+           seeded strict tail, never the throughput path.
+       5%  plain pods LABELED like the anti apps — anti-affinity TARGETS:
+           their placements must respect the symmetry check against every
+           committed iso pod (predicates.go:1146) per wave.
+      78%  plain density pods (distinct app labels, no interactions).
+    """
+    out: List[Pod] = []
+    for i in range(n):
+        r = i % 100
+        if r < 15:
+            app = f"iso-{r % 6}"
+            p = make_pod(f"mixed-iso-{i}", namespace=namespace, cpu=100,
+                         memory=256 * Mi, labels={"app": app})
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": app}),
+                    namespaces=[], topology_key=HOSTNAME_KEY)]))
+        elif r < 17:
+            app = f"pack-{i % 4}"
+            p = make_pod(f"mixed-pack-{i}", namespace=namespace, cpu=100,
+                         memory=256 * Mi, labels={"app": app})
+            p.affinity = Affinity(pod_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": app}),
+                    namespaces=[], topology_key=ZONE_KEY)]))
+        elif r < 22:
+            p = make_pod(f"mixed-tgt-{i}", namespace=namespace, cpu=100,
+                         memory=500 * Mi, labels={"app": f"iso-{r % 6}"})
+        else:
+            p = make_pod(f"mixed-web-{i}", namespace=namespace, cpu=100,
+                         memory=500 * Mi, labels={"app": f"web-{i % 8}"})
+        out.append(p)
+    return out
+
+
 def hetero_gpu_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
     """Config 5: GPU/extended-resource requests + tolerations on 10k
     heterogeneous nodes."""
@@ -147,6 +202,7 @@ PROFILES = {
     "density": density_pods,
     "binpack": binpack_pods,
     "affinity": affinity_pods,
+    "mixed_affinity": mixed_affinity_pods,
     "hetero": hetero_gpu_pods,
     "gang": gang_pods,
 }

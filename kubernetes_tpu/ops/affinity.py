@@ -106,9 +106,19 @@ def _out_terms(pod: Pod, hard_weight: int) -> List[Tuple[int, object]]:
 
 
 def _has_affinity(pod: Pod) -> bool:
-    a = pod.affinity
-    return a is not None and (a.pod_affinity is not None
-                              or a.pod_anti_affinity is not None)
+    return pod.has_pod_affinity()
+
+
+def spec_overflow(pod: Pod, hard_weight: int) -> bool:
+    """True iff this pod's term counts exceed the static slot shapes — the
+    spec-only precondition of ``AffinityData.overflow`` (domain-independent:
+    no cluster state consulted). Callers use it to bail to the classic path
+    BEFORE paying collect_pod_pairs/intern/ClassBatch/AffinityData for a
+    chunk whose verdict is already known to be overflow."""
+    return (len(_own_terms(pod, anti=False)) > S_REQ_AFF
+            or len(_own_terms(pod, anti=True)) > S_REQ_ANTI
+            or len(_pref_terms(pod)) > S_PREF
+            or len(_out_terms(pod, hard_weight)) > S_OUT)
 
 
 def _term_topology_keys(pod: Pod) -> List[str]:
@@ -283,7 +293,7 @@ class AffinityData:
                             self.forbid_static[c, d] = 1
 
         # ---------------- priority side ---------------------------------
-        any_prio = any(_has_affinity(p) for p, _ in aff_pods)
+        any_prio = False
         for c, rep in enumerate(reps):
             prefs = _pref_terms(rep)
             if len(prefs) > S_PREF:
@@ -365,7 +375,16 @@ class AffinityData:
         self.node_has_zone = zone_id >= 0
 
         self.fits_needed = any_required or self.fail_all.any()
-        self.prio_needed = any_prio
+        # prio_needed gates on NONZERO contributions, not mere presence of
+        # affinity-carrying pods: a cluster of required-anti-only pods (no
+        # preferred terms, no outgoing score terms) produces identically
+        # zero InterPodAffinity counts, and tracing the whole priority side
+        # through the scan for a guaranteed zero is pure per-step cost.
+        # Exactness: counts can only come from prio_static (static matches),
+        # p_w x own-preferred occupancy, or q_w x incoming occupancy — all
+        # three all-zero forces counts == 0 and interpod_score(0) == 0.
+        self.prio_needed = any_prio or bool(
+            self.prio_static.any() or self.p_w.any() or self.q_w.any())
         self.spread_needed = bool(self.sp_has.any())
         # required (anti-)affinity classes must schedule sequentially (their
         # fits depend on every prior in-batch commit) -> wave mode routes
@@ -378,13 +397,60 @@ class AffinityData:
                           | self.anti_active.any(axis=1) | self.fail_all
                           | self.forbid_static.any(axis=1))
 
+        # ---------------- wave-path classification (ISSUE 3) --------------
+        # The pipelined wave engine re-evaluates required-anti constraints
+        # per WAVE from [C, L] topology-occupancy counters (waves.py). That
+        # is exact for a class iff:
+        #   - forbidden domains only GROW as pods commit (anti occupancy and
+        #     the symmetry row are monotone), so a wave-start mask is valid
+        #     for every pod placed under it and "fits nowhere" is final —
+        #     the same monotonicity that makes capacity verdicts exact;
+        #   - within one wave, per-node conflict resolution commits a single
+        #     class per node, so cross-class anti violations inside a wave
+        #     need two nodes SHARING a topology domain — excluded by
+        #     requiring every key on the class's required-anti surface (own
+        #     terms AND incoming terms that target it) to have SINGLETON
+        #     domains (each (key, value) label column on at most one node:
+        #     the hostname shape);
+        #   - a self-anti class additionally commits at most one pod per
+        #     node per wave (wave_gate -> the `special` discipline), so its
+        #     own same-node FIFO run cannot collide with itself.
+        # Own required AFFINITY is never wave-safe (a bootstrapping group
+        # evaluated against one frozen mask would scatter instead of
+        # co-locating), nor is fail_all/overflow. Those classes keep the
+        # strict scan — but as a SEEDED TAIL after the wave pass (engine
+        # harvest), never silently through the throughput path.
+        anti_target = self.m_anti.any(axis=(0, 1))        # [C] targeted by
+        # some pending class's required anti term (symmetry side)
+        relevant = (self.aff_active.any(axis=1) | self.anti_active.any(axis=1)
+                    | anti_target | self.forbid_static.any(axis=1)
+                    | self.fail_all)
+        strict = (self.overflow | self.fail_all
+                  | self.aff_active.any(axis=1))
+        # singleton-domain test per label column over the CURRENT node set
+        multi_col = snap.domain_node_counts() > 1                   # [L]
+        term_multi = (self.anti_keymask.astype(bool)
+                      & multi_col[None, None, :]).any(axis=2)       # [C, A]
+        own_multi = (term_multi & self.anti_active).any(axis=1)
+        in_multi = (self.m_anti.astype(bool)
+                    & term_multi[:, :, None]).any(axis=(0, 1))      # [C]
+        # (forbid_static needs no width gate: it is CONSTANT inside the
+        # wave mask, so it is exact at any domain width — only domains that
+        # GROW from in-batch commits carry the within-wave hazard)
+        strict |= relevant & (own_multi | in_multi)
+        self.wave_strict = relevant & strict
+        iota_c = np.arange(C)
+        self_anti = self.m_anti[iota_c, :, iota_c].any(axis=1)
+        self.wave_gate = relevant & ~strict & self_anti
+        self.wave_relevant = relevant
+
     def device_arrays(self) -> Arrays:
         out = {}
         for k in ("fail_all", "forbid_static", "aff_active", "aff_allow",
                   "aff_has_static", "aff_self", "aff_keymask", "anti_active",
                   "anti_keymask", "m_aff", "m_anti", "prio_static", "p_w",
                   "p_keymask", "mp", "q_w", "q_keymask", "mq", "sp_static",
-                  "sp_cls", "sp_has", "Z", "node_has_zone"):
+                  "sp_cls", "sp_has", "Z", "node_has_zone", "wave_gate"):
             out[k] = jnp.asarray(getattr(self, k))
         return out
 

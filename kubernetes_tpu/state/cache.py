@@ -43,6 +43,10 @@ class _PodState:
         self.binding_finished = False
 
 
+def _pod_has_affinity(pod: Pod) -> bool:
+    return pod.has_pod_affinity()
+
+
 class SchedulerCache:
     def __init__(self, ttl_seconds: float = 30.0, now: Callable[[], float] = time.monotonic):
         self._ttl = ttl_seconds
@@ -50,6 +54,14 @@ class SchedulerCache:
         self._lock = threading.Lock()
         self._pod_states: Dict[str, _PodState] = {}
         self._nodes: Dict[str, NodeInfo] = {}
+        # affinity-churn sequence: bumped once per (anti-)affinity-carrying
+        # pod entering or leaving any NodeInfo (assume, confirm-move,
+        # foreign add/remove, TTL expiry, forget). The wave engine's cached
+        # AffinityData folds its OWN assumes into this counter, so
+        # aff_seq != expected means a FOREIGN mutation invalidated the
+        # static topology arrays (ISSUE 3). Confirming our own assume in
+        # place mutates no NodeInfo and does not bump.
+        self.aff_seq = 0
 
     # ------------------------------------------------------------------ pods
 
@@ -79,6 +91,8 @@ class SchedulerCache:
                     info = NodeInfo()
                     self._nodes[pod.node_name] = info
                 info.add_pod_precomputed(pod, req, ncpu, nmem, ports)
+                if _pod_has_affinity(pod):
+                    self.aff_seq += 1
                 st = _PodState(pod)
                 st.assumed = True
                 self._pod_states[key] = st
@@ -102,6 +116,8 @@ class SchedulerCache:
                     info = NodeInfo()
                     self._nodes[node_name] = info
                 info.add_pods_same_class(pods, req, ncpu, nmem, ports)
+                if pods and _pod_has_affinity(pods[0]):
+                    self.aff_seq += len(pods)
                 touched[node_name] = info
                 for pod in pods:
                     key = pod.key()
@@ -246,6 +262,10 @@ class SchedulerCache:
                 stub = NodeInfo()
                 for p in info.pods:
                     stub.add_pod(p)
+                    if _pod_has_affinity(p):
+                        # the pods' NodeInfo (and its node object) moved —
+                        # cached topology arrays resolved domains through it
+                        self.aff_seq += 1
                 self._nodes[name] = stub
 
     # -------------------------------------------------------------- snapshot
@@ -285,8 +305,12 @@ class SchedulerCache:
             info = NodeInfo()
             self._nodes[pod.node_name] = info
         info.add_pod(pod)
+        if _pod_has_affinity(pod):
+            self.aff_seq += 1
 
     def _remove_pod_locked(self, pod: Pod) -> None:
         info = self._nodes.get(pod.node_name)
         if info is not None:
             info.remove_pod(pod)
+            if _pod_has_affinity(pod):
+                self.aff_seq += 1

@@ -159,6 +159,12 @@ class ClusterSnapshot:
         # the extender's per-class encodings (PodBatch arrays) stay valid
         # across a scheduleOne stream of binds.
         self.vocab_gen = 0
+        # label-CONTENT generation: bumped whenever any node's label ROW
+        # changes value (relabel to already-interned columns rides the
+        # delta refresh without touching vocab_gen, but anything that
+        # materialized label content — the wave encoding's key_node /
+        # static_forbid / labels_aff topology views — is stale then)
+        self.labels_gen = 0
         self.dirty: set = set()
         self._label_index: Dict[str, set] = {}  # key -> values across nodes
         self._row_labels: List[Dict[str, str]] = []  # per-row node label maps
@@ -886,6 +892,8 @@ class ClusterSnapshot:
             idx = self.label_vocab.get(k, v)
             if idx >= 0:
                 lbl[idx] = 1
+        if not np.array_equal(self.labels[i], lbl):
+            self.labels_gen += 1
         self.labels[i] = lbl
 
     def _write_ports_row(self, i: int, info: NodeInfo) -> None:
@@ -915,6 +923,21 @@ class ClusterSnapshot:
                 cached = int(np.nonzero(self.port_bitmap.any(axis=0))[0][-1]) + 1
             self._port_words_used = cached
         return cached
+
+    def domain_node_counts(self) -> np.ndarray:
+        """Nodes per interned topology DOMAIN (label-pair column): int64 [L]
+        over the current label matrix. The wave engine's affinity
+        classification (ops/affinity.py, ISSUE 3) keys on this: a column on
+        at most ONE node (the hostname shape) makes per-node conflict
+        resolution exactly domain-granular, so required-anti classes over
+        singleton-domain keys ride the per-wave mask instead of the strict
+        tail. Column indices are PREFIX-STABLE across vocab growth (Vocab
+        appends, finalize_labels rebuilds content but never reorders), which
+        is also what lets the harvest fence slice live arrays down to an
+        older encoding's width."""
+        if getattr(self, "labels", None) is None:
+            return np.zeros(0, dtype=np.int64)
+        return self.labels.sum(axis=0, dtype=np.int64)
 
     def _rebuild_label_index(self, infos: Dict[str, NodeInfo],
                              names: List[str]) -> None:

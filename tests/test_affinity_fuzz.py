@@ -206,6 +206,159 @@ def _violates_required_anti(placements, nodes_by_name, all_pods):
     return None
 
 
+def _violates_required_aff(placements, nodes_by_name, all_pods):
+    """Allow-side oracle: a placed pod's required AFFINITY terms must each
+    be satisfied by some other pod sharing the topology domain — except the
+    legitimate bootstrap (the term self-matches and no other matching pod
+    is bound anywhere, predicates.go:1210-1230). Catches the blind-window
+    hazard of two chunks bootstrapping one group into different domains."""
+    from kubernetes_tpu.ops.oracle_ext import (
+        _own_terms,
+        nodes_same_topology,
+        term_matches_pod,
+    )
+    for pod, node_name in placements:
+        if node_name is None:
+            continue
+        node = nodes_by_name[node_name]
+        for t in _own_terms(pod, anti=False):
+            matches = [(q, qn) for q, qn in all_pods
+                       if q is not pod and qn is not None
+                       and term_matches_pod(t, pod, q)]
+            if not matches:
+                if term_matches_pod(t, pod, pod):
+                    continue  # lone bootstrap: nothing else to co-locate with
+                return f"{pod.name}: term has no matching pod at all"
+            if not any(nodes_same_topology(node, nodes_by_name[qn],
+                                           t.topology_key)
+                       for _q, qn in matches):
+                return f"{pod.name}: required affinity unmet at {node_name}"
+    return None
+
+
+def _build_pipeline_cluster(rng, n_nodes=10, n_existing=6):
+    """Like _build_cluster but with a HOSTNAME key in every node's labels so
+    the fuzz exercises the wave path (singleton domains), not only the
+    strict tail, and some existing anti-affinity guards for the static
+    symmetry side."""
+    nodes, existing, _w = _build_cluster(rng, n_nodes=n_nodes,
+                                         n_existing=n_existing)
+    return nodes, existing
+
+
+def _pending_required_mix(rng, n):
+    """Pending pods over required-only (anti-)affinity mixes: hostname anti
+    (wave-expressible), zone/rack anti and zone affinity (strict tail),
+    plain pods sharing labels with the anti apps (symmetry targets)."""
+    out = []
+    for i in range(n):
+        app = rng.choice(APPS)
+        p = make_pod(f"pp-{i}", cpu=rng.choice([100, 500]),
+                     labels={"app": app})
+        roll = rng.random()
+        if roll < 0.25:
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[_term(rng, key="host")]))
+        elif roll < 0.40:
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[_term(rng)]))  # zone/rack/room: multi-node
+        elif roll < 0.50:
+            p.affinity = Affinity(pod_affinity=PodAffinity(
+                required_terms=[_term(rng)]))
+        out.append(p)
+    return out
+
+
+def _drain_pipelined(nodes, existing, pending, overlap=True, chunk=4):
+    from kubernetes_tpu.engine.scheduler import Scheduler
+    from kubernetes_tpu.models.hollow import load_cluster
+    from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+    api = ApiServerLite()
+    load_cluster(api, nodes, [])
+    for p in existing:
+        api.create("Pod", copy.deepcopy(p))
+    for p in pending:
+        api.create("Pod", copy.deepcopy(p))
+    s = Scheduler(api, record_events=False)
+    s.pipeline_chunk = chunk
+    # unschedulable-retry backoff promotes on WALL CLOCK — under load a
+    # retry can join a different chunk in the overlapped run than in the
+    # sequential one, which legally shifts RR draws and breaks the
+    # bit-identity this A/B asserts. Zero the initial delay so a retry
+    # always promotes at the very next pop, load-independent; retries
+    # themselves (the behavior under test) still happen.
+    s.queue.backoff._initial = 0.0
+    s.start()
+    s.run_until_drained(max_batch=chunk, overlap=overlap)
+    return {p.name: (p.node_name or None) for p in api.list("Pod")[0]}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 5, 9])
+def test_pipelined_affinity_wave_vs_strict_oracle(seed):
+    """ISSUE 3 fuzz: the pipelined drain places required-(anti-)affinity
+    chunks through the wave path (per-wave topology occupancy + seeded
+    strict tail + fence). The STRICT SCAN'S constraint semantics are the
+    oracle: no placement may violate required anti-affinity in either
+    direction (own terms and the symmetry check, predicates.go:982/1146),
+    and every required-affinity term must be co-location-satisfied (modulo
+    the lone-bootstrap rule) — on the final cluster state, existing guard
+    pods included. The overlap A/B must be bit-identical: the fence, not
+    timing, decides every blind conflict."""
+    rng = random.Random(seed)
+    nodes, existing = _build_pipeline_cluster(rng)
+    # give every node a "host" singleton key so hostname anti rides waves
+    for i, n in enumerate(nodes):
+        n.labels.setdefault("host", f"h{i}")
+    pending = _pending_required_mix(rng, 18)
+    got = _drain_pipelined(nodes, existing, pending)
+    nodes_by_name = {n.name: n for n in nodes}
+    all_pods = [(p, p.node_name) for p in existing] + \
+        [(p, got.get(p.name)) for p in pending]
+    placements = [(p, got.get(p.name)) for p in pending]
+    err = _violates_required_anti(placements, nodes_by_name, all_pods)
+    assert err is None, err
+    err = _violates_required_aff(placements, nodes_by_name, all_pods)
+    assert err is None, err
+    # A/B: identical dataflow, overlap off -> bit-identical placements
+    got2 = _drain_pipelined(nodes, existing, pending, overlap=False)
+    assert got == got2
+
+
+@pytest.mark.parametrize("seed", [2, 6])
+def test_pipelined_affinity_chunks_do_not_flush(seed):
+    """Routing guard: a drain whose chunks mix plain and required-affinity
+    pods must stay wave-granular — every chunk dispatches as a wave (no
+    classic-round fallback), inexpressible shapes go to the strict tail,
+    and the tail is never silently skipped."""
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    rng = random.Random(seed)
+    nodes, existing = _build_pipeline_cluster(rng)
+    for i, n in enumerate(nodes):
+        n.labels.setdefault("host", f"h{i}")
+    pending = _pending_required_mix(rng, 16)
+    n_strict_expected = 0  # multi-node-domain anti + zone affinity shapes
+    for p in pending:
+        a = p.affinity
+        if a is None:
+            continue
+        terms = []
+        if a.pod_affinity is not None:
+            terms += [(t, True) for t in a.pod_affinity.required_terms]
+        if a.pod_anti_affinity is not None:
+            terms += [(t, False) for t in a.pod_anti_affinity.required_terms]
+        if any(aff or t.topology_key != "host" for t, aff in terms):
+            n_strict_expected += 1
+    COUNTERS.reset()
+    got = _drain_pipelined(nodes, existing, pending)
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.wave_dispatch", (0, 0))[0] >= 2, snap
+    tail = snap.get("engine.affinity_strict_tail", (0, 0))[0]
+    # requeues may send a strict pod through the tail more than once
+    assert tail >= n_strict_expected, (tail, n_strict_expected, snap)
+
+
 @pytest.mark.parametrize("seed", [0, 3, 11])
 def test_wave_mode_required_affinity_invariants(seed):
     """Wave mode's preferred scoring is a documented batch-frozen

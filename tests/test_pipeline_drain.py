@@ -95,10 +95,12 @@ def test_blind_port_conflict_requeues_conservatively():
     assert {p.node_name for p in api.list("Pod")[0]} == {"n0", "n1"}
 
 
-def test_required_anti_affinity_falls_back_to_strict_and_converges():
-    """Chunks carrying required pod anti-affinity are not wave-eligible:
-    the pipeline must flush and route them through the classic synchronous
-    engine, and the result must match the classic drain exactly."""
+def test_required_anti_affinity_rides_the_wave_path():
+    """Chunks carrying required pod anti-affinity are wave-eligible
+    (ISSUE 3): the pipeline must NOT flush — hostname-keyed anti classes
+    place through the per-wave topology-occupancy mask — the constraint
+    must hold exactly (one pod per host), and the overlap A/B must be
+    bit-identical (the fence, not timing, decides every conflict)."""
     def build():
         nodes = [make_node(f"n{i:02d}", cpu=8000, memory=32 * Gi, pods=110,
                            labels={"host": f"h{i}"}) for i in range(8)]
@@ -113,13 +115,50 @@ def test_required_anti_affinity_falls_back_to_strict_and_converges():
             pods.append(p)
         return mk_sched(nodes, pods, chunk=3)
 
+    COUNTERS.reset()
     api, s = build()
     tot = s.run_until_drained()
     assert tot["bound"] == 8
     assert len({p.node_name for p in api.list("Pod")[0]}) == 8  # 1 per host
+    snap = COUNTERS.snapshot()
+    # the chunks dispatched as waves — they never flushed to the classic
+    # synchronous round, and the hostname shape needed no strict tail
+    assert snap.get("engine.wave_dispatch", (0, 0))[0] >= 2, snap
+    assert snap.get("engine.affinity_strict_tail", (0, 0))[0] == 0, snap
+    # A/B: same dataflow with overlap forced off is bit-identical
     api2, s2 = build()
-    s2.run_until_drained(pipeline=False)
+    tot2 = s2.run_until_drained(overlap=False)
+    assert tot2["bound"] == 8
     assert placements(api) == placements(api2)
+
+
+def test_required_affinity_group_routes_to_strict_tail():
+    """Own required AFFINITY (a co-locating group bootstrapping from
+    nothing) is not counter-expressible per wave: those pods must route to
+    the seeded strict tail — never silently through the throughput path —
+    and the group must land co-located in one topology domain."""
+    nodes = [make_node(f"n{i:02d}", cpu=8000, memory=32 * Gi, pods=110,
+                       labels={"host": f"h{i}", "zone": f"z{i % 2}"})
+             for i in range(6)]
+    pods = []
+    for i in range(6):
+        p = make_pod(f"pack-{i}", cpu=100, memory=128 << 20,
+                     labels={"app": "pack"})
+        p.affinity = Affinity(pod_affinity=PodAffinity(
+            required_terms=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": "pack"}),
+                namespaces=[], topology_key="zone")]))
+        pods.append(p)
+    COUNTERS.reset()
+    api, s = mk_sched(nodes, pods, chunk=2)
+    tot = s.run_until_drained()
+    assert tot["bound"] == 6, tot
+    zones = {p.node_name for p in api.list("Pod")[0] if p.node_name}
+    suffix = {int(n[1:]) % 2 for n in zones}
+    assert len(suffix) == 1, f"group split across zones: {zones}"
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.affinity_strict_tail", (0, 0))[0] == 6, snap
+    assert snap.get("engine.wave_dispatch", (0, 0))[0] >= 2, snap
 
 
 def test_pipelined_equals_sequential_on_seeded_density():
@@ -175,6 +214,54 @@ def test_warm_round_invariants_via_span_counters():
     assert snap.get("snapshot.refresh_hinted", (0, 0))[0] >= 2
 
 
+def test_warm_affinity_drain_dispatch_counters():
+    """ISSUE 3 dispatch-count guard: a WARM re-drain of wave-eligible
+    affinity chunks must cost ONE fused dispatch per wave, ZERO strict-scan
+    tail dispatches, and ZERO ClassBatch/AffinityData rebuilds — so a later
+    PR cannot quietly put affinity back on the flush-and-rebuild path. Apps
+    are split so consecutive chunks never interact across the blind window
+    (the fence stays quiet and the dispatch count is deterministic)."""
+    nodes = hollow_nodes(64)
+
+    def mk_pods(prefix, n):
+        out = []
+        for i in range(n):
+            app = f"iso-{i % 2 if i < n // 2 else 2 + i % 2}"
+            p = make_pod(f"{prefix}-{i}", cpu=100, memory=128 << 20,
+                         labels={"app": app})
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": app}),
+                    namespaces=[], topology_key="kubernetes.io/hostname")]))
+            out.append(p)
+        return out
+
+    api, s = mk_sched(nodes, mk_pods("w1", 128), chunk=64)
+    tot = s.run_until_drained(max_batch=64)  # warm: compiles + builds enc
+    assert tot["bound"] == 128, tot
+
+    for p in mk_pods("w2", 128):  # same classes arrive again
+        api.create("Pod", p)
+    COUNTERS.reset()
+    tot = s.run_until_drained(max_batch=64)
+    assert tot["bound"] == 128, tot
+    snap = COUNTERS.snapshot()
+    # no re-tensorization, no AffinityData rebuild (the encoding's
+    # commdom/aff_seq bookkeeping absorbed our own assumes)
+    assert snap.get("engine.wave_encode_build", (0, 0))[0] == 0, snap
+    assert snap.get("engine.wave_aff_build", (0, 0))[0] == 0, snap
+    assert snap.get("engine.wave_encode_reuse", (0, 0))[0] >= 2, snap
+    # one fused dispatch per wave: 128 pods / 64 chunk = 2 waves; the
+    # hostname shape needs no per-pod strict-scan dispatches at all
+    assert snap.get("engine.wave_dispatch", (0, 0))[0] == 2, snap
+    assert snap.get("engine.wave_tail_dispatch", (0, 0))[0] == 0, snap
+    assert snap.get("engine.affinity_strict_tail", (0, 0))[0] == 0, snap
+    assert snap.get("engine.affinity_fence_requeues", (0, 0))[0] == 0, snap
+    # targeted refresh only, as in the plain warm drain
+    assert snap.get("snapshot.refresh_scan", (0, 0))[0] == 0, snap
+    assert snap.get("snapshot.refresh_rebuild", (0, 0))[0] == 0, snap
+
+
 def test_fence_requeue_is_not_backoff():
     """A fence conflict is a capacity race, not unschedulability: the loser
     must retry in the immediately following waves (plain queue add), not
@@ -186,3 +273,121 @@ def test_fence_requeue_is_not_backoff():
     tot = s.run_until_drained()
     assert tot["bound"] == 4, tot  # nobody parked in backoff: all 4 landed
     assert tot["unschedulable"] == 0
+
+
+def test_zone_anti_blind_window_fenced():
+    """Multi-node-domain (zone) required anti-affinity across BLIND
+    windows: the per-node fence mirror cannot see a collision on a
+    DIFFERENT node of the same domain, so the fence also re-validates
+    over the projected domain columns. Two za classes are pinned to
+    DIFFERENT nodes of the same zone (same-class blind evaluations are
+    identical and collide on the same node, where the per-node mirror
+    already catches them); chunk=1 makes za-b's evaluation blind to
+    za-a's bind, so only the domain form can reject za-b@n1 against
+    za-a@n0. Exactly one za pod may land, in both overlap modes,
+    bit-identically."""
+    def build():
+        nodes = [make_node(f"n{i}", cpu=4000, memory=16 * Gi, pods=110,
+                           labels={"host": f"h{i}", "zone": "z0"})
+                 for i in range(3)]
+        pods = []
+        for i, host in enumerate(("h0", "h1")):
+            p = make_pod(f"za-{i}", cpu=100 * (i + 1), memory=128 << 20,
+                         labels={"app": "za"})
+            p.node_selector = {"host": host}
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "za"}),
+                    namespaces=[], topology_key="zone")]))
+            pods.append(p)
+        return mk_sched(nodes, pods, chunk=1)
+
+    api, s = build()
+    tot = s.run_until_drained(max_batch=1)
+    assert tot["bound"] == 1, tot            # one per zone, no more
+    assert tot["unschedulable"] == 1, tot
+    api2, s2 = build()
+    s2.run_until_drained(max_batch=1, overlap=False)
+    assert placements(api) == placements(api2)
+
+
+def test_affinity_straggler_requeues_not_tail():
+    """Max-waves stragglers of wave-eligible classes in an AFFINITY chunk
+    must requeue (without backoff), never ride the seeded strict tail —
+    the tail's domain projection carries only the wave_strict classes'
+    columns, so a straggler's constraints would be invisible there. The
+    bottleneck: a special (volume) class pinned to ONE node commits one
+    pod per wave, so > 64 pods in one chunk exhaust max_waves."""
+    from kubernetes_tpu.api.types import Volume, VolumeKind
+
+    nodes = [make_node(f"n{i}", cpu=8000, memory=32 * Gi, pods=110,
+                       labels={"host": f"h{i}"}) for i in range(4)]
+    pods = []
+    for i in range(70):  # > max_waves(64) pods of one special class
+        p = make_pod(f"ro-{i}", cpu=10, memory=16 << 20)
+        p.volumes = [Volume(name="shared", kind=VolumeKind.GCE_PD,
+                            volume_id="shared-pd", read_only=True)]
+        p.node_selector = {"host": "h0"}
+        pods.append(p)
+    # one anti pod makes the chunk an affinity chunk (enc.adata != None)
+    guard = make_pod("iso-0", cpu=100, memory=128 << 20,
+                     labels={"app": "iso"})
+    guard.affinity = Affinity(pod_anti_affinity=PodAffinity(
+        required_terms=[PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": "iso"}),
+            namespaces=[], topology_key="host")]))
+    pods.append(guard)
+    COUNTERS.reset()
+    api, s = mk_sched(nodes, pods, chunk=128)
+    tot = s.run_until_drained(max_batch=128)
+    assert tot["bound"] == 71, tot
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.affinity_straggler_requeues", (0, 0))[0] > 0, \
+        snap  # the bottleneck class DID exhaust max_waves
+    assert snap.get("engine.wave_tail_dispatch", (0, 0))[0] == 0, \
+        snap  # ... and its stragglers never rode the projected tail
+    per_node = Counter(p.node_name for p in api.list("Pod")[0]
+                       if p.node_name and p.name.startswith("ro-"))
+    assert per_node == {"n0": 70}, per_node
+
+
+def test_relabel_invalidates_affinity_encoding():
+    """A node relabel to ALREADY-interned values rides the delta refresh:
+    no vocab growth, no affinity churn — only snapshot.labels_gen records
+    that label CONTENT moved. The cached wave encoding bakes topology
+    views (key_node / labels_aff) from label content, so reuse keyed on
+    (vocab_gen, aff_seq) alone would evaluate required anti-affinity
+    against the OLD topology. za-1's node moves from z1 into z0, which
+    frees zone z1: a third zone-anti pod MUST bind there — a stale
+    encoding still sees z1 occupied and calls it unschedulable."""
+    nodes = [make_node(f"n{i}", cpu=4000, memory=16 * Gi, pods=110,
+                       labels={"zone": "z0" if i < 2 else "z1"})
+             for i in range(4)]
+
+    def za(name):
+        p = make_pod(name, cpu=100, memory=128 << 20, labels={"app": "za"})
+        p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+            required_terms=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": "za"}),
+                namespaces=[], topology_key="zone")]))
+        return p
+
+    api, s = mk_sched(nodes, [za("za-0"), za("za-1")], chunk=4)
+    tot = s.run_until_drained(max_batch=4)
+    assert tot["bound"] == 2, tot
+    where = placements(api)
+    z1_node = where["za-1"] if where["za-1"] in ("n2", "n3") \
+        else where["za-0"]
+    assert z1_node in ("n2", "n3"), where
+
+    # relabel the z1 occupant's node into z0 (z0 is already interned)
+    node = [n for n in api.list("Node")[0] if n.name == z1_node][0]
+    node.labels = dict(node.labels, zone="z0")
+    api.update("Node", node)
+
+    api.create("Pod", za("za-2"))
+    tot = s.run_until_drained(max_batch=4)
+    assert tot["bound"] == 1, (tot, placements(api))
+    got = placements(api)["za-2"]
+    other_z1 = "n3" if z1_node == "n2" else "n2"
+    assert got == other_z1, (got, z1_node)
