@@ -499,7 +499,29 @@ def measure_mixed_affinity(n_nodes: int, n_pods: int, warmup: bool = True):
     }
 
 
+def lint_gate_or_die():
+    """`--lint-gate` / BENCH_LINT_GATE=1: refuse to report perf numbers
+    from a tree carrying unsuppressed graftlint hazards. A number measured
+    over an aliasing upload or a hidden host sync is not a number — it is
+    either racing (wrong placements under load) or quietly serialized
+    (wrong overlap). Pure AST, milliseconds, no device."""
+    import sys
+
+    from kubernetes_tpu.analysis.lint import lint_gate
+    ok, report = lint_gate()
+    if not ok:
+        print(report, file=sys.stderr)
+        print(json.dumps({"metric": "schedule_pods_per_sec", "value": 0,
+                          "unit": "pods/s", "error": "lint-gate: tree has "
+                          "unsuppressed graftlint findings"}))
+        raise SystemExit(3)
+
+
 def main():
+    import sys
+    if "--lint-gate" in sys.argv[1:] \
+            or os.environ.get("BENCH_LINT_GATE", "0") == "1":
+        lint_gate_or_die()
     n_nodes = int(os.environ.get("BENCH_NODES", 5000))
     n_pods = int(os.environ.get("BENCH_PODS", 30000))
     profile = os.environ.get("BENCH_PROFILE", "density")

@@ -1,0 +1,34 @@
+"""graftlint rule registry: GL001-GL005, one module each.
+
+A rule module exports `RULE` (the id) and `check(ctx, index) -> [Finding]`.
+The engine (analysis/lint.py) applies pragma suppression and baselines;
+rules only report.
+"""
+
+from kubernetes_tpu.analysis.rules import (  # noqa: F401
+    gl001_aliasing,
+    gl002_hostsync,
+    gl003_recompile,
+    gl004_tracer,
+    gl005_generation,
+)
+from kubernetes_tpu.analysis.rules.base import (  # noqa: F401
+    FileContext,
+    Finding,
+    ProjectIndex,
+)
+
+ALL_RULES = (gl001_aliasing, gl002_hostsync, gl003_recompile,
+             gl004_tracer, gl005_generation)
+
+RULE_IDS = tuple(m.RULE for m in ALL_RULES)
+
+CATALOG = {
+    "GL001": "aliasing upload: jnp.asarray of an in-place-mutated host "
+             "buffer / broken copy-required seam",
+    "GL002": "hidden device->host sync on a device value in the hot path",
+    "GL003": "recompile hazard: jit built in a function/loop, ragged "
+             "shapes into a jitted call in a loop",
+    "GL004": "tracer leak: host state mutated inside a traced scope",
+    "GL005": "snapshot dynamic-row write without dirty/generation bump",
+}

@@ -1,0 +1,114 @@
+"""Runtime aliasing sanitizer for the device-upload seams.
+
+The static rules (GL001) catch the aliasing shapes the AST can prove; this
+module catches the rest AT TEST TIME. Under `GRAFT_SANITIZE=1` the upload
+helpers change behavior:
+
+- `upload_copied(host)` — seams whose contract is "the device gets its OWN
+  buffer" (`_nodes_on_device`, the committed_nodes seed): after the copy,
+  assert the device buffer really does NOT share memory with the host
+  source. On the CPU backend `np.asarray(dev)` is a zero-copy view of the
+  device buffer, so `np.shares_memory` sees straight through a
+  constructor that silently degraded to an alias — the exact r07/r08
+  regression, caught at the seam instead of as a placement flake.
+- `upload_frozen(host)` — seams whose contract is "alias is fine because
+  the host buffer is IMMUTABLE from now on" (AffinityData device bundles,
+  the wave encodings' static topology views): freeze the source
+  (`ndarray.flags.writeable = False`) so any later in-place write crashes
+  loudly with a numpy ValueError at the WRITE site — not three waves
+  later as a corrupted blind placement.
+- `upload_view(host)` — seams whose contract is "alias is fine because
+  the result is consumed SYNCHRONOUSLY before any host mutation"
+  (predicates.node_arrays, the extender's cold path): sanitize mode
+  upgrades them to verified copies, making the blessed-sync assumption
+  unnecessary while the sanitizer watches.
+
+With `GRAFT_SANITIZE` unset all three are exactly the constructors they
+wrap — zero hot-path cost beyond one env check per upload (uploads are
+already rare: the incremental sync moves a handful of arrays per round).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["AliasingViolation", "enabled", "freeze", "upload_copied",
+           "upload_frozen", "upload_view"]
+
+
+class AliasingViolation(RuntimeError):
+    """A device upload that is contractually a copy aliases its host
+    source — the data race GL001 exists to prevent, observed live."""
+
+
+def enabled() -> bool:
+    """Read the knob per call (not cached): tests toggle it via
+    monkeypatch.setenv around individual drains."""
+    return os.environ.get("GRAFT_SANITIZE", "") == "1"
+
+
+# indirection point: the deliberately-aliasing regression test monkeypatches
+# this to jnp.asarray to prove the shares-memory assert actually fires on
+# the r07-style regression (tests/test_pipeline_drain.py)
+_copy_ctor = jnp.array
+
+
+def upload_copied(host):
+    """Device upload with copy semantics, verified under GRAFT_SANITIZE=1."""
+    dev = _copy_ctor(host)
+    if enabled() and isinstance(host, np.ndarray):
+        _assert_no_alias(dev, host)
+    return dev
+
+
+def upload_frozen(host):
+    """Zero-copy device upload of a host buffer that is IMMUTABLE from this
+    point on; sanitize mode seals the source so a violation crashes at the
+    offending write."""
+    dev = jnp.asarray(host)
+    if enabled() and isinstance(host, np.ndarray):
+        freeze(host)
+    return dev
+
+
+def upload_view(host):
+    """Zero-copy device upload consumed synchronously by the caller (the
+    result is fetched before any host mutation can run). Sanitize mode
+    upgrades to a verified copy — the synchronous-consumption assumption
+    then cannot be violated at all."""
+    if enabled() and isinstance(host, np.ndarray):
+        return upload_copied(host)
+    return jnp.asarray(host)
+
+
+def freeze(host: np.ndarray) -> np.ndarray:
+    """Make every future in-place write to `host` raise. Reducing
+    permissions is always legal, even on views; freezing a view does not
+    freeze its base, so walk to the owner first when possible."""
+    base = host
+    while base.base is not None and isinstance(base.base, np.ndarray):
+        base = base.base
+    for arr in (base, host):
+        try:
+            arr.flags.writeable = False
+        except ValueError:
+            pass  # non-owning exotic view: freezing `host` itself suffices
+    return host
+
+
+def _assert_no_alias(dev, host: np.ndarray) -> None:
+    try:
+        view = np.asarray(dev)  # CPU backend: zero-copy view of the device
+        # buffer; other backends may copy here, making the check vacuously
+        # pass — aliasing is only possible on backends where this IS a view
+    except Exception:
+        return
+    if np.shares_memory(view, host):
+        raise AliasingViolation(
+            f"device upload of {host.shape} {host.dtype} buffer aliases "
+            "its host source — a copy-contract seam degraded to zero-copy "
+            "(the r07 _nodes_on_device / r08 committed_nodes race class); "
+            "upload with jnp.array or fix the constructor")

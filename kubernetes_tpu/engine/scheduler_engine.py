@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.analysis import sanitize
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.engine.batch import NodeState, gather_place_batch
 from kubernetes_tpu.engine import waves
@@ -538,8 +539,11 @@ def _eval_dispatch(pod, infos, snap, priorities, workloads, hard_weight,
             enc.parr, narr,
             enc.aff if (fits_on or prio_on or spread_on) else None,
             plain, (w_ip, w_sp), (fits_on, prio_on, spread_on))
-        m = np.array(m)  # blocks; device buffers are read-only views
-        s = np.asarray(s)
+        # the extender's one result fetch: the verb returns (fits, scores)
+        # to an HTTP caller, so this stall IS the response (m must be
+        # writable below; s stays a read-only view)
+        m = np.array(m)  # graftlint: sync-ok
+        s = np.asarray(s)  # graftlint: sync-ok (same blessed fetch)
     m[len(snap.node_names):] = False
     return m, s
 
@@ -621,8 +625,12 @@ def _aff_tail_arrays(adata, snap, cols: np.ndarray):
             a = a[:, :, cols]
         elif k in _AFF_SLICE2:
             a = a[:, cols]
-        out[k] = jnp.asarray(a)
-    out["labels_aff"] = jnp.asarray(snap.labels[:, cols])
+        # static-per-encoding host arrays (AffinityData owns them, nothing
+        # mutates them after build) — zero-copy is the point; the sanitizer
+        # seals the sources so a violation crashes at the offending write
+        out[k] = sanitize.upload_frozen(a)
+    # advanced indexing already copies, so freezing the fresh row is free
+    out["labels_aff"] = sanitize.upload_frozen(snap.labels[:, cols])
     return out
 
 
@@ -745,7 +753,8 @@ class WaveHandle:
     def block(self) -> None:
         """Force device completion now (sequential/debug mode): the values
         are identical whenever fetched; only the overlap is forfeited."""
-        self.packed.block_until_ready()
+        self.packed.block_until_ready()  # graftlint: sync-ok — this
+        # method EXISTS to stall (overlap=False debug mode)
 
 
 class WaveHarvest:
@@ -943,9 +952,12 @@ class SchedulingEngine:
                     cls_arr, jnp.asarray(pc_fast), nodes, state,
                     jnp.uint32(self.rr.counter), kernel_priorities,
                     aff=aff_arrays, aff_mode=aff_mode)
-                selected = np.asarray(selected)[:pf]
-                fit_counts = np.asarray(fit_counts)[:pf]
-            self.rr.counter = int(rr_end)
+                # the synchronous engine's result fetch: schedule() owes
+                # its caller host placements, so the stall is the contract
+                selected = np.asarray(selected)[:pf]  # graftlint: sync-ok
+                fit_counts = np.asarray(fit_counts)[:pf]  # graftlint: sync-ok
+            self.rr.counter = int(rr_end)  # graftlint: sync-ok — scalar
+            # draw-count fetch rides the result fetch above (device idle)
             names = self.snapshot.node_names
             placements = []
             # plain-int lists: numpy scalar indexing in a 30k-iteration loop
@@ -1058,9 +1070,11 @@ class SchedulingEngine:
                 cls_arr, jnp.asarray(pcs), nodes, state_cur,
                 jnp.uint32(rr), kernel_priorities, aff=aff_arrays,
                 aff_mode=aff_mode, aff_init=aff_init)
-            selected[strict_pos] = np.asarray(sel_s)[:sp_n]
-            fit_counts[strict_pos] = np.asarray(fc_s)[:sp_n]
-            rr = int(rr_d)
+            # strict-tail result fetch (classic wave mode is synchronous
+            # by definition — the caller consumes placements immediately)
+            selected[strict_pos] = np.asarray(sel_s)[:sp_n]  # graftlint: sync-ok
+            fit_counts[strict_pos] = np.asarray(fc_s)[:sp_n]  # graftlint: sync-ok
+            rr = int(rr_d)  # graftlint: sync-ok (scalar, device idle)
         return selected, fit_counts, rr
 
     def _assume(self, pod: Pod, node_name: str) -> None:
@@ -1135,13 +1149,14 @@ class SchedulingEngine:
                 host = getattr(snap, k)
             cur = self._device_nodes.get(k)
             if cur is None or cur.shape != host.shape or k in snap.dirty:
-                # jnp.array, NOT jnp.asarray: the CPU backend ZERO-COPIES
-                # aligned numpy buffers, and these snapshot arrays are
-                # mutated in place (refresh deltas, apply_assume_delta)
-                # while a pipelined wave may still be executing against
-                # them asynchronously — an alias here is a data race that
-                # shows up as placement flakes under load
-                self._device_nodes[k] = jnp.array(
+                # COPY, never alias: the CPU backend zero-copies aligned
+                # numpy buffers, and these snapshot arrays are mutated in
+                # place (refresh deltas, apply_assume_delta) while a
+                # pipelined wave may still be executing against them
+                # asynchronously. The pragma makes GL001 reject any future
+                # jnp.asarray "optimization" here; GRAFT_SANITIZE=1
+                # additionally asserts the upload really did not alias.
+                self._device_nodes[k] = sanitize.upload_copied(  # graftlint: copy-required
                     np.ascontiguousarray(host) if k == "port_bitmap" else host)
                 uploaded += 1
         if uploaded:
@@ -1247,11 +1262,13 @@ class SchedulingEngine:
                 has_aff_pod[c] = _has_affinity(rep)
             if fits_on:
                 key_node, static_forbid_hit = _aff_node_views(adata, snap)
+                # static per encoding — frozen-alias seam, like the tail
                 aff_wave_dev = {
-                    "m_anti": jnp.asarray(adata.m_anti),
-                    "key_node": jnp.asarray(key_node),
-                    "static_forbid": jnp.asarray(static_forbid_hit),
-                    "wave_gate": jnp.asarray(adata.wave_gate),
+                    "m_anti": sanitize.upload_frozen(adata.m_anti),
+                    "key_node": sanitize.upload_frozen(key_node),
+                    "static_forbid": sanitize.upload_frozen(
+                        static_forbid_hit),
+                    "wave_gate": sanitize.upload_frozen(adata.wave_gate),
                 }
             if fits_on or prio_on:
                 tail_cols = _aff_tail_cols(adata, prio_on)
@@ -1345,16 +1362,19 @@ class SchedulingEngine:
                 strict_idx = np.nonzero(ser)[0]
                 act = np.zeros(p_pad, dtype=bool)
                 act[:n] = ~ser
-                # jnp.array, NOT jnp.asarray: the CPU backend zero-copies
-                # aligned numpy uploads, and the harvest FOLD mutates
-                # committed_nodes in place while this wave may still be
-                # executing against it asynchronously (the same race
-                # _nodes_on_device documents)
+                # committed_nodes must upload as a COPY: the harvest FOLD
+                # mutates it in place (np.add.at) while this wave may
+                # still be executing against it asynchronously (the same
+                # race class _nodes_on_device documents). GL001's
+                # copy-required contract + the class-scoped alias check
+                # both reject a jnp.asarray regression here.
+                committed_dev = sanitize.upload_copied(  # graftlint: copy-required
+                    enc.committed_nodes)
                 packed, state_out, committed_out = waves.waves_loop(
                     enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
                     self._kernel_priorities(), 64, extra_score=extra,
                     aff=enc.aff_wave_dev,
-                    committed0=jnp.array(enc.committed_nodes),
+                    committed0=committed_dev,
                     active0=jnp.asarray(act))
                 if strict_idx.size:
                     COUNTERS.inc("engine.affinity_strict_tail",
@@ -1397,7 +1417,9 @@ class SchedulingEngine:
         p_pad = bucket(max(n, handle.pad_floor or 1))
         t0 = _time.perf_counter()
         with timed_span("pipeline.device_block"):
-            packed_h = np.asarray(handle.packed)
+            # THE pipeline's blessed block: harvest exists to absorb this
+            # wave's device wait while the NEXT wave already runs
+            packed_h = np.asarray(handle.packed)  # graftlint: sync-ok
         t_block = _time.perf_counter() - t0
         sel = packed_h[:n].copy()
         fc = packed_h[p_pad:p_pad + n].copy()
@@ -1463,9 +1485,12 @@ class SchedulingEngine:
                 enc.cls_arr, jnp.asarray(pcs), handle.nodes,
                 handle.state_out, jnp.uint32(counter_h), tail_prios,
                 aff=aff_arrays, aff_mode=aff_mode, aff_init=aff_init)
-            sel[tail_idx] = np.asarray(sel_s)[:n_tail]
-            fc[tail_idx] = np.asarray(fc_s)[:n_tail]
-            counter_h = int(rr_d)
+            # seeded strict-tail fetch: the fence below needs these rows
+            # on host NOW, and the main wave result is already fetched —
+            # the tail is the last device work in this harvest
+            sel[tail_idx] = np.asarray(sel_s)[:n_tail]  # graftlint: sync-ok
+            fc[tail_idx] = np.asarray(fc_s)[:n_tail]  # graftlint: sync-ok
+            counter_h = int(rr_d)  # graftlint: sync-ok (scalar, device idle)
         if self._rr_chain is handle.counter_out:
             self._rr_chain = None
         self.rr.counter = counter_h

@@ -532,7 +532,10 @@ def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
     packed, state = waves_loop(cls, nodes, state, jnp.asarray(pod_class),
                                jnp.uint32(counter), priorities, max_waves,
                                extra_score)
-    packed_h = np.asarray(packed)  # the ONLY device->host sync
+    packed_h = np.asarray(packed)  # graftlint: sync-ok — the ONLY
+    # blessed device->host sync on the classic wave path: one [3P+2]
+    # fetch for the whole drain round, everything before it is one
+    # async device program
     final_sel = packed_h[:P].copy()
     final_fc = packed_h[P:2 * P].copy()
     act_h = packed_h[2 * P:3 * P].astype(bool)
@@ -555,7 +558,9 @@ def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,
         sel, fcs, state, counter_d = gather_place_batch(
             cls, jnp.asarray(pc), nodes, state, jnp.uint32(counter_h),
             priorities, aff=aff, aff_mode=aff_mode, extra_score=extra_score)
-        final_sel[idx] = np.asarray(sel)[:n_strag]
-        final_fc[idx] = np.asarray(fcs)[:n_strag]
-        counter_h = int(counter_d)
+        # rare straggler finish (max_waves exhausted): a second fetch is
+        # the cost of correctness here, not a hot-path stall
+        final_sel[idx] = np.asarray(sel)[:n_strag]  # graftlint: sync-ok
+        final_fc[idx] = np.asarray(fcs)[:n_strag]  # graftlint: sync-ok
+        counter_h = int(counter_d)  # graftlint: sync-ok (scalar, idle)
     return final_sel, final_fc, state, counter_h

@@ -445,13 +445,17 @@ class AffinityData:
         self.wave_relevant = relevant
 
     def device_arrays(self) -> Arrays:
+        """Zero-copy upload of the STATIC class arrays — nothing mutates
+        them after __init__, so the alias is safe; GRAFT_SANITIZE=1 seals
+        the host sources to make that lifecycle claim crash-enforced."""
+        from kubernetes_tpu.analysis.sanitize import upload_frozen
         out = {}
         for k in ("fail_all", "forbid_static", "aff_active", "aff_allow",
                   "aff_has_static", "aff_self", "aff_keymask", "anti_active",
                   "anti_keymask", "m_aff", "m_anti", "prio_static", "p_w",
                   "p_keymask", "mp", "q_w", "q_keymask", "mq", "sp_static",
                   "sp_cls", "sp_has", "Z", "node_has_zone", "wave_gate"):
-            out[k] = jnp.asarray(getattr(self, k))
+            out[k] = upload_frozen(getattr(self, k))
         return out
 
 

@@ -41,32 +41,27 @@ from kubernetes_tpu.state.snapshot import (
 Arrays = Dict[str, jnp.ndarray]
 
 
+_NODE_ARRAY_KEYS = ("alloc", "requested", "nonzero", "pod_count",
+                    "allowed_pods", "schedulable", "mem_pressure",
+                    "disk_pressure", "labels", "taints_sched",
+                    "taints_pref", "port_bitmap", "valid", "avoid",
+                    "image_sizes", "vol_present", "vol_rw", "pd_present",
+                    "pd_counts", "pd_kind", "pd_max", "has_zone")
+
+
 def node_arrays(snap) -> Arrays:
-    """Assemble the node-side pytree from a ClusterSnapshot."""
-    return {
-        "alloc": jnp.asarray(snap.alloc),
-        "requested": jnp.asarray(snap.requested),
-        "nonzero": jnp.asarray(snap.nonzero),
-        "pod_count": jnp.asarray(snap.pod_count),
-        "allowed_pods": jnp.asarray(snap.allowed_pods),
-        "schedulable": jnp.asarray(snap.schedulable),
-        "mem_pressure": jnp.asarray(snap.mem_pressure),
-        "disk_pressure": jnp.asarray(snap.disk_pressure),
-        "labels": jnp.asarray(snap.labels),
-        "taints_sched": jnp.asarray(snap.taints_sched),
-        "taints_pref": jnp.asarray(snap.taints_pref),
-        "port_bitmap": jnp.asarray(snap.port_bitmap),
-        "valid": jnp.asarray(snap.valid),
-        "avoid": jnp.asarray(snap.avoid),
-        "image_sizes": jnp.asarray(snap.image_sizes),
-        "vol_present": jnp.asarray(snap.vol_present),
-        "vol_rw": jnp.asarray(snap.vol_rw),
-        "pd_present": jnp.asarray(snap.pd_present),
-        "pd_counts": jnp.asarray(snap.pd_counts),
-        "pd_kind": jnp.asarray(snap.pd_kind),
-        "pd_max": jnp.asarray(snap.pd_max),
-        "has_zone": jnp.asarray(snap.has_zone),
-    }
+    """Assemble the node-side pytree from a ClusterSnapshot.
+
+    Zero-copy VIEW seam: callers consume the dispatch synchronously
+    (the extender cold path, tests) before any snapshot mutation can run,
+    so aliasing the live snapshot arrays is safe AND free. Anything that
+    holds device work across host bookkeeping must go through the
+    engine's copying seam instead (_nodes_on_device — GL001's
+    copy-required contract). GRAFT_SANITIZE=1 upgrades these to verified
+    copies, so sanitized runs don't depend on the synchronous-consumption
+    argument at all."""
+    from kubernetes_tpu.analysis.sanitize import upload_view
+    return {k: upload_view(getattr(snap, k)) for k in _NODE_ARRAY_KEYS}
 
 
 def bucket(n: int, lo: int = 16) -> int:

@@ -849,6 +849,8 @@ class ClusterSnapshot:
                                for k in node.labels)
         self.dirty.update(self.STATIC)
 
+    # graftlint: gen-ok — per-row helper; every caller (_write_dynamic_row,
+    # finalize_images' rebuild loop) owns the dirty note for the batch
     def _write_image_row(self, i: int, images) -> None:
         row = np.zeros(self._images_width, dtype=np.int32)
         for img in images:
@@ -861,6 +863,8 @@ class ClusterSnapshot:
                 and self.image_sizes.shape[1] == self._images_width:
             self.image_sizes[i] = row
 
+    # graftlint: gen-ok — per-row helper; callers (_write_dynamic_row,
+    # finalize_volumes' rebuild loop) own the dirty note for the batch
     def _write_volume_presence_row(self, i: int) -> None:
         """Multi-hot conflict/PD presence over the demand-driven vocabs; a
         key no pending pod references has no column (and cannot conflict)."""

@@ -376,3 +376,30 @@ def test_wave_mode_required_affinity_invariants(seed):
     placements = [(p, nm) for p, nm in zip(pending, got)]
     err = _violates_required_anti(placements, nodes_by_name, all_pods)
     assert err is None, err
+
+
+def test_pipelined_fuzz_oracle_under_sanitizer(monkeypatch, seed=5):
+    """ISSUE 4 satellite: one wave-vs-strict-oracle fuzz case with every
+    upload seam armed (GRAFT_SANITIZE=1 — copy seams alias-asserted,
+    static bundles frozen). The sanitizer must catch nothing on the
+    current tree, the oracle invariants must hold, and placements must be
+    bit-identical to the unsanitized drain — proving the sanitizer is an
+    observer, not a participant."""
+    rng = random.Random(seed)
+    nodes, existing = _build_pipeline_cluster(rng)
+    for i, n in enumerate(nodes):
+        n.labels.setdefault("host", f"h{i}")
+    pending = _pending_required_mix(rng, 18)
+    got_ref = _drain_pipelined(nodes, existing, pending)
+
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    got = _drain_pipelined(nodes, existing, pending)
+    assert got == got_ref, "sanitizer changed placements"
+    nodes_by_name = {n.name: n for n in nodes}
+    all_pods = [(p, p.node_name) for p in existing] + \
+        [(p, got.get(p.name)) for p in pending]
+    placements = [(p, got.get(p.name)) for p in pending]
+    err = _violates_required_anti(placements, nodes_by_name, all_pods)
+    assert err is None, err
+    err = _violates_required_aff(placements, nodes_by_name, all_pods)
+    assert err is None, err

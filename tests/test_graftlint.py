@@ -1,0 +1,588 @@
+"""graftlint (ISSUE 4): per-rule fixtures, pragma/baseline plumbing, and
+the clean-tree gate.
+
+Fixture discipline: every rule fires on a minimal known-bad snippet AND
+stays silent on the blessed idiom — so a rule regression shows up as a
+missed fixture, not as a silent pass over the real tree. The clean-tree
+gate is the tier-1 contract of the whole subsystem: the package lints to
+ZERO unsuppressed findings (pragmas carry the justifications in-code; the
+shipped baseline is empty).
+
+Pure AST — no jax import, no device; the gate costs well under a second.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import kubernetes_tpu
+from kubernetes_tpu.analysis.lint import (
+    lint_gate,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+
+PKG_DIR = os.path.dirname(os.path.abspath(kubernetes_tpu.__file__))
+
+
+def lint_src(tmp_path, src, name="snippet.py", rules=None):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(src))
+    findings, _sup, errors = run_paths([str(f)], rules=rules)
+    assert not errors, errors
+    return findings
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------- GL001
+
+
+def test_gl001_fires_on_asarray_then_mutate(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def upload():
+            buf = np.zeros(8)
+            dev = jnp.asarray(buf)
+            buf[0] = 1.0
+            return dev
+    """)
+    assert rules_of(fs) == ["GL001"]
+
+
+def test_gl001_fires_on_class_scoped_alias(tmp_path):
+    """The r08 committed_nodes shape: upload in one method, in-place fold
+    in another — lifetime spans calls, so the alias must be assumed live."""
+    fs = lint_src(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        class Engine:
+            def dispatch(self, enc):
+                return jnp.asarray(enc.committed_nodes)
+
+            def harvest(self, enc, cls, node):
+                np.add.at(enc.committed_nodes, (cls, node), 1)
+    """)
+    assert rules_of(fs) == ["GL001"]
+
+
+def test_gl001_silent_on_copying_idioms(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def upload():
+            buf = np.zeros(8)
+            a = jnp.array(buf)          # copy constructor
+            b = jnp.asarray(buf.copy()) # explicit host copy
+            c = jnp.asarray(buf)        # alias, but buf is never mutated
+            return a, b, c
+    """)
+    assert fs == []
+
+
+def test_gl001_copy_required_contract(tmp_path):
+    """The machine-checked form of the old prose comments: downgrading a
+    copy-required seam to jnp.asarray fires; the copying form passes."""
+    bad = lint_src(tmp_path, """
+        import jax.numpy as jnp
+
+        def seam(host):
+            dev = jnp.asarray(host)  # graftlint: copy-required
+            return dev
+    """)
+    assert rules_of(bad) == ["GL001"]
+    good = lint_src(tmp_path, """
+        import jax.numpy as jnp
+
+        def seam(host):
+            dev = jnp.array(host)  # graftlint: copy-required
+            return dev
+    """, name="good.py")
+    assert good == []
+
+
+# ------------------------------------------------------------------- GL002
+
+
+GL002_BAD = """
+    import functools
+    import jax
+    import numpy as np
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def kernel(x, k=1):
+        return x * k
+
+    def hot_path(x):
+        out = kernel(x)
+        host = np.asarray(out)
+        return host
+"""
+
+
+def test_gl002_fires_on_sync_of_jitted_result(tmp_path):
+    fs = lint_src(tmp_path, GL002_BAD)
+    assert rules_of(fs) == ["GL002"]
+
+
+def test_gl002_pragma_blesses_the_sync(tmp_path):
+    fs = lint_src(tmp_path, GL002_BAD.replace(
+        "host = np.asarray(out)",
+        "host = np.asarray(out)  # graftlint: sync-ok"))
+    assert fs == []
+
+
+def test_gl002_silent_on_numpy_on_numpy(tmp_path):
+    """np.asarray of host data is free — taint only flows from jitted
+    calls and WaveHandle device fields, and a rebind clears it."""
+    fs = lint_src(tmp_path, """
+        import functools
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def fine(x):
+            res = kernel(x)
+            res = np.asarray(res)  # graftlint: sync-ok (the one fetch)
+            twice = np.asarray(res)      # already host: no second sync
+            n = int(res[0])              # host scalar
+            return twice, n
+    """)
+    assert fs == []
+
+
+def test_gl002_fires_on_device_handle_field(tmp_path):
+    fs = lint_src(tmp_path, """
+        import numpy as np
+
+        def harvest(handle):
+            return np.asarray(handle.packed)
+    """)
+    assert rules_of(fs) == ["GL002"]
+
+
+# ------------------------------------------------------------------- GL003
+
+
+def test_gl003_fires_on_jit_in_function(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+
+        def hot(xs):
+            f = jax.jit(lambda a: a + 1)
+            return [f(x) for x in xs]
+    """)
+    assert rules_of(fs) == ["GL003"]
+
+
+def test_gl003_fires_on_ragged_slice_in_loop(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            return x.sum()
+
+        def drain(queue, arr):
+            out = []
+            while queue:
+                n = queue.pop()
+                out.append(kernel(arr[:n]))
+            return out
+    """)
+    assert rules_of(fs) == ["GL003"]
+
+
+def test_gl003_silent_on_blessed_idioms(tmp_path):
+    """Module-level wrap/decorator and bucketed shapes pass."""
+    fs = lint_src(tmp_path, """
+        import functools
+        import jax
+
+        def _impl(x):
+            return x + 1
+
+        impl_jit = jax.jit(_impl)
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def kernel(x, k=1):
+            return x * k
+
+        def drain(queue, arr, pad):
+            out = []
+            while queue:
+                queue.pop()
+                out.append(kernel(arr))   # constant shape per drain
+            return out
+    """)
+    assert fs == []
+
+
+# ------------------------------------------------------------------- GL004
+
+
+def test_gl004_fires_on_attr_store_in_traced_scope(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+
+        def _impl(holder, x):
+            holder.last = x
+            return x + 1
+
+        impl_jit = jax.jit(_impl)
+    """)
+    assert rules_of(fs) == ["GL004"]
+
+
+def test_gl004_fires_on_global_append(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+
+        TRACE_LOG = []
+
+        @jax.jit
+        def kernel(x):
+            TRACE_LOG.append(x)
+            return x + 1
+    """)
+    assert rules_of(fs) == ["GL004"]
+
+
+def test_gl004_silent_on_pure_kernel_with_local_state(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def loop(x):
+            acc = []
+            acc.append(x)          # local container: fine
+
+            def body(c):
+                s, i = c
+                return (s + 1, i + 1)
+
+            def cond(c):
+                return c[1] < 4
+
+            return lax.while_loop(cond, body, (x, 0)), acc
+    """)
+    assert fs == []
+
+
+# ------------------------------------------------------------------- GL005
+
+
+GL005_BAD = """
+    import numpy as np
+
+    class Snapshot:
+        def __init__(self, n):
+            self.requested = np.zeros((n, 4), dtype=np.int32)
+            self.version = 0
+            self.dirty = set()
+
+        def write_row(self, i, row):
+            self.requested[i] = row
+"""
+
+
+def test_gl005_fires_on_unannounced_row_write(tmp_path):
+    fs = lint_src(tmp_path, GL005_BAD)
+    assert rules_of(fs) == ["GL005"]
+
+
+def test_gl005_silent_when_announced_or_blessed(tmp_path):
+    fs = lint_src(tmp_path, GL005_BAD.replace(
+        "self.requested[i] = row",
+        "self.requested[i] = row\n"
+        "            self.dirty.add(\"requested\")\n"
+        "            self.version += 1"))
+    assert fs == []
+    fs = lint_src(tmp_path, GL005_BAD.replace(
+        "        def write_row(self, i, row):",
+        "        # graftlint: gen-ok — caller owns the dirty note\n"
+        "        def write_row(self, i, row):"), name="blessed.py")
+    assert fs == []
+
+
+def test_gl005_silent_on_nonsnapshot_labels(tmp_path):
+    """A Pod's labels dict shares the attribute name but carries no
+    generation machinery — out of scope by construction."""
+    fs = lint_src(tmp_path, """
+        def admit(req):
+            req.obj.labels["key"] = "value"
+    """)
+    assert fs == []
+
+
+def test_gl005_fires_via_local_alias(tmp_path):
+    fs = lint_src(tmp_path, """
+        import numpy as np
+
+        class Snapshot:
+            def __init__(self, n):
+                self.requested = np.zeros((n, 4), dtype=np.int32)
+                self.dirty = set()
+
+            def write(self, idx, rows):
+                requested = self.requested
+                requested[idx] = rows
+    """)
+    assert rules_of(fs) == ["GL005"]
+
+
+# ----------------------------------------------- review-hardening guards
+
+
+def test_pragma_does_not_smear_over_the_function(tmp_path):
+    """A sync-ok on one statement must NOT bless a different unblessed
+    sync elsewhere in the same function (suppression anchors on the
+    smallest enclosing statement; function-wide blessing requires the
+    pragma on the def line itself)."""
+    fs = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def hot(x):
+            a = kernel(x)
+            b = np.asarray(a)  # graftlint: sync-ok (this one is blessed)
+            c = kernel(x)
+            d = np.asarray(c)
+            return b, d
+    """)
+    assert rules_of(fs) == ["GL002"]
+    assert "np.asarray" in fs[0].message
+
+
+def test_gl001_sees_through_upload_frozen(tmp_path):
+    """upload_frozen is jnp.asarray underneath — with GRAFT_SANITIZE unset
+    nothing seals the source, so mutating a frozen-seam buffer is the same
+    production race and must fire GL001 like the bare spelling."""
+    fs = lint_src(tmp_path, """
+        import numpy as np
+        from kubernetes_tpu.analysis import sanitize
+
+        class Enc:
+            def up(self):
+                return sanitize.upload_frozen(self.wave_gate)
+
+            def poke(self):
+                self.wave_gate[0] = 1
+    """)
+    assert rules_of(fs) == ["GL001"]
+    assert "upload_frozen" in fs[0].message
+
+
+def test_gl002_survives_same_line_mixed_rebinds(tmp_path):
+    """Two same-line rebinds of one name with mixed producers (jitted and
+    not) must not crash the taint-event sort (None vs str comparison) —
+    a lint-engine TypeError takes down the whole gate, not one rule."""
+    fs = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def hot(x):
+            out = kernel(x); out = np.zeros(3)
+            return out
+    """)
+    assert fs == []
+
+
+def test_empty_collection_fails_the_gate(tmp_path):
+    """A typo'd path must fail loudly, not lint zero files and pass."""
+    findings, _sup, errors = run_paths([str(tmp_path / "no_such_dir")])
+    assert findings == [] and errors, errors
+    ok, _report = lint_gate(str(tmp_path / "no_such_dir"))
+    assert not ok
+
+
+def test_bad_path_fails_even_beside_good_paths(tmp_path):
+    """A typo'd path must fail the run even when OTHER paths yield files —
+    else a CI arg list silently stops covering a renamed subtree."""
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    findings, _sup, errors = run_paths([str(good),
+                                        str(tmp_path / "renamed_away")])
+    assert findings == []
+    assert any("renamed_away" in e for e in errors), errors
+
+
+# ------------------------------------------------- baseline + CLI plumbing
+
+
+def test_baseline_suppresses_and_survives_line_drift(tmp_path):
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def upload():
+            buf = np.zeros(8)
+            dev = jnp.asarray(buf)
+            buf[0] = 1.0
+            return dev
+    """
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(src))
+    findings, _s, _e = run_paths([str(f)])
+    assert len(findings) == 1
+    bpath = tmp_path / "baseline.json"
+    write_baseline(str(bpath), findings)
+    base = load_baseline(str(bpath))
+    # shift every line down: the fingerprint (rule, path, qualname,
+    # message) must keep matching
+    f.write_text("# a new header comment\n# another\n"
+                 + textwrap.dedent(src))
+    findings2, sup, _e = run_paths([str(f)], baseline=base)
+    assert findings2 == [] and sup == 1
+
+
+def test_cli_clean_and_failing_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\ndef f(xs):\n"
+                   "    g = jax.jit(lambda a: a)\n    return g(xs)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis", str(bad)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PKG_DIR))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "GL003" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PKG_DIR))
+    assert r2.returncode == 0 and "GL005" in r2.stdout
+
+
+def test_baseline_path_form_stable_relative_vs_absolute(tmp_path,
+                                                        monkeypatch):
+    """A baseline written while linting a RELATIVE path must still
+    suppress when the same files are linted via the absolute dir
+    (lint_gate's default) — fingerprints must not embed the invocation
+    spelling of the path."""
+    pkg = tmp_path / "proj" / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(
+        "import numpy as np\nimport jax.numpy as jnp\n\n"
+        "def f():\n    b = np.zeros(4)\n"
+        "    d = jnp.asarray(b)\n    b[0] = 1\n    return d\n")
+    monkeypatch.chdir(tmp_path / "proj")
+    findings, _s, _e = run_paths(["pkg"])
+    assert len(findings) == 1
+    bpath = tmp_path / "b.json"
+    write_baseline(str(bpath), findings)
+    findings2, sup, _e = run_paths([str(pkg)],
+                                   baseline=load_baseline(str(bpath)))
+    assert findings2 == [] and sup == 1
+
+
+def test_write_baseline_roundtrip_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import numpy as np\nimport jax.numpy as jnp\n\n"
+                   "def f():\n    b = np.zeros(4)\n"
+                   "    d = jnp.asarray(b)\n    b[0] = 1\n    return d\n")
+    bpath = tmp_path / "b.json"
+    findings, _s, _e = run_paths([str(bad)])
+    write_baseline(str(bpath), findings)
+    data = json.loads(bpath.read_text())
+    assert len(data["suppressions"]) == 1
+    findings2, sup, _e = run_paths([str(bad)],
+                                   baseline=load_baseline(str(bpath)))
+    assert findings2 == [] and sup == 1
+
+
+def test_fingerprints_stable_across_cwd(tmp_path, monkeypatch):
+    """The same IN-REPO file must fingerprint identically whatever CWD the
+    linter runs from — a baseline regenerated by CI at the repo root must
+    keep suppressing for a wrapper script running elsewhere."""
+    from kubernetes_tpu.analysis.lint import _relpath
+
+    target = os.path.join(PKG_DIR, "engine", "waves.py")
+    monkeypatch.chdir(os.path.dirname(PKG_DIR))
+    a = _relpath(target)
+    monkeypatch.chdir(tmp_path)
+    b = _relpath(target)
+    assert a == b == os.path.join("kubernetes_tpu", "engine", "waves.py")
+
+
+def test_write_baseline_reports_parse_errors(tmp_path, capsys):
+    """--write-baseline over a tree with an unparseable file must fail
+    (exit 1) and say so — a 'successful' regeneration that silently
+    shrank coverage resurfaces the broken file's findings unsuppressed
+    the moment it is fixed."""
+    from kubernetes_tpu.analysis.__main__ import main
+
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    bpath = tmp_path / "b.json"
+    rc = main(["--write-baseline", str(bpath), str(tmp_path)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "broken.py" in err
+
+
+def test_write_baseline_regen_keeps_inherited_suppressions(tmp_path,
+                                                           capsys):
+    """--baseline old --write-baseline new must regenerate from the
+    UNFILTERED findings: the old file's suppressions land in the new one
+    instead of being silently dropped (which would resurrect them as
+    fresh findings on the very next --baseline run)."""
+    from kubernetes_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import numpy as np\nimport jax.numpy as jnp\n\n"
+                   "def f():\n    b = np.zeros(4)\n"
+                   "    d = jnp.asarray(b)\n    b[0] = 1\n    return d\n")
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    assert main(["--write-baseline", str(old), str(bad)]) == 0
+    assert main(["--baseline", str(old), "--write-baseline", str(new),
+                 str(bad)]) == 0
+    capsys.readouterr()
+    assert load_baseline(str(new)) == load_baseline(str(old)) != {}
+    assert main(["--baseline", str(new), str(bad)]) == 0
+
+
+# ------------------------------------------------------- the tier-1 gate
+
+
+def test_tree_lints_clean():
+    """THE gate: the whole package carries zero unsuppressed findings.
+    Every hazard is either fixed or pragma'd with its justification next
+    to the code (the shipped baseline is empty). A new finding here means
+    a new hazard entered the hot path — fix it or bless it, don't widen
+    the gate."""
+    ok, report = lint_gate(PKG_DIR)
+    assert ok, f"graftlint gate failed:\n{report}"
+
+
+def test_gate_is_pure_ast_fast():
+    """The gate must stay cheap enough for tier-1 and bench.py
+    --lint-gate: pure AST, no device, well under 10s even on the CI box."""
+    import time
+    t0 = time.perf_counter()
+    lint_gate(PKG_DIR)
+    assert time.perf_counter() - t0 < 10.0

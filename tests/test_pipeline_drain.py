@@ -391,3 +391,131 @@ def test_relabel_invalidates_affinity_encoding():
     got = placements(api)["za-2"]
     other_z1 = "n3" if z1_node == "n2" else "n2"
     assert got == other_z1, (got, z1_node)
+
+
+# ------------------------------------------------- runtime sanitizer (ISSUE 4)
+
+
+def test_headline_density_drain_under_sanitizer(monkeypatch):
+    """GRAFT_SANITIZE=1 on the headline shape (seeded density through the
+    pipelined drain): the armed upload seams must catch nothing and change
+    nothing — bit-identical placements vs the unsanitized run."""
+    def build():
+        nodes = hollow_nodes(96, seed=7)
+        pods = PROFILES["density"](700)
+        return mk_sched(nodes, pods, chunk=128)
+
+    api_ref, s_ref = build()
+    tot_ref = s_ref.run_until_drained()
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    api, s = build()
+    tot = s.run_until_drained()
+    assert tot["bound"] == tot_ref["bound"] == 700
+    assert placements(api) == placements(api_ref)
+
+
+def test_mixed_affinity_drain_under_sanitizer(monkeypatch):
+    """GRAFT_SANITIZE=1 proof run (ISSUE 4): a pipelined mixed-affinity
+    drain with every upload seam armed — copy seams assert they really
+    copied, frozen-alias seams seal their host sources. The sanitizer must
+    catch NOTHING on the current tree, and arming it must not change a
+    single placement (the A/B against the unsanitized run)."""
+    def build():
+        nodes = [make_node(f"n{i:02d}", cpu=8000, memory=32 * Gi, pods=110,
+                           labels={"host": f"h{i}", "zone": f"z{i % 2}"})
+                 for i in range(8)]
+        pods = []
+        for i in range(6):  # one-per-host anti: rides the wave path
+            p = make_pod(f"iso-{i}", cpu=100, memory=128 << 20,
+                         labels={"app": "iso"})
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "iso"}),
+                    namespaces=[], topology_key="host")]))
+            pods.append(p)
+        for i in range(4):  # zone co-location group: seeded strict tail
+            p = make_pod(f"co-{i}", cpu=100, memory=128 << 20,
+                         labels={"app": "co"})
+            p.affinity = Affinity(pod_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "co"}),
+                    namespaces=[], topology_key="zone")]))
+            pods.append(p)
+        pods += [make_pod(f"plain-{i}", cpu=200, memory=256 << 20)
+                 for i in range(12)]
+        return mk_sched(nodes, pods, chunk=5)
+
+    api_ref, s_ref = build()
+    tot_ref = s_ref.run_until_drained()
+
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    api, s = build()
+    tot = s.run_until_drained()
+    assert tot["bound"] == tot_ref["bound"] == 22
+    assert placements(api) == placements(api_ref), \
+        "arming the sanitizer must not change placements"
+    per_host = Counter(p.node_name for p in api.list("Pod")[0]
+                       if p.node_name and p.name.startswith("iso-"))
+    assert all(v == 1 for v in per_host.values()), per_host
+    zone_of = {n.name: n.labels["zone"] for n in api.list("Node")[0]}
+    co_zone = {zone_of[p.node_name] for p in api.list("Pod")[0]
+               if p.node_name and p.name.startswith("co-")}
+    assert len(co_zone) == 1, co_zone  # co-location honored under sanitize
+
+
+def _aligned_buf(shape, dtype, align=64):
+    """A numpy buffer the CPU backend is GUARANTEED to zero-copy when
+    handed to jnp.asarray (XLA's CPU client aliases only >=64-byte-aligned
+    host buffers — ordinary numpy allocations are 16-aligned, which is
+    exactly why the r07 race was flaky instead of reliable)."""
+    import numpy as np
+    size = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    raw = np.zeros(size + align, dtype=np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + size].view(dtype).reshape(shape)
+
+
+def test_sanitizer_catches_deliberate_aliasing_regression(monkeypatch):
+    """Re-introduce the exact r07/r08 regression shape — a copy-contract
+    seam silently degraded to jnp.asarray — and prove the sanitizer
+    crashes LOUDLY at the seam instead of letting a blind wave read a
+    mutating buffer. The ctor indirection (sanitize._copy_ctor) exists for
+    this test: it is the programmatic form of reverting the jnp.array fix."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from kubernetes_tpu.analysis import sanitize
+
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    buf = _aligned_buf((64, 8), np.int32)
+    if not np.shares_memory(np.asarray(jnp.asarray(buf)), buf):
+        pytest.skip("backend copies uploads — the aliasing race cannot "
+                    "exist here (CPU-only regression)")
+    monkeypatch.setattr(sanitize, "_copy_ctor", jnp.asarray)
+    with pytest.raises(sanitize.AliasingViolation):
+        sanitize.upload_copied(buf)
+    # the verified-copy seam (sanitize-mode node_arrays) must refuse too
+    with pytest.raises(sanitize.AliasingViolation):
+        sanitize.upload_view(buf)
+
+
+def test_sanitizer_freeze_crashes_at_the_offending_write(monkeypatch):
+    """upload_frozen seals its source: a late in-place write — the other
+    half of the aliasing race — dies at the WRITE site with numpy's
+    read-only error, not three waves later as a corrupted placement."""
+    import numpy as np
+    import pytest
+
+    from kubernetes_tpu.analysis import sanitize
+
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    host = np.ones((16, 4), dtype=np.int8)
+    sanitize.upload_frozen(host)
+    with pytest.raises(ValueError):
+        host[0, 0] = 7
+    # disabled -> pure pass-through, source stays writable
+    monkeypatch.setenv("GRAFT_SANITIZE", "0")
+    host2 = np.ones(8, dtype=np.int32)
+    sanitize.upload_frozen(host2)
+    host2[0] = 5  # no crash
