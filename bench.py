@@ -496,6 +496,105 @@ def measure_mixed_affinity(n_nodes: int, n_pods: int, warmup: bool = True):
         "mixed_wave_dispatch": cnt("engine.wave_dispatch"),
         "mixed_wave_tail_dispatch": cnt("engine.wave_tail_dispatch"),
         "mixed_wave_encode_build": cnt("engine.wave_encode_build"),
+        # conflict-round tail observability (ISSUE 5): how many round-loop
+        # dispatches the strict tail cost and how many sequential ROUNDS
+        # ran inside them — the whole point is rounds << tail pods; a
+        # regression back to per-pod depth shows up here, not only in
+        # wall clock
+        "mixed_tail_rounds": cnt("engine.tail_rounds"),
+        "mixed_tail_round_dispatch": cnt("engine.tail_round_dispatch"),
+    }
+
+
+def measure_gang_mix(n_nodes: int, n_pods: int, warmup: bool = True):
+    """ISSUE 5 gang scenario: the `gang_mix` profile (~20% of pods in
+    8–64-member full-quorum gangs, rest the mixed-affinity stream)
+    drained twice on the same box — once with gangs riding the pipelined
+    wave path (the new default) and once in FLUSH mode
+    (Scheduler.gang_pipeline=False: every gang-bearing chunk drains the
+    pipeline into the classic synchronous round — the r07/r08 behavior,
+    kept reachable as this A/B's baseline). Both runs use the same fixed
+    chunk so the comparison isolates the routing, not the chunking.
+
+    The default shape is 1k nodes / 6k pods, NOT the 5k/30k headline:
+    with gangs interleaved into every chunk, flush mode runs the WHOLE
+    mixed stream through the classic path — per-chunk AffinityData
+    rebuilds plus the full-label-axis strict scan, the costs
+    PROFILE_r08 measured at >3,500 s (timed out) on the headline shape.
+    The baseline must finish for the ratio to be a measurement.
+    Asserts the hard invariant: ZERO partially bound gangs in either
+    mode."""
+    import gc
+
+    from kubernetes_tpu.engine.gang import GANG_NAME_ANNOTATION
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    chunk = int(os.environ.get("BENCH_GANG_CHUNK", "1024"))
+
+    def drain(gang_pipeline: bool):
+        api, sched = build(n_nodes, n_pods, "gang_mix")
+        sched.gang_pipeline = gang_pipeline
+        t0 = time.monotonic()
+        totals = sched.run_until_drained(max_batch=chunk)
+        elapsed = time.monotonic() - t0
+        by_gang = {}
+        for p in api.list("Pod")[0]:
+            g = p.annotations.get(GANG_NAME_ANNOTATION)
+            if g is not None:
+                by_gang.setdefault(g, []).append(bool(p.node_name))
+        partial = sum(1 for flags in by_gang.values()
+                      if len(set(flags)) != 1)
+        return totals, elapsed, partial
+
+    if warmup:
+        # warm BOTH modes: the flush baseline must not be charged for
+        # cold XLA compiles the pipelined run already amortized
+        drain(True)
+        drain(False)
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    COUNTERS.reset()
+    try:
+        totals, elapsed, partial = drain(True)
+        snap = COUNTERS.snapshot()
+        _totals_f, elapsed_flush, partial_flush = drain(False)
+    finally:
+        gc.enable()
+        gc.unfreeze()
+
+    def cnt(name):
+        return snap.get(name, (0, 0.0))[0]
+
+    # the hard invariant, enforced loudly: a partially bound gang is a
+    # broken atomicity contract, not a perf data point — refuse to report
+    # numbers over it (same spirit as the lint gate; explicit raise, not
+    # a bare assert, so python -O cannot silently drop the check)
+    if partial or partial_flush:
+        raise RuntimeError(f"partially bound gangs: pipelined={partial} "
+                           f"flush={partial_flush}")
+    return {
+        "gangmix_pods_s": round(totals["bound"] / elapsed, 1)
+        if elapsed > 0 else 0.0,
+        "gangmix_elapsed_s": round(elapsed, 3),
+        "gangmix_bound": totals["bound"],
+        "gangmix_unschedulable": totals["unschedulable"],
+        "gangmix_partial_gangs": partial + partial_flush,  # 0 by the
+        # raise above — kept in the JSON so trajectory readers see the
+        # invariant was measured, not assumed
+        "gangmix_chunk": chunk,
+        # the A/B this scenario exists for: same drain with every
+        # gang-bearing chunk flushing the pipeline (the old routing)
+        "gangmix_flush_elapsed_s": round(elapsed_flush, 3),
+        "gangmix_speedup_vs_flush": round(elapsed_flush / elapsed, 2)
+        if elapsed > 0 else 0.0,
+        # routing observability (ISSUE 5): gangs dispatched wave-granular,
+        # gangs atomically rolled back at the fence, fence requeues
+        "gangmix_gang_wave_dispatch": cnt("engine.gang_wave_dispatch"),
+        "gangmix_gang_fence_rollbacks": cnt("engine.gang_fence_rollbacks"),
+        "gangmix_gang_requeued": totals.get("gang_requeued", 0),
+        "gangmix_fence_requeued": totals.get("fence_requeued", 0),
+        "gangmix_wave_dispatch": cnt("engine.wave_dispatch"),
     }
 
 
@@ -608,6 +707,21 @@ def main():
             print(f"bench: mixed-affinity measurement failed: {e}",
                   file=sys.stderr)
 
+    # gang-heavy drain (ISSUE 5): gangs on the pipeline vs the
+    # flush-every-gang baseline, same box, same chunk (BENCH_GANGMIX=0 to
+    # skip)
+    gangmix = None
+    if os.environ.get("BENCH_GANGMIX", "1") != "0":
+        try:
+            gangmix = measure_gang_mix(
+                int(os.environ.get("BENCH_GANGMIX_NODES", 1000)),
+                int(os.environ.get("BENCH_GANGMIX_PODS", 6000)),
+                warmup=warmup)
+        except Exception as e:
+            import sys
+            print(f"bench: gang-mix measurement failed: {e}",
+                  file=sys.stderr)
+
     bound = totals["bound"]
     pods_per_s = bound / elapsed if elapsed > 0 else 0.0
     c2b = sched.metrics.create_to_bound  # honest per-pod distribution:
@@ -653,14 +767,14 @@ def main():
         "arrival_p99_create_to_bound_ms": round(arrival["p99_ms"], 3)
         if arrival else None,
         "arrival_bound": arrival["bound"] if arrival else None,
-    }, **(mixed or {}))
+    }, **(mixed or {}), **(gangmix or {}))
     print(json.dumps(out))
 
-    # resume the bench trajectory (ISSUE 3 satellite): persist this round's
-    # numbers as the BENCH_r08 artifact — same {cmd, rc, parsed} shape as
+    # resume the bench trajectory (ISSUE 5 satellite): persist this round's
+    # numbers as the BENCH_r09 artifact — same {cmd, rc, parsed} shape as
     # the driver-written BENCH_r01..r05 files, so trajectory readers keep
     # working. BENCH_ARTIFACT= (empty) disables, or names another round.
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r08.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r09.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

@@ -177,6 +177,22 @@ def _batch_pre(pods: Arrays, nodes: Arrays,
     return static_fit, tt_cnt, na_cnt, static_score
 
 
+def check_affinity_priorities(priorities, aff, extra_score) -> None:
+    """Affinity-priority guard shared by every batch-placement entry point
+    (place_batch scan, waves.tail_rounds_loop): SelectorSpread/
+    InterPodAffinity in the priority set without class data or a frozen
+    extra_score would contribute silent zeros — a parity bug, never a
+    fallback."""
+    for nm, _w in priorities:
+        if nm in ("SelectorSpreadPriority", "InterPodAffinityPriority") \
+                and aff is None and extra_score is None:
+            raise ValueError(
+                f"{nm} in the priority set requires affinity/spread class "
+                "data (pass aff= from ops.affinity.AffinityData, or a "
+                "frozen extra_score) — silent zero contributions are a "
+                "parity bug, not a fallback")
+
+
 @functools.partial(jax.jit, static_argnames=("priorities", "aff_mode"))
 def gather_place_batch(cls_arr: Arrays, pc: jnp.ndarray, nodes: Arrays,
                        state: "NodeState", rr: jnp.ndarray, priorities,
@@ -225,14 +241,7 @@ def place_batch(pods: Arrays, nodes: Arrays, state: NodeState,
     """
     fits_on, prio_on, spread_on = aff_mode
     any_aff = aff is not None and (fits_on or prio_on or spread_on)
-    for nm, _w in priorities:
-        if nm in ("SelectorSpreadPriority", "InterPodAffinityPriority") \
-                and aff is None and extra_score is None:
-            raise ValueError(
-                f"{nm} in the priority set requires affinity/spread class "
-                "data (pass aff= from ops.affinity.AffinityData, or a "
-                "frozen extra_score) — silent zero contributions are a "
-                "parity bug, not a fallback")
+    check_affinity_priorities(priorities, aff, extra_score)
     w_ip = sum(w for nm, w in priorities
                if nm == "InterPodAffinityPriority") if prio_on else 0
     w_sp = sum(w for nm, w in priorities

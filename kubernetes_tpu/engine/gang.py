@@ -144,9 +144,10 @@ def schedule_gangs(engine, ready: List[Tuple[str, List[Pod], int]],
                 f"{len(unplaced)} stragglers past quorum retry solo"))
             continue
         # below quorum: rollback to zero residue (scheduler.go:234's
-        # ForgetPod, applied transactionally across the group)
+        # ForgetPod, applied transactionally across the group — ONE lock
+        # for the whole gang via the cache's bulk rollback)
+        engine.cache.forget_pods_bulk([r.pod for r in ok])
         for r in ok:
-            engine.cache.forget_pod(r.pod)
             engine.note_node_dirty(r.pod.node_name)
             r.pod.node_name = ""
         results.append(GangResult(

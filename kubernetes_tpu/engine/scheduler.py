@@ -25,10 +25,15 @@ Two drain modes:
   Required (anti-)affinity chunks are wave-eligible since ISSUE 3: the
   engine evaluates their masks per wave from device-resident topology
   occupancy, routes counter-inexpressible shapes to a seeded strict tail
-  inside the harvest, and the fence re-validates topology occupancy the
-  same way it re-validates capacity — only gangs, Policy algorithms,
-  workload spreading, and host-check/slot-overflow classes still flush
-  to the classic round.
+  inside the harvest (a conflict-round loop since ISSUE 5), and the
+  fence re-validates topology occupancy the same way it re-validates
+  capacity. Quorum-ready GANGS are wave-eligible since ISSUE 5: they
+  dispatch as ordinary batch rows and the harvest applies an
+  all-or-nothing gang fence — below quorum, every member is dropped
+  BEFORE anything is assumed (atomic rollback, zero residue) and
+  requeues with backoff. Only Policy algorithms, workload spreading,
+  and host-check/slot-overflow classes still flush to the classic
+  round.
 
 Error paths preserved:
 
@@ -113,6 +118,14 @@ class Scheduler:
         # capacity-unsafe watch event must flush before applying
         self.pipeline_chunk = 4096
         self._pipeline = None
+        # gangs ride the pipelined wave path (ISSUE 5): quorum-eligible
+        # gangs dispatch as ordinary wave batches with an all-or-nothing
+        # gang fence at harvest. False restores the r07/r08 behavior —
+        # every gang-bearing chunk flushes the pipeline into the classic
+        # synchronous round — kept reachable as the A/B baseline
+        # (bench.measure_gang_mix flips this attribute for the
+        # gangmix_flush_elapsed_s measurement).
+        self.gang_pipeline = True
         self.metrics = SchedulerMetrics()
         self.record_events = record_events
         self.events: List[Event] = []
@@ -309,24 +322,7 @@ class Scheduler:
             self._idle_gc()
             return stats
         trace.field("pods", len(pods))
-        ready_gangs = []
-        for gname, members in gangs.items():
-            if gname in self._gang_degraded:
-                # past the gang's atomicity point (quorum already bound):
-                # stragglers and bind-retries schedule individually instead
-                # of parking below quorum forever
-                plain.extend(members)
-                continue
-            waiting = self._gang_waiting.setdefault(gname, {})
-            if gname not in self._gang_parked_at:
-                self._gang_parked_at[gname] = self._now()
-            for m in members:
-                waiting[m.key()] = m
-            quorum = gangmod.min_available(list(waiting.values()))
-            if len(waiting) >= quorum:
-                ready_gangs.append((gname, list(waiting.values()), quorum))
-                del self._gang_waiting[gname]
-                self._gang_parked_at.pop(gname, None)
+        ready_gangs = self._gate_gangs(gangs, plain)
         t0 = time.monotonic()
         scheduled_count = len(plain) + sum(len(m) for _g, m, _q in
                                            ready_gangs)
@@ -413,6 +409,31 @@ class Scheduler:
         trace.log_if_long(SCHEDULE_TRACE_THRESHOLD_S
                           * max(scheduled_count, 1))
         return stats
+
+    def _gate_gangs(self, gangs: Dict[str, List[Pod]],
+                    plain: List[Pod]) -> List[Tuple[str, List[Pod], int]]:
+        """Quorum gating shared by the classic round and the pipelined
+        drain (ISSUE 5): degraded gangs' members (quorum already bound —
+        past the atomicity point) join the plain stream, below-quorum
+        gangs park in _gang_waiting until members arrive, and gangs whose
+        quorum is present are RELEASED from the parking lot and returned
+        as (name, members, quorum) ready for atomic placement."""
+        ready: List[Tuple[str, List[Pod], int]] = []
+        for gname, members in gangs.items():
+            if gname in self._gang_degraded:
+                plain.extend(members)
+                continue
+            waiting = self._gang_waiting.setdefault(gname, {})
+            if gname not in self._gang_parked_at:
+                self._gang_parked_at[gname] = self._now()
+            for m in members:
+                waiting[m.key()] = m
+            quorum = gangmod.min_available(list(waiting.values()))
+            if len(waiting) >= quorum:
+                ready.append((gname, list(waiting.values()), quorum))
+                del self._gang_waiting[gname]
+                self._gang_parked_at.pop(gname, None)
+        return ready
 
     def _sweep_parked_gangs(self, gangs) -> None:
         """Parked-too-long gangs surface even on empty rounds — a gang below
@@ -511,11 +532,52 @@ class Scheduler:
     # ------------------------------------------------------ pipelined drain
 
     def _wave_eligible(self, pods: List[Pod]) -> bool:
-        """Cheap host-side gate before dispatch: gangs schedule atomically
-        through the classic round; the engine applies the deeper checks
-        itself (host-path classes, policy, affinity slot overflow —
-        required (anti-)affinity itself rides the wave path, ISSUE 3)."""
+        """Cheap host-side gate before dispatch: with gang_pipeline off,
+        gang-bearing chunks flush to the classic round (the pre-ISSUE 5
+        behavior, kept as the bench A/B baseline); the engine applies the
+        deeper checks itself (host-path classes, policy, affinity slot
+        overflow — required (anti-)affinity and quorum-ready gangs ride
+        the wave path, ISSUEs 3/5)."""
+        if self.gang_pipeline:
+            return True
         return all(gangmod.gang_name(p) is None for p in pods)
+
+    def _release_gangs_for_wave(self, pods: List[Pod], stats: Dict[str, int]
+                                ) -> Tuple[List[Pod], Optional[list]]:
+        """Pipelined gang routing (ISSUE 5): partition a popped chunk,
+        park/degrade/release through the shared quorum gate, reject
+        provably-infeasible ready gangs host-side (capacity_precheck, the
+        classic path's cheap gate), and return (chunk_pods, gang_spans)
+        where gang_spans = [(name, member index range, quorum)] into
+        chunk_pods. Ready gangs lead the chunk — their members were queued
+        at or before this chunk's plain pods, and trailing them would let
+        a sustained plain stream starve contended gangs."""
+        plain, gangs = gangmod.partition(pods)
+        self._sweep_parked_gangs(gangs)
+        if not gangs:
+            return plain, None
+        ready = self._gate_gangs(gangs, plain)
+        members_first: List[Pod] = []
+        spans = []
+        if ready:
+            infos = self.cache.node_infos()
+            for name, members, quorum in ready:
+                if not gangmod.capacity_precheck(members, infos):
+                    stats["unschedulable"] += len(members)
+                    self.metrics.failed.inc(len(members))
+                    for m in members:
+                        self._event(m, "Warning", "FailedScheduling",
+                                    f"gang {name}: "
+                                    "InsufficientClusterCapacity")
+                        self.queue.add_backoff(
+                            dataclasses.replace(m, node_name=""))
+                    continue
+                start = len(members_first)
+                members_first.extend(members)
+                spans.append((name, list(range(start,
+                                               start + len(members))),
+                              quorum))
+        return members_first + plain, spans or None
 
     def _bind_bulk(self, pods: List[Pod]) -> List[Optional[str]]:
         """One bulk binding write for already-placed pods. Prefers the
@@ -563,8 +625,30 @@ class Scheduler:
         res = self.engine.harvest_waves(handle)
         out = {"popped": 0, "bound": 0, "bind_errors": 0, "preemptions": 0,
                "unschedulable": len(res.unschedulable),
-               "fence_requeued": len(res.conflicts)}
+               "fence_requeued": len(res.conflicts),
+               "gang_requeued": len(res.gang_requeued)}
         record = self.record_events
+        for name in res.gang_committed:
+            # quorum committed through the wave fence: the gang is past
+            # its atomicity point — later members/retries go solo
+            self._mark_gang_degraded(name)
+            # a straggler that popped while this wave was in flight was
+            # gated BEFORE the commit landed, so it parked below quorum;
+            # release it to schedule solo now instead of waiting out the
+            # 60s parked-gang sweep (the classic round marks degraded
+            # synchronously and never hits this window)
+            waiting = self._gang_waiting.pop(name, None)
+            self._gang_parked_at.pop(name, None)
+            if waiting:
+                for m in waiting.values():
+                    self.queue.add(m)
+        for pod, reason in res.gang_requeued:
+            # atomic gang rollback (nothing was assumed): requeue WITH
+            # backoff — the gang lost as a unit, like the classic round's
+            # below-quorum path; a retry re-waves it against fresh state
+            if record:
+                self._event(pod, "Warning", "FailedScheduling", reason)
+            self.queue.add_backoff(pod)
         for pod in res.conflicts:
             self.queue.add(pod)  # node_name never set on a fenced pod
         if res.unschedulable:
@@ -623,7 +707,8 @@ class Scheduler:
         and priority scheduling keep the classic synchronous rounds, and
         any chunk the engine cannot wave-place falls back per chunk."""
         total = {"popped": 0, "bound": 0, "unschedulable": 0,
-                 "bind_errors": 0, "preemptions": 0, "fence_requeued": 0}
+                 "bind_errors": 0, "preemptions": 0, "fence_requeued": 0,
+                 "gang_requeued": 0}
         if pipeline is None:
             pipeline = (self.batch_mode == "wave"
                         and not features.enabled("PodPriority"))
@@ -838,27 +923,33 @@ class _DrainPipeline:
             s._sweep_parked_gangs(())
         if pods:
             pop_ts = time.monotonic()
+            chunk_pods = pods
             if s._wave_eligible(pods):
-                handle = s.engine.dispatch_waves(pods, pop_ts)
-            if handle is None:
-                # chunk needs the strict/oracle machinery (gangs,
-                # host-check classes, affinity slot overflow, policy):
-                # drain the pipeline so the synchronous path sees every
-                # commit, then run it classic
+                # quorum-ready gangs ride the wave path as ordinary
+                # batches (ISSUE 5) — the harvest applies their
+                # all-or-nothing fence; below-quorum members park here
+                chunk_pods, gang_spans = s._release_gangs_for_wave(
+                    pods, stats)
+                if chunk_pods:
+                    handle = s.engine.dispatch_waves(chunk_pods, pop_ts,
+                                                     gangs=gang_spans)
+            if handle is None and chunk_pods:
+                # chunk needs the strict/oracle machinery (host-check
+                # classes, affinity slot overflow, policy — or gangs with
+                # gang_pipeline off): drain the pipeline so the
+                # synchronous path sees every commit, then run it classic
                 self.flush()
-                sub = s._process_batch(pods, pop_ts)
+                sub = s._process_batch(chunk_pods, pop_ts)
                 sub["popped"] = 0  # already counted
                 for k, v in sub.items():
                     stats[k] = stats.get(k, 0) + v
-            elif not self.overlap:
+            elif handle is not None and not self.overlap:
                 # sequential mode: forfeit the overlap only. The span is
                 # the profiler's measure of RAW per-wave device time (no
                 # host work runs between dispatch and this block)
                 from kubernetes_tpu.utils.trace import timed_span
                 with timed_span("pipeline.device_sync"):
                     handle.block()
-            if handle is not None:
-                s._sweep_parked_gangs(())  # wave chunks carry no gang pods
         prev, self.inflight = self.inflight, handle
         if prev is not None:
             for k, v in s._complete_wave(prev).items():

@@ -23,11 +23,13 @@ The pipelined drain rides the dispatch_waves / harvest_waves pair instead
 of schedule(): dispatch encodes a chunk (vocab_gen-keyed encoding reuse),
 launches waves_loop WITHOUT the device→host sync, and returns a WaveHandle;
 harvest blocks on the handle, re-validates the blind wave's placements
-against current occupancy (the capacity fence), assumes survivors columnar
-(grouped per node+class, folded into the snapshot via raw-delta math), and
-hands conflicts back for requeue. schedule() remains the synchronous path
-for everything the wave engine can't take (pod affinity, host-check
-classes, Policy algorithms).
+against current occupancy (the capacity fence, its topology mirror, and —
+for gang-bearing waves — the all-or-nothing gang fence), finishes
+strict-tail pods via the conflict-round loop (waves.tail_rounds_loop),
+assumes survivors columnar (grouped per node+class, folded into the
+snapshot via raw-delta math), and hands conflicts back for requeue.
+schedule() remains the synchronous path for everything the wave engine
+can't take (host-check classes, Policy algorithms, workload spreading).
 """
 
 from __future__ import annotations
@@ -728,11 +730,11 @@ class WaveHandle:
 
     __slots__ = ("pods", "pc", "enc", "packed", "state_out", "counter_out",
                  "nodes", "blind", "pop_ts", "dispatch_ts", "pad_floor",
-                 "committed_out", "strict_idx")
+                 "committed_out", "strict_idx", "gangs")
 
     def __init__(self, pods, pc, enc, packed, state_out, counter_out, nodes,
                  blind, pop_ts, dispatch_ts, pad_floor=0,
-                 committed_out=None, strict_idx=None):
+                 committed_out=None, strict_idx=None, gangs=None):
         self.pad_floor = pad_floor
         self.pods = pods
         self.pc = pc                  # host int32 [n] class index per pod
@@ -749,6 +751,10 @@ class WaveHandle:
         # inactive on the wave path, placed by harvest's tail scan
         self.strict_idx = strict_idx if strict_idx is not None \
             else np.empty(0, dtype=np.int64)
+        # quorum-ready gangs riding this wave (ISSUE 5): [(name, member
+        # indices into `pods`, quorum)] — the harvest's gang fence commits
+        # or atomically rolls back each one
+        self.gangs = gangs or []
 
     def block(self) -> None:
         """Force device completion now (sequential/debug mode): the values
@@ -760,15 +766,23 @@ class WaveHandle:
 class WaveHarvest:
     """Fenced result of one wave: pods to bind (node_name set, already
     assumed), fence conflicts to requeue WITHOUT backoff (a capacity race
-    with the blind wave, not unschedulability), and unschedulable pods."""
+    with the blind wave, not unschedulability), unschedulable pods, and —
+    for gang-bearing waves (ISSUE 5) — the gangs whose quorum committed
+    (the caller marks them degraded) plus the members of gangs the fence
+    ROLLED BACK atomically (requeue WITH backoff: the gang lost as a
+    unit, exactly the below-quorum rollback of the classic round)."""
 
-    __slots__ = ("bound", "conflicts", "unschedulable", "t_block")
+    __slots__ = ("bound", "conflicts", "unschedulable", "t_block",
+                 "gang_committed", "gang_requeued")
 
-    def __init__(self, bound, conflicts, unschedulable, t_block):
+    def __init__(self, bound, conflicts, unschedulable, t_block,
+                 gang_committed=None, gang_requeued=None):
         self.bound = bound
         self.conflicts = conflicts
         self.unschedulable = unschedulable
         self.t_block = t_block
+        self.gang_committed = gang_committed or []
+        self.gang_requeued = gang_requeued or []  # [(pod, reason)]
 
 
 class SchedulingEngine:
@@ -808,12 +822,33 @@ class SchedulingEngine:
         # pipelined-drain state (dispatch_waves/harvest_waves)
         self._wave_enc = None
         self._rr_chain = None  # device RR counter chaining between waves
+        # per-encoding cache of waves.precompute (the capacity-INdependent
+        # [C, N] tensors): every wave/tail dispatch of a drain used to
+        # recompute the selector/taint/node-affinity label-axis matmuls —
+        # the largest per-dispatch device cost once the loops themselves
+        # went round-granular (ISSUE 5). Keyed on the encoding object and
+        # the IDENTITY of the static node device arrays (_nodes_on_device
+        # replaces a buffer only when the snapshot marked it dirty, so
+        # identity is the exact staleness signal).
+        self._pre_cache = None
         self._blind_listeners: List[set] = []  # per-inflight-wave touch sets
         # pod-axis padding floor for dispatch_waves: the pipeline pins this
         # to its chunk size so an arrival stream's ragged pops (345, 589,
         # 100, ...) all reuse ONE compiled wave shape instead of paying a
         # multi-second XLA compile per fresh power-of-2 bucket mid-stream
         self.wave_pad_floor = 0
+        # conflict-round tail (ISSUE 5): the harvest's seeded strict tail
+        # runs as waves.tail_rounds_loop (round-depth sequentiality, exact
+        # required-affinity semantics, wave-style tie-breaks) when the
+        # tail is big enough to pay for the round body; small tails keep
+        # the per-pod scan, whose per-step cost is a fraction of a round.
+        # GRAFT_TAIL_ROUNDS=0 forces the scan everywhere (the oracle mode
+        # the tail-round fuzz compares against); GRAFT_TAIL_ROUNDS_MIN
+        # moves the crossover (0 = rounds always).
+        import os as _os
+        self.tail_rounds = _os.environ.get("GRAFT_TAIL_ROUNDS", "1") != "0"
+        self.tail_rounds_min = int(
+            _os.environ.get("GRAFT_TAIL_ROUNDS_MIN", "48"))
 
     # ------------------------------------------------------------------ api
 
@@ -1172,6 +1207,34 @@ class SchedulingEngine:
         return tuple((nm, w) for nm, w in self.priorities
                      if nm not in prio.AFFINITY_PRIORITIES)
 
+    _STATE_NODE_KEYS = frozenset({
+        "requested", "nonzero", "pod_count", "port_bitmap",
+        "vol_present", "vol_rw", "pd_present", "pd_counts"})
+
+    def _tail_wave_pre(self, enc: "_WaveEncoding", nodes):
+        """The drain's shared waves.precompute instance (see _pre_cache).
+        precompute reads only the class encoding and STATIC node arrays —
+        the evolving NodeState is threaded separately — and it skips
+        InterPodAffinity/SelectorSpread names outright, so one instance
+        computed at the kernel priorities serves both the wave loop and
+        the (possibly IP-bearing) tail priorities byte-for-byte."""
+        from kubernetes_tpu.utils.trace import COUNTERS
+
+        # the key holds the STATIC device arrays THEMSELVES (not their
+        # id()s): the cache must keep them alive so a freed buffer's
+        # recycled address can never alias a fresh upload into a stale hit
+        key = tuple(nodes[k] for k in sorted(nodes)
+                    if k not in self._STATE_NODE_KEYS)
+        hit = self._pre_cache
+        if hit is not None and hit[0] is enc and len(hit[1]) == len(key) \
+                and all(a is b for a, b in zip(hit[1], key)):
+            return hit[2]
+        COUNTERS.inc("engine.wave_pre_build")
+        pre = waves.precompute_jit(enc.cls_arr, nodes,
+                                   self._kernel_priorities())
+        self._pre_cache = (enc, key, pre)
+        return pre
+
     def _wave_encoding(self, pods: Sequence[Pod], infos):
         """(encoding, pod_class[n]) for a pipeline chunk, via the
         (vocab_gen, aff_seq)-keyed reuse cache; None when any class is not
@@ -1298,8 +1361,8 @@ class SchedulingEngine:
             labels_gen=snap.labels_gen)
         return self._wave_enc, batch.pod_class[len(seed):].copy()
 
-    def dispatch_waves(self, pods: Sequence[Pod],
-                       pop_ts: float = 0.0) -> Optional[WaveHandle]:
+    def dispatch_waves(self, pods: Sequence[Pod], pop_ts: float = 0.0,
+                       gangs=None) -> Optional[WaveHandle]:
         """Encode a chunk and launch its wave placement WITHOUT blocking —
         the device computes while the caller does the previous wave's
         bookkeeping (JAX async dispatch). The chunk is evaluated against the
@@ -1312,7 +1375,13 @@ class SchedulingEngine:
         when the chunk needs the classic path (policy algorithms,
         workloads/spreading, host-check classes, affinity slot overflow) —
         the caller must then flush the pipeline and run the synchronous
-        engine."""
+        engine.
+
+        `gangs` = [(name, member indices into `pods`, quorum)]: quorum-
+        ready gangs riding this wave as ordinary batch rows (ISSUE 5).
+        Dispatch treats them like any other pod; atomicity lives entirely
+        in harvest_waves' gang fence, so the pipeline never drains for a
+        gang chunk."""
         import time as _time
 
         from kubernetes_tpu.utils.trace import COUNTERS, timed_span
@@ -1375,24 +1444,28 @@ class SchedulingEngine:
                     self._kernel_priorities(), 64, extra_score=extra,
                     aff=enc.aff_wave_dev,
                     committed0=committed_dev,
-                    active0=jnp.asarray(act))
+                    active0=jnp.asarray(act),
+                    pre=self._tail_wave_pre(enc, nodes))
                 if strict_idx.size:
                     COUNTERS.inc("engine.affinity_strict_tail",
                                  int(strict_idx.size))
             else:
                 packed, state_out = waves.waves_loop(
                     enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
-                    self._kernel_priorities(), 64, extra_score=extra)
+                    self._kernel_priorities(), 64, extra_score=extra,
+                    pre=self._tail_wave_pre(enc, nodes))
             counter_out = packed[3 * p_pad].astype(jnp.uint32)
             self._rr_chain = counter_out
             blind: set = set()
             self._blind_listeners.append(blind)
             COUNTERS.inc("engine.wave_dispatch")
+            if gangs:
+                COUNTERS.inc("engine.gang_wave_dispatch", len(gangs))
             return WaveHandle(list(pods), pc, enc, packed, state_out,
                               counter_out, nodes, blind, pop_ts,
                               _time.monotonic(), self.wave_pad_floor,
                               committed_out=committed_out,
-                              strict_idx=strict_idx)
+                              strict_idx=strict_idx, gangs=gangs)
 
     def harvest_waves(self, handle: WaveHandle) -> WaveHarvest:
         """Block on one wave's device→host sync, fence its placements
@@ -1481,16 +1554,41 @@ class SchedulingEngine:
                         (nm, w) for nm, w in self.priorities
                         if nm != "SelectorSpreadPriority")
             COUNTERS.inc("engine.wave_tail_dispatch")
-            sel_s, fc_s, _st, rr_d = gather_place_batch(
-                enc.cls_arr, jnp.asarray(pcs), handle.nodes,
-                handle.state_out, jnp.uint32(counter_h), tail_prios,
-                aff=aff_arrays, aff_mode=aff_mode, aff_init=aff_init)
-            # seeded strict-tail fetch: the fence below needs these rows
-            # on host NOW, and the main wave result is already fetched —
-            # the tail is the last device work in this harvest
-            sel[tail_idx] = np.asarray(sel_s)[:n_tail]  # graftlint: sync-ok
-            fc[tail_idx] = np.asarray(fc_s)[:n_tail]  # graftlint: sync-ok
-            counter_h = int(rr_d)  # graftlint: sync-ok (scalar, device idle)
+            if self.tail_rounds and n_tail >= self.tail_rounds_min:
+                # conflict-round tail (ISSUE 5): the whole tail as ONE
+                # while_loop dispatch whose sequential depth is the round
+                # count — required semantics exact at every commit, tie-
+                # breaks wave-style (waves.tail_rounds_loop docstring)
+                COUNTERS.inc("engine.tail_round_dispatch")
+                with timed_span("pipeline.tail"):
+                    packed_t, _st = waves.tail_rounds_loop(
+                        enc.cls_arr, handle.nodes, handle.state_out,
+                        jnp.asarray(pcs), jnp.uint32(counter_h), tail_prios,
+                        aff=aff_arrays, aff_mode=aff_mode, aff_init=aff_init,
+                        pre=self._tail_wave_pre(enc, handle.nodes))
+                    # seeded tail fetch: the fence below needs these rows
+                    # on host NOW — the tail is the last device work in
+                    # this harvest
+                    packed_th = np.asarray(packed_t)  # graftlint: sync-ok
+                p_t = len(pcs)
+                sel[tail_idx] = packed_th[:n_tail]
+                fc[tail_idx] = packed_th[p_t:p_t + n_tail]
+                counter_h = int(np.uint32(packed_th[2 * p_t]))
+                COUNTERS.inc("engine.tail_rounds",
+                             int(packed_th[2 * p_t + 1]))
+            else:
+                # per-pod scan (small tails, and the GRAFT_TAIL_ROUNDS=0
+                # oracle mode): classic sequential semantics, the
+                # constraint reference the round fuzz compares against
+                with timed_span("pipeline.tail"):
+                    sel_s, fc_s, _st, rr_d = gather_place_batch(
+                        enc.cls_arr, jnp.asarray(pcs), handle.nodes,
+                        handle.state_out, jnp.uint32(counter_h), tail_prios,
+                        aff=aff_arrays, aff_mode=aff_mode, aff_init=aff_init)
+                    # same fetch contract as the rounds branch above
+                    sel[tail_idx] = np.asarray(sel_s)[:n_tail]  # graftlint: sync-ok
+                    fc[tail_idx] = np.asarray(fc_s)[:n_tail]  # graftlint: sync-ok
+                    counter_h = int(rr_d)  # graftlint: sync-ok (scalar)
         if self._rr_chain is handle.counter_out:
             self._rr_chain = None
         self.rr.counter = counter_h
@@ -1498,69 +1596,110 @@ class SchedulingEngine:
 
         pods = handle.pods
         strag = set(straggler_idx.tolist())
-        unschedulable = [(pods[i], int(fc[i]))
-                         for i in np.nonzero(sel < 0)[0].tolist()
-                         if i not in strag]
-        bound: List[Pod] = []
-        conflicts: List[Pod] = [pods[i] for i in straggler_idx.tolist()]
         placed_idx = np.nonzero(sel >= 0)[0]
+        acc_idx = np.empty(0, dtype=np.int64)
+        acc_node = np.empty(0, dtype=np.int64)
+        acc_cls = np.empty(0, dtype=np.int32)
+        conflict_idx: List[int] = []
         if placed_idx.size:
             with timed_span("pipeline.fence"):
                 acc_idx, acc_node, acc_cls, conflict_idx = \
                     self._fence(handle, sel, placed_idx)
-            conflicts += [pods[i] for i in conflict_idx]
-            if acc_idx.size:
-                names = snap.node_names
-                groups = []
-                acc_l = acc_idx.tolist()
-                node_l = acc_node.tolist()
-                cls_l = acc_cls.tolist()
-                change = np.nonzero((acc_node[1:] != acc_node[:-1])
-                                    | (acc_cls[1:] != acc_cls[:-1]))[0] + 1
-                bounds = [0] + change.tolist() + [len(acc_l)]
-                with timed_span("pipeline.assume"):
-                    for b0, b1 in zip(bounds[:-1], bounds[1:]):
-                        name = names[node_l[b0]]
-                        run = [pods[i] for i in acc_l[b0:b1]]
-                        for p in run:
-                            p.node_name = name
-                        groups.append((name, run) + enc.derived[cls_l[b0]])
-                    infos_touched = self.cache.assume_pods_grouped(groups)
-                    # fold the assumes into the snapshot WITHOUT a node
-                    # walk: classes with pure base-resource footprints go
-                    # through the exact raw-delta path (generation synced
-                    # so the next refresh skips these nodes); the rest take
-                    # the normal dirty-note rewrite
-                    dok = enc.delta_ok[acc_cls]
-                    dirty_names = {names[i] for i in
-                                   set(acc_node[~dok].tolist())}
-                    if dok.any():
-                        snap.apply_assume_delta(
-                            acc_node[dok], enc.raw_rows[acc_cls[dok]],
-                            [(nm, info) for nm, info in
-                             infos_touched.items()
-                             if nm not in dirty_names])
-                    if dirty_names:
-                        self._touch(dirty_names)
-                    blind_names = [nm for nm in infos_touched
-                                   if nm not in dirty_names]
-                    for s in self._blind_listeners:
-                        s.update(blind_names)
-                if enc.adata is not None and enc is self._wave_enc:
-                    # fold fence-accepted commits into the encoding's
-                    # cumulative per-node topology occupancy — the host
-                    # mirror the next dispatch seeds the device loop from —
-                    # and into its aff_seq expectation (assume_pods_grouped
-                    # just bumped cache.aff_seq once per affinity pod). A
-                    # stale enc skips both: its aff_seq mismatch forces the
-                    # next dispatch to rebuild from the live NodeInfos,
-                    # which already contain these assumes.
-                    if enc.committed_nodes is not None:
-                        np.add.at(enc.committed_nodes, (acc_cls, acc_node),
-                                  1)
-                    enc.aff_seq += int(enc.has_aff_pod[acc_cls].sum())
-                bound = [pods[i] for i in sorted(acc_l)]
-        return WaveHarvest(bound, conflicts, unschedulable, t_block)
+        # the GANG FENCE (ISSUE 5): all-or-nothing atomicity for gangs that
+        # rode this wave as ordinary batches. A gang COMMITS when >= quorum
+        # members survived placement AND the capacity/topology fence; below
+        # quorum, every member — placed, fenced, or unschedulable — is
+        # dropped from the accepted set BEFORE anything is assumed (atomic
+        # rollback with zero partial residue, by construction: nothing of a
+        # losing gang ever reaches the cache) and requeues WITH backoff,
+        # exactly the classic round's below-quorum semantics.
+        gang_committed: List[str] = []
+        gang_requeued: List[Tuple[Pod, str]] = []
+        drop = None
+        if handle.gangs:
+            acc_mask = np.zeros(n, dtype=bool)
+            acc_mask[acc_idx] = True
+            drop = np.zeros(n, dtype=bool)
+            for gname, idxs, quorum in handle.gangs:
+                ia = np.asarray(idxs, dtype=np.int64)
+                ok_n = int(acc_mask[ia].sum())
+                if ok_n >= quorum:
+                    gang_committed.append(gname)
+                    continue
+                COUNTERS.inc("engine.gang_fence_rollbacks")
+                drop[ia] = True
+                reason = (f"gang {gname}: only {ok_n}/{len(ia)} members "
+                          f"placeable past the wave fence (quorum {quorum})")
+                gang_requeued.extend((pods[int(i)], reason) for i in ia)
+            if drop.any():
+                keep = ~drop[acc_idx]
+                acc_idx = acc_idx[keep]
+                acc_node = acc_node[keep]
+                acc_cls = acc_cls[keep]
+            else:
+                drop = None
+        unschedulable = [(pods[i], int(fc[i]))
+                         for i in np.nonzero(sel < 0)[0].tolist()
+                         if i not in strag and (drop is None or not drop[i])]
+        bound: List[Pod] = []
+        conflicts: List[Pod] = [pods[i] for i in straggler_idx.tolist()
+                                if drop is None or not drop[i]]
+        conflicts += [pods[i] for i in conflict_idx
+                      if drop is None or not drop[i]]
+        if acc_idx.size:
+            names = snap.node_names
+            groups = []
+            acc_l = acc_idx.tolist()
+            node_l = acc_node.tolist()
+            cls_l = acc_cls.tolist()
+            change = np.nonzero((acc_node[1:] != acc_node[:-1])
+                                | (acc_cls[1:] != acc_cls[:-1]))[0] + 1
+            bounds = [0] + change.tolist() + [len(acc_l)]
+            with timed_span("pipeline.assume"):
+                for b0, b1 in zip(bounds[:-1], bounds[1:]):
+                    name = names[node_l[b0]]
+                    run = [pods[i] for i in acc_l[b0:b1]]
+                    for p in run:
+                        p.node_name = name
+                    groups.append((name, run) + enc.derived[cls_l[b0]])
+                infos_touched = self.cache.assume_pods_grouped(groups)
+                # fold the assumes into the snapshot WITHOUT a node
+                # walk: classes with pure base-resource footprints go
+                # through the exact raw-delta path (generation synced
+                # so the next refresh skips these nodes); the rest take
+                # the normal dirty-note rewrite
+                dok = enc.delta_ok[acc_cls]
+                dirty_names = {names[i] for i in
+                               set(acc_node[~dok].tolist())}
+                if dok.any():
+                    snap.apply_assume_delta(
+                        acc_node[dok], enc.raw_rows[acc_cls[dok]],
+                        [(nm, info) for nm, info in
+                         infos_touched.items()
+                         if nm not in dirty_names])
+                if dirty_names:
+                    self._touch(dirty_names)
+                blind_names = [nm for nm in infos_touched
+                               if nm not in dirty_names]
+                for s in self._blind_listeners:
+                    s.update(blind_names)
+            if enc.adata is not None and enc is self._wave_enc:
+                # fold fence-accepted commits into the encoding's
+                # cumulative per-node topology occupancy — the host
+                # mirror the next dispatch seeds the device loop from —
+                # and into its aff_seq expectation (assume_pods_grouped
+                # just bumped cache.aff_seq once per affinity pod). A
+                # stale enc skips both: its aff_seq mismatch forces the
+                # next dispatch to rebuild from the live NodeInfos,
+                # which already contain these assumes.
+                if enc.committed_nodes is not None:
+                    np.add.at(enc.committed_nodes, (acc_cls, acc_node),
+                              1)
+                enc.aff_seq += int(enc.has_aff_pod[acc_cls].sum())
+            bound = [pods[i] for i in sorted(acc_l)]
+        return WaveHarvest(bound, conflicts, unschedulable, t_block,
+                           gang_committed=gang_committed,
+                           gang_requeued=gang_requeued)
 
     def _fence(self, handle: WaveHandle, sel: np.ndarray,
                placed_idx: np.ndarray):

@@ -94,7 +94,16 @@ def precompute(cls: Arrays, nodes: Arrays,
     """Everything state-INdependent, computed once per batch OUTSIDE the
     wave loop (XLA cannot hoist work out of a lax.while_loop body): the
     static predicate mask, the reduce-priority count matrices, and the
-    weighted sum of static priorities."""
+    weighted sum of static priorities.
+
+    The result depends only on the CLASS encoding and the STATIC node
+    arrays — not on the evolving NodeState — so a pipelined drain reuses
+    one instance across every wave/tail dispatch of an encoding
+    (engine/scheduler_engine._tail_wave_pre): the selector/taint/
+    node-affinity label-axis matmuls in here are the single largest
+    per-dispatch cost once the loops themselves are round-granular.
+    `precompute_jit` is the standalone entry point for that caching;
+    the loops keep computing it inline when no `pre` is passed."""
     c = cls["req"].shape[0]
     n = nodes["alloc"].shape[0]
     static_score = jnp.zeros((c, n), dtype=jnp.int32)
@@ -121,6 +130,9 @@ def precompute(cls: Arrays, nodes: Arrays,
         else jnp.zeros((c, n), dtype=jnp.int32)
     return {"static_fit": preds.static_fits(cls, nodes),
             "static_score": static_score, "tt_cnt": tt_cnt, "na_cnt": na_cnt}
+
+
+precompute_jit = jax.jit(precompute, static_argnames=("priorities",))
 
 
 def _wave_scores(cls: Arrays, nodes: Arrays, state: NodeState,
@@ -455,6 +467,7 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
                aff: Arrays = None,
                committed0: jnp.ndarray = None,
                active0: jnp.ndarray = None,
+               pre: Arrays = None,
                ) -> Union[Tuple[jnp.ndarray, NodeState],
                           Tuple[jnp.ndarray, NodeState, jnp.ndarray]]:
     """The whole wave iteration as ONE device program (lax.while_loop over
@@ -475,8 +488,10 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
     strict scan). The trailing occupancy is returned only when `aff` is
     given."""
     P = pod_class.shape[0]
-    pre = precompute(cls, nodes, priorities)  # hoisted: while_loop bodies
-    # re-execute everything every iteration; XLA cannot hoist for us
+    if pre is None:  # hoisted: while_loop bodies re-execute everything
+        # every iteration and XLA cannot hoist for us; callers draining
+        # many chunks pass the per-encoding cached instance instead
+        pre = precompute(cls, nodes, priorities)
     if extra_score is not None:  # batch-frozen spread/interpod scores
         pre = dict(pre, static_score=pre["static_score"] + extra_score)
     if aff is not None:
@@ -513,6 +528,293 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
     if aff is None:
         return packed, state
     return packed, state, committed
+
+
+@functools.partial(jax.jit, static_argnames=("priorities", "aff_mode"))
+def tail_rounds_loop(cls: Arrays, nodes: Arrays, state: NodeState,
+                     pod_class: jnp.ndarray, counter: jnp.ndarray,
+                     priorities: Tuple[Tuple[str, int], ...],
+                     aff: Arrays = None,
+                     aff_mode: Tuple[bool, bool, bool] = (False, False, False),
+                     aff_init=None,
+                     pre: Arrays = None,
+                     ) -> Tuple[jnp.ndarray, NodeState]:
+    """The seeded strict tail as CONFLICT ROUNDS — one device program
+    whose sequential depth is the number of rounds (a handful), not the
+    number of tail pods (hundreds), with required-(anti-)affinity
+    semantics EXACT at every commit.
+
+    The per-pod scan (engine/batch.place_batch, still reachable via
+    GRAFT_TAIL_ROUNDS=0) serializes the whole tail to keep two things
+    exact: the affinity occupancy each pod evaluates against, and the
+    classic one-at-a-time tie-break order. Only the first is a
+    CONSTRAINT; the second is the same tie-spreading freedom every
+    wave-mode class already trades away (PROFILE_r08 §6 — batch-defined
+    RR fan-out instead of the classic serialized order). So each round:
+
+      1. re-evaluates the REQUIRED mask for every class exactly against
+         the cumulative occupancy carry (ops/affinity.step_fits_all over
+         the projected domain columns — allow side, own anti, the
+         symmetry direction, and the lone-bootstrap rule, bit-identical
+         per class to the scan's per-step mask), plus exact capacity
+         predicates and scores;
+      2. places every still-active pod wave-style: FIFO prefix RR draws
+         over the per-class tie sets, per-node FIFO conflict resolution
+         with exact integer capacity and the score-aware window (the
+         _wave_once discipline);
+      3. gates the commits whose own effects the round-start mask cannot
+         see: a class still BOOTSTRAPPING an allow-side group (no static
+         or committed match yet) commits at most ONE pod per round — the
+         group picks its domain serially, then fans out — and classes
+         coupled through any required ANTI term (as source or target,
+         m_aff is monotone-benign but m_anti is not) commit at most one
+         pod per round ACROSS the whole coupled pool, so a commit can
+         never invalidate a same-round placement made under the stale
+         mask. Allow-satisfied, anti-free classes fan out freely: their
+         masks can only widen as the round's commits land.
+      4. retires placed pods; fit_count==0 pods stay active while ANY
+         commit lands (an allow-side commit may widen their mask — the
+         scan's order-dependent schedulability, reproduced round-
+         granular) and retire as unschedulable the first round nothing
+         commits, which is also the loop exit.
+
+    Every round with a placeable pod commits at least one (the first
+    active pod survives per-node rank-0 resolution and every quota), so
+    the loop terminates in <= P+1 rounds; the typical mixed-affinity
+    tail is one bootstrap round per co-location group plus one or two
+    fan-out rounds. Placements stay deterministic — the pipelined ==
+    sequential (overlap=False) A/B holds bit-exactly — but tie-breaks
+    follow wave semantics, the same documented divergence as every
+    other wave-path class. Spread scoring is not modeled here (the
+    harvest tail never runs it).
+
+    Returns (packed, final NodeState) with packed =
+    [selected(P), fit_count(P), counter, rounds_used]."""
+    from kubernetes_tpu.engine.batch import check_affinity_priorities
+    from kubernetes_tpu.ops import affinity as aff_ops
+
+    fits_on, prio_on, spread_on = aff_mode
+    if spread_on:
+        raise ValueError("tail_rounds_loop does not model spread scoring "
+                         "(the harvest tail runs with spread off)")
+    check_affinity_priorities(priorities, aff, None)
+    any_aff = aff is not None and (fits_on or prio_on)
+    P = pod_class.shape[0]
+    N = nodes["alloc"].shape[0]
+    C = cls["req"].shape[0]
+    iota = jnp.arange(P, dtype=jnp.int32)
+    idx_n = jnp.arange(N, dtype=jnp.int32)
+    if pre is None:
+        pre = precompute(cls, nodes, priorities)
+    w_ip = sum(w for nm, w in priorities
+               if nm == "InterPodAffinityPriority") if prio_on else 0
+    if any_aff:
+        labels = aff["labels_aff"] if "labels_aff" in aff \
+            else nodes["labels"]
+        pre_aff = aff_ops.precompute_static(aff, labels)
+        l_dim = labels.shape[1]
+        # anti-coupled pool: classes that appear in ANY required anti
+        # relation, as matching target or term owner — their commits can
+        # shrink a same-round mask, so the pool shares one commit quota
+        m_anti_b = aff["m_anti"].astype(bool)
+        anti_pool = m_anti_b.any(axis=(1, 2)) | m_anti_b.any(axis=(0, 1))
+        boot_candidate = (aff["aff_active"] & ~aff["aff_has_static"])
+    else:
+        labels = jnp.zeros((N, 1), dtype=jnp.int8)
+        pre_aff = None
+        l_dim = 1
+        anti_pool = jnp.zeros(C, dtype=bool)
+        boot_candidate = None
+    if aff_init is not None:
+        commdom0, committed0, comm_cnt0 = aff_init
+        commdom0 = commdom0.astype(jnp.int32)
+        committed0 = committed0.astype(jnp.int32)
+        comm_cnt0 = comm_cnt0.astype(jnp.int32)
+    else:
+        commdom0 = jnp.zeros((C, l_dim), dtype=jnp.int32)
+        committed0 = jnp.zeros((C, N), dtype=jnp.int32)
+        comm_cnt0 = jnp.zeros(C, dtype=jnp.int32)
+    special_base = ((cls["ports"][:, 0] >= 0)
+                    | (cls["vol_hard"].sum(axis=1) + cls["vol_ro"].sum(axis=1)
+                       + cls["pd_req"].sum(axis=1) > 0))
+
+    def cond(carry):
+        active = carry[1]
+        w = carry[-1]
+        return active.any() & (w <= P)
+
+    def body(carry):
+        (state, active, counter, fsel, ffc, commdom, committed,
+         comm_cnt, w) = carry
+        # ---- exact round-start evaluation, class-level [C, N] -----------
+        fits_c = pre["static_fit"] & _dynamic_fits(cls, nodes, state)
+        if fits_on:
+            fits_c = fits_c & aff_ops.step_fits_all(aff, pre_aff, commdom,
+                                                    comm_cnt, labels)
+        scores_c = _wave_scores(cls, nodes, state, pre, fits_c, priorities)
+        if prio_on:
+            cnt = aff_ops.step_prio_counts_all(aff, pre_aff, commdom,
+                                               labels)
+            scores_c = scores_c + w_ip * aff_ops.interpod_score(cnt, fits_c)
+        # ---- wave-style selection (the _wave_once discipline) -----------
+        # NOTE: steps 2/4 below mirror _wave_once's tie-selection, per-node
+        # FIFO conflict resolution, score window, and commit scatters with
+        # only the fits source and the round-quota gate differing. A fix
+        # to the acceptance math there (K_WAVE analysis, prefix closure,
+        # port/volume scatters) must be applied HERE too — the tail and
+        # the wave loop are tested to agree on those semantics.
+        fitcnt = fits_c.sum(axis=1).astype(jnp.int32)
+        masked = jnp.where(fits_c, scores_c, jnp.int32(-1))
+        best = masked.max(axis=1, keepdims=True)
+        ties = (masked == best) & fits_c
+        m = ties.sum(axis=1).astype(jnp.int32)
+        rank = jnp.cumsum(ties.astype(jnp.int32), axis=1) - 1
+        cols = jnp.where(ties, rank, N)
+        rows = jnp.broadcast_to(jnp.arange(ties.shape[0])[:, None],
+                                ties.shape)
+        tiemat = jnp.zeros(ties.shape, dtype=jnp.int32).at[rows, cols].set(
+            jnp.broadcast_to(idx_n[None, :], ties.shape), mode="drop")
+        fc = fitcnt[pod_class]
+        multi = active & (fc > 1)
+        draw = counter.astype(jnp.int32) \
+            + jnp.cumsum(multi.astype(jnp.int32)) - multi.astype(jnp.int32)
+        mz = jnp.maximum(m[pod_class], 1)
+        kz = (draw % mz).astype(jnp.int32)
+        sel_multi = tiemat[pod_class, kz]
+        sel_single = jnp.argmax(fits_c, axis=1).astype(jnp.int32)[pod_class]
+        sel = jnp.where(~active | (fc == 0), jnp.int32(-1),
+                        jnp.where(fc == 1, sel_single, sel_multi))
+        new_counter = counter + multi.sum().astype(jnp.uint32)
+        # ---- per-node FIFO conflict resolution --------------------------
+        placeable = sel >= 0
+        key = jnp.where(placeable, sel, N) * P + iota
+        order = jnp.argsort(key)
+        s_sel = sel[order]
+        s_class = pod_class[order]
+        s_place = placeable[order]
+        seg_start = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), s_sel[1:] != s_sel[:-1]])
+        bs = jax.lax.cummax(jnp.where(seg_start, iota, 0))
+        rank_in_seg = iota - bs
+        first_class = s_class[bs]
+        same_run = jnp.cumsum((s_class != first_class).astype(jnp.int32))
+        same_run = (same_run - same_run[bs]) == 0
+        cap = _class_capacity(cls, nodes, state)
+        safe_sel = jnp.maximum(s_sel, 0)
+        cap_lim = jnp.minimum(cap[s_class, safe_sel], K_WAVE)
+        special = special_base[s_class]
+        thr = jnp.where(ties, jnp.int32(-1), masked).max(axis=1)
+        r_eff = jnp.minimum(rank_in_seg, cap_lim)
+        nz_z = cls["nonzero"][s_class]
+        nz_node = state.nonzero[safe_sel]
+        alloc_rows = nodes["alloc"][safe_sel]
+        tot0 = nz_node + nz_z
+        tot_r = nz_node + (r_eff[:, None] + 1) * nz_z
+        dyn0 = _dyn_at(tot0[:, 0], tot0[:, 1], alloc_rows[:, 0],
+                       alloc_rows[:, 1], priorities)
+        dyn_r = _dyn_at(tot_r[:, 0], tot_r[:, 1], alloc_rows[:, 0],
+                        alloc_rows[:, 1], priorities)
+        score_r = masked[s_class, safe_sel] - dyn0 + dyn_r
+        acc_core = (s_place & same_run & (rank_in_seg < cap_lim)
+                    & (~special | (rank_in_seg == 0))
+                    & ((rank_in_seg == 0) | (score_r >= thr[s_class])))
+        fail = (~acc_core).astype(jnp.int32)
+        pre_fail = jnp.cumsum(fail) - fail
+        acc_s = acc_core & ((pre_fail - pre_fail[bs]) == 0)
+        accepted = jnp.zeros(P, dtype=bool).at[order].set(acc_s)
+        # ---- the round gates (step 3 of the docstring) ------------------
+        if any_aff:
+            # boot_pending[c]: some active allow term has neither a static
+            # nor a committed match — this round's commit IS the group's
+            # domain choice, so it must be singular
+            dyn_total = jnp.einsum("csd,d->cs",
+                                   aff["m_aff"].astype(jnp.int32), comm_cnt)
+            boot_pending = (boot_candidate & (dyn_total == 0)).any(axis=1)
+            # quota group per class: bootstrapping classes serialize
+            # individually (group id = class index); the anti-coupled pool
+            # shares ONE group (id = C); everyone else is unquota'd
+            qgroup = jnp.where(anti_pool, jnp.int32(C),
+                               jnp.where(boot_pending,
+                                         jnp.arange(C, dtype=jnp.int32),
+                                         jnp.int32(-1)))
+            g = qgroup[pod_class]                             # [P]
+            member = accepted & (g >= 0)
+            oh = (member[:, None]
+                  & (g[:, None] == jnp.arange(C + 1, dtype=jnp.int32)[None, :]))
+            rank_in_group = jnp.cumsum(oh.astype(jnp.int32), axis=0) \
+                - oh.astype(jnp.int32)
+            keep = ~member | (rank_in_group[iota, jnp.maximum(g, 0)] == 0)
+            accepted = accepted & keep
+            acc_s = accepted[order]
+        # ---- commit (batched AssumePod, dropped pods stay active) -------
+        seg_ids = jnp.where(acc_s, s_sel, N)
+        gain = acc_s.astype(jnp.int32)
+        add_req = jax.ops.segment_sum(cls["req"][s_class] * gain[:, None],
+                                      seg_ids, num_segments=N + 1)[:N]
+        add_nz = jax.ops.segment_sum(cls["nonzero"][s_class] * gain[:, None],
+                                     seg_ids, num_segments=N + 1)[:N]
+        add_cnt = jax.ops.segment_sum(gain, seg_ids, num_segments=N + 1)[:N]
+        requested = state.requested + add_req
+        nonzero = state.nonzero + add_nz
+        pod_count = state.pod_count + add_cnt
+        sp = acc_s & special
+        sp_gain = sp.astype(jnp.int32)
+        sp_sel = jnp.where(sp, s_sel, N)
+        ports = cls["ports"][s_class]
+        want = (ports >= 0) & sp[:, None]
+        wsafe = jnp.maximum(ports, 0)
+        words = jnp.where(want, wsafe // 32, state.port_bitmap.shape[1])
+        bits = jnp.where(want,
+                         jnp.uint32(1) << (wsafe % 32).astype(jnp.uint32),
+                         jnp.uint32(0))
+        port_bitmap = state.port_bitmap.at[
+            jnp.where(sp, s_sel, N)[:, None], words].add(bits, mode="drop")
+        vh = cls["vol_hard"][s_class]
+        vr = cls["vol_ro"][s_class]
+        pdq = cls["pd_req"][s_class]
+        sp8 = sp[:, None].astype(jnp.int8)
+        vol_present = state.vol_present.at[sp_sel].max((vh | vr) * sp8,
+                                                       mode="drop")
+        vol_rw = state.vol_rw.at[sp_sel].max(vh * sp8, mode="drop")
+        pd_present = state.pd_present.at[sp_sel].max(pdq * sp8, mode="drop")
+        pd_new = []
+        for k in range(3):
+            req_k = pdq * nodes["pd_kind"][k][None, :]
+            overlap = jnp.einsum("pv,pv->p", req_k.astype(jnp.int32),
+                                 state.pd_present[safe_sel].astype(jnp.int32))
+            pd_new.append(cls["pd_req_count"][s_class, k] - overlap)
+        pd_counts = state.pd_counts.at[sp_sel].add(
+            jnp.stack(pd_new, axis=1) * sp_gain[:, None], mode="drop")
+        new_state = NodeState(requested, nonzero, pod_count, port_bitmap,
+                              vol_present, vol_rw, pd_present, pd_counts)
+        # occupancy carry: committed pods become visible to the NEXT
+        # round's exact mask
+        sel_safe_p = jnp.maximum(sel, 0)
+        gain_p = accepted.astype(jnp.int32)
+        commdom = commdom.at[pod_class].add(
+            labels[sel_safe_p].astype(jnp.int32) * gain_p[:, None])
+        committed = committed.at[
+            pod_class, jnp.where(accepted, sel, N)].add(gain_p, mode="drop")
+        comm_cnt = comm_cnt.at[pod_class].add(gain_p)
+        # ---- retire: placed pods always; fit_count==0 pods only once a
+        # round commits nothing (an allow-side commit may still widen
+        # their mask) — which is also the loop's natural exit
+        none_committed = ~accepted.any()
+        retire_unsched = active & (fc == 0) & none_committed
+        done = accepted | retire_unsched
+        fsel = jnp.where(accepted, sel, fsel)
+        ffc = jnp.where(done, fc, ffc)
+        return (new_state, active & ~done, new_counter, fsel, ffc,
+                commdom, committed, comm_cnt, w + 1)
+
+    init = (state, jnp.ones(P, dtype=bool), counter,
+            jnp.full(P, -1, dtype=jnp.int32), jnp.zeros(P, dtype=jnp.int32),
+            commdom0, committed0, comm_cnt0, jnp.int32(0))
+    (state, _active, counter, fsel, ffc, _cd, _cm, _cc, w) = \
+        lax.while_loop(cond, body, init)
+    packed = jnp.concatenate([fsel, ffc,
+                              counter.astype(jnp.int32)[None], w[None]])
+    return packed, state
 
 
 def place_waves(cls: Arrays, nodes: Arrays, state: NodeState,

@@ -198,6 +198,49 @@ def gang_pods(n: int, seed: int = 0, namespace: str = "bench",
     return out
 
 
+def gang_mix_pods(n: int, seed: int = 0,
+                  namespace: str = "bench") -> List[Pod]:
+    """ISSUE 5 gang storm: ~20% of the pods arrive in 8–64-member gangs
+    (scheduling.k8s.io/group-name with a FULL-SIZE quorum annotation — the
+    strictest all-or-nothing contract); the rest is the `mixed_affinity`
+    stream (hostname anti, zone co-location groups, symmetry targets,
+    density). The blend is the point: when a gang-bearing chunk flushes
+    the pipeline (the pre-ISSUE 5 routing), it drags the stream's
+    affinity classes back through the CLASSIC path — per-chunk
+    AffinityData rebuilds and the full-label-axis strict scan, the exact
+    costs PROFILE_r08 measured as the PR-start collapse — so "gangs stop
+    flushing" is worth far more than the gangs themselves. Every gang pod
+    shares ONE spec class (annotations are identity, not spec —
+    state/classes.pod_class_key), so the wave encoding's class axis stays
+    flat no matter how many gangs ride a chunk; the shuffle interleaves
+    members across arrival order, so gangs complete their quorum
+    mid-drain and join whatever chunk releases them."""
+    from kubernetes_tpu.engine.gang import (
+        GANG_MIN_AVAILABLE_ANNOTATION,
+        GANG_NAME_ANNOTATION,
+    )
+    rng = random.Random(seed)
+    sizes = [8, 16, 32, 64]
+    n_gang = n // 5
+    out: List[Pod] = []
+    g = 0
+    i = 0
+    while i < n_gang:
+        size = min(sizes[g % len(sizes)], n_gang - i)
+        for m in range(size):
+            p = make_pod(f"gmix-gang-{g:04d}-{m:02d}", namespace=namespace,
+                         cpu=100, memory=256 * Mi, labels={"app": "gangmix"})
+            p.annotations[GANG_NAME_ANNOTATION] = f"gmix-{g:04d}"
+            p.annotations[GANG_MIN_AVAILABLE_ANNOTATION] = str(size)
+            out.append(p)
+        i += size
+        g += 1
+    out.extend(mixed_affinity_pods(n - n_gang, seed=seed,
+                                   namespace=namespace))
+    rng.shuffle(out)  # members arrive interleaved, like real job storms
+    return out
+
+
 PROFILES = {
     "density": density_pods,
     "binpack": binpack_pods,
@@ -205,6 +248,7 @@ PROFILES = {
     "mixed_affinity": mixed_affinity_pods,
     "hetero": hetero_gpu_pods,
     "gang": gang_pods,
+    "gang_mix": gang_mix_pods,
 }
 
 

@@ -534,6 +534,57 @@ def step_prio_counts(aff: Arrays, pre: Arrays, c: jnp.ndarray,
     return counts
 
 
+def step_fits_all(aff: Arrays, pre: Arrays, commdom: jnp.ndarray,
+                  comm_cnt: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Class-vectorized ``step_fits``: the required (anti-)affinity mask
+    for EVERY class against one occupancy carry, [C, N] bool — row c is
+    bit-identical to ``step_fits(aff, pre, c, ...)``. The conflict-round
+    tail evaluates all of a round's classes in one shot instead of
+    indexing per pod inside a scan; the einsums just keep the class axis
+    the per-class forms contract away."""
+    lab = labels.astype(jnp.int32)
+    m_aff = aff["m_aff"].astype(jnp.int32)
+    occ = jnp.einsum("csd,dl->csl", m_aff, commdom) \
+        * aff["aff_keymask"].astype(jnp.int32)
+    dyn_hit = jnp.einsum("csl,nl->csn", occ, lab) > 0       # [C,S,N]
+    dyn_total = jnp.einsum("csd,d->cs", m_aff, comm_cnt)    # [C,S]
+    bootstrap = (aff["aff_self"] & ~aff["aff_has_static"]
+                 & (dyn_total == 0))                        # [C,S]
+    ok = ((~aff["aff_active"][:, :, None]) | pre["allow_hit"] | dyn_hit
+          | bootstrap[:, :, None]).all(axis=1)              # [C,N]
+    m_anti = aff["m_anti"].astype(jnp.int32)
+    occa = jnp.einsum("cad,dl->cal", m_anti, commdom) \
+        * aff["anti_keymask"].astype(jnp.int32)
+    anti_dyn = (jnp.einsum("cal,nl->can", occa, lab) > 0) \
+        & aff["anti_active"][:, :, None]
+    sym_occ = jnp.einsum("dac,dal->cl", m_anti,
+                         aff["anti_keymask"].astype(jnp.int32)
+                         * commdom[:, None, :])              # [C,L]
+    sym_hit = jnp.einsum("cl,nl->cn", sym_occ, lab) > 0
+    forbidden = pre["forbid_hit"] | anti_dyn.any(axis=1) | sym_hit
+    return ok & ~forbidden & ~aff["fail_all"][:, None]
+
+
+def step_prio_counts_all(aff: Arrays, pre: Arrays, commdom: jnp.ndarray,
+                         labels: jnp.ndarray) -> jnp.ndarray:
+    """Class-vectorized ``step_prio_counts``: InterPodAffinity weighted
+    counts for every class, [C, N] int32, row-identical to the per-class
+    form."""
+    lab = labels.astype(jnp.int32)
+    counts = pre["prio_counts"]
+    occp = jnp.einsum("ctd,dl->ctl", aff["mp"].astype(jnp.int32), commdom) \
+        * aff["p_keymask"].astype(jnp.int32)
+    per_t = jnp.einsum("ctl,nl->ctn", occp, lab)            # [C,T,N]
+    counts = counts + (aff["p_w"][:, :, None] * per_t).sum(axis=1)
+    # occq[r, l] = sum_{d,u} q_w[d,u] * mq[d,u,r] * q_keymask[d,u,l]
+    #            * commdom[d,l] — committed classes' outgoing terms
+    occq = jnp.einsum("du,dur,dul,dl->rl", aff["q_w"],
+                      aff["mq"].astype(jnp.int32),
+                      aff["q_keymask"].astype(jnp.int32), commdom)
+    counts = counts + jnp.einsum("rl,nl->rn", occq, lab)
+    return counts
+
+
 def interpod_score(counts: jnp.ndarray, fits: jnp.ndarray) -> jnp.ndarray:
     """0..10 normalization over the filtered set (interpod_affinity.go:224-
     239): max clamped >= 0, min clamped <= 0, integer floor division equals

@@ -62,9 +62,15 @@ def replicate(pods: Arrays, mesh: Mesh) -> Arrays:
 # class/slot/label-indexed (replicated — the label axis L is the contraction
 # axis of the topology einsums, so splitting it would force inner-product
 # collectives per scan step; N is the embarrassingly-parallel axis), but
-# three carry a node axis and shard with the nodes:
-#   sp_static [C, N] axis 1, Z [N, ZN] axis 0, node_has_zone [N] axis 0
-_AFF_NODE_AXIS = {"sp_static": 1, "Z": 0, "node_has_zone": 0}
+# some carry a node axis and shard with the nodes:
+#   sp_static [C, N] axis 1, Z [N, ZN] axis 0, node_has_zone [N] axis 0,
+# plus the r08/r09 wave-path bundles (engine/scheduler_engine
+# _aff_node_views / _aff_tail_arrays): key_node [C, A, N] axis 2,
+# static_forbid [C, N] axis 1, and the tail's projected node incidence
+# labels_aff [N, Lp] axis 0 (Lp is the SMALL projected domain axis — it
+# stays replicated as a contraction axis, exactly like L)
+_AFF_NODE_AXIS = {"sp_static": 1, "Z": 0, "node_has_zone": 0,
+                  "key_node": 2, "static_forbid": 1, "labels_aff": 0}
 
 
 def shard_affinity(aff: Arrays, mesh: Mesh) -> Arrays:

@@ -195,6 +195,20 @@ class SchedulerCache:
                 self._remove_pod_locked(st.pod)
                 del self._pod_states[key]
 
+    def forget_pods_bulk(self, pods: List[Pod]) -> None:
+        """ForgetPod for a whole group under ONE lock acquisition — the
+        atomic-rollback half of gang scheduling (ISSUE 5): a below-quorum
+        or fence-rolled-back gang releases every member's assumed capacity
+        in one pass, so no reader interleaves with a half-rolled-back
+        gang. Per-pod semantics identical to forget_pod, in order."""
+        with self._lock:
+            states = self._pod_states
+            for pod in pods:
+                st = states.get(pod.key())
+                if st is not None and st.assumed:
+                    self._remove_pod_locked(st.pod)
+                    del states[pod.key()]
+
     def add_pod(self, pod: Pod) -> None:
         """Informer-confirmed pod add (cache.go:214)."""
         key = pod.key()

@@ -269,7 +269,8 @@ def _pending_required_mix(rng, n):
     return out
 
 
-def _drain_pipelined(nodes, existing, pending, overlap=True, chunk=4):
+def _drain_pipelined(nodes, existing, pending, overlap=True, chunk=4,
+                     tail_rounds=None):
     from kubernetes_tpu.engine.scheduler import Scheduler
     from kubernetes_tpu.models.hollow import load_cluster
     from kubernetes_tpu.server.apiserver_lite import ApiServerLite
@@ -281,6 +282,10 @@ def _drain_pipelined(nodes, existing, pending, overlap=True, chunk=4):
     for p in pending:
         api.create("Pod", copy.deepcopy(p))
     s = Scheduler(api, record_events=False)
+    if tail_rounds is True:       # force the conflict-round tail even for
+        s.engine.tail_rounds_min = 0   # tiny tails (the fuzz shapes)
+    elif tail_rounds is False:    # per-pod scan oracle mode
+        s.engine.tail_rounds = False
     s.pipeline_chunk = chunk
     # unschedulable-retry backoff promotes on WALL CLOCK — under load a
     # retry can join a different chunk in the overlapped run than in the
@@ -378,22 +383,98 @@ def test_wave_mode_required_affinity_invariants(seed):
     assert err is None, err
 
 
+@pytest.mark.parametrize("seed", [1, 4, 8])
+def test_tail_rounds_vs_scan_tail_oracle(seed):
+    """ISSUE 5 fuzz: the conflict-round tail (waves.tail_rounds_loop,
+    forced on via tail_rounds_min=0) against the per-pod scan tail
+    (GRAFT_TAIL_ROUNDS=0 semantics) on the same required-affinity mixes.
+    The rounds tail re-evaluates the REQUIRED mask exactly every round,
+    so both modes must satisfy the strict constraint oracle — anti in
+    both directions (own terms + the symmetry check) and allow-side
+    co-location with the lone-bootstrap rule — and must agree on the
+    requeue/schedulability outcome (same pods bound: monotone capacity
+    plus exact masks make the verdicts mode-independent on these
+    shapes). Tie-breaks may diverge (wave-style fan-out vs the classic
+    serialized order — the documented wave-path divergence), so node
+    assignments are NOT compared. Each mode must also be deterministic:
+    the overlap=False A/B is bit-identical per mode, which pins the
+    requeue ORDER (a reordered requeue changes RR draws and with them
+    the placements)."""
+    rng = random.Random(seed)
+    nodes, existing = _build_pipeline_cluster(rng)
+    for i, n in enumerate(nodes):
+        n.labels.setdefault("host", f"h{i}")
+    pending = _pending_required_mix(rng, 18)
+    nodes_by_name = {n.name: n for n in nodes}
+    results = {}
+    for mode in (True, False):
+        got = _drain_pipelined(nodes, existing, pending, tail_rounds=mode)
+        all_pods = [(p, p.node_name) for p in existing] + \
+            [(p, got.get(p.name)) for p in pending]
+        placements = [(p, got.get(p.name)) for p in pending]
+        err = _violates_required_anti(placements, nodes_by_name, all_pods)
+        assert err is None, (mode, err)
+        err = _violates_required_aff(placements, nodes_by_name, all_pods)
+        assert err is None, (mode, err)
+        # determinism incl. requeue order: overlap off is bit-identical
+        got_seq = _drain_pipelined(nodes, existing, pending, overlap=False,
+                                   tail_rounds=mode)
+        assert got == got_seq, f"tail_rounds={mode} not deterministic"
+        results[mode] = got
+    bound_rounds = {k for k, v in results[True].items() if v}
+    bound_scan = {k for k, v in results[False].items() if v}
+    assert bound_rounds == bound_scan, \
+        (bound_rounds - bound_scan, bound_scan - bound_rounds)
+
+
+def test_tail_rounds_collapse_sequential_depth():
+    """The point of the conflict-round tail: a zone co-location group of
+    P pods must place in a HANDFUL of rounds (one bootstrap round + the
+    fan-out), not one round per pod — and still co-locate exactly."""
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    nodes = [make_node(f"n{i:02d}", cpu=32000, memory=64 * (1 << 30),
+                       pods=110, labels={"host": f"h{i}", "zone": f"z{i % 2}"})
+             for i in range(10)]
+    pods = []
+    for i in range(48):
+        p = make_pod(f"pack-{i}", cpu=100, labels={"app": "pack"})
+        p.affinity = Affinity(pod_affinity=PodAffinity(
+            required_terms=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": "pack"}),
+                namespaces=[], topology_key="zone")]))
+        pods.append(p)
+    COUNTERS.reset()
+    got = _drain_pipelined(nodes, [], pods, chunk=48, tail_rounds=True)
+    snap = COUNTERS.snapshot()
+    assert all(got[p.name] for p in pods), got
+    zones = {int(got[p.name][1:]) % 2 for p in pods}
+    assert len(zones) == 1, f"group split across zones: {zones}"
+    rounds = snap.get("engine.tail_rounds", (0, 0))[0]
+    dispatches = snap.get("engine.tail_round_dispatch", (0, 0))[0]
+    assert dispatches >= 1, snap
+    # 48 pods through the tail in a handful of rounds: bootstrap +
+    # fan-out (+ the final empty retire round), NOT one per pod
+    assert 0 < rounds <= 8, (rounds, snap)
+
+
 def test_pipelined_fuzz_oracle_under_sanitizer(monkeypatch, seed=5):
     """ISSUE 4 satellite: one wave-vs-strict-oracle fuzz case with every
     upload seam armed (GRAFT_SANITIZE=1 — copy seams alias-asserted,
     static bundles frozen). The sanitizer must catch nothing on the
     current tree, the oracle invariants must hold, and placements must be
     bit-identical to the unsanitized drain — proving the sanitizer is an
-    observer, not a participant."""
+    observer, not a participant. The CONFLICT-ROUND tail is forced on
+    (ISSUE 5 acceptance: the new tail path too must be sanitize-inert)."""
     rng = random.Random(seed)
     nodes, existing = _build_pipeline_cluster(rng)
     for i, n in enumerate(nodes):
         n.labels.setdefault("host", f"h{i}")
     pending = _pending_required_mix(rng, 18)
-    got_ref = _drain_pipelined(nodes, existing, pending)
+    got_ref = _drain_pipelined(nodes, existing, pending, tail_rounds=True)
 
     monkeypatch.setenv("GRAFT_SANITIZE", "1")
-    got = _drain_pipelined(nodes, existing, pending)
+    got = _drain_pipelined(nodes, existing, pending, tail_rounds=True)
     assert got == got_ref, "sanitizer changed placements"
     nodes_by_name = {n.name: n for n in nodes}
     all_pods = [(p, p.node_name) for p in existing] + \

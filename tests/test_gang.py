@@ -203,6 +203,139 @@ def test_gang_completing_in_timeout_round_schedules():
                    in e.message for e in sched.events)
 
 
+def test_gang_pipelined_vs_classic_flush_ab():
+    """ISSUE 5 A/B: the same gang storm drained with gangs riding the
+    pipelined wave path (default) and in FLUSH mode (gang_pipeline=False
+    — every gang-bearing chunk drains the pipeline into the classic
+    synchronous round, the pre-ISSUE 5 routing). Placements may differ
+    (wave tie-breaks vs classic order) but the gang CONTRACT must agree:
+    the same gangs fully bind, zero partial gangs, zero residue for the
+    losers — and the pipelined run must actually dispatch gangs through
+    waves, never flushing."""
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    def drain(gang_pipeline):
+        api = ApiServerLite()
+        for i in range(50):
+            api.create("Node", make_node(f"node-{i:03d}", cpu=16_000,
+                                         memory=64 * Gi))
+        for p in gang_pods(32 * 8):  # gangs 15 and 31 infeasible
+            api.create("Pod", p)
+        sched = Scheduler(api, record_events=False)
+        sched.gang_pipeline = gang_pipeline
+        sched.start()
+        COUNTERS.reset()
+        totals = sched.run_until_drained(max_batch=64)
+        snap = COUNTERS.snapshot()
+        by_gang = {}
+        for p in api.list("Pod")[0]:
+            by_gang.setdefault(p.annotations[GANG_NAME_ANNOTATION],
+                               []).append(bool(p.node_name))
+        return totals, by_gang, snap, sched
+
+    tot_p, gangs_p, snap_p, sched_p = drain(True)
+    tot_c, gangs_c, snap_c, _ = drain(False)
+    for by_gang in (gangs_p, gangs_c):
+        for gname, flags in by_gang.items():
+            assert len(set(flags)) == 1, f"{gname} partially bound"
+    bound_p = {g for g, f in gangs_p.items() if f[0]}
+    bound_c = {g for g, f in gangs_c.items() if f[0]}
+    assert bound_p == bound_c == {f"job-{g:04d}" for g in range(32)
+                                  if g % 16 != 15}
+    assert tot_p["bound"] == tot_c["bound"] == 30 * 8
+    # the pipelined run really took the wave path; flush mode never did
+    assert snap_p.get("engine.gang_wave_dispatch", (0, 0))[0] >= 30, snap_p
+    assert snap_c.get("engine.gang_wave_dispatch", (0, 0))[0] == 0, snap_c
+    # zero residue for the infeasible gangs: assumed capacity all released
+    used = sum(i.requested.milli_cpu
+               for i in sched_p.cache.node_infos().values())
+    assert used == 30 * 8 * 100, used
+
+
+def test_gang_pipelined_overlap_ab_bit_identical():
+    """ISSUE 5 acceptance: the gang-bearing pipelined drain with overlap
+    forced off (sequential debug mode) is BIT-IDENTICAL — the gang fence,
+    not timing, decides every commit and rollback."""
+    def drain(overlap):
+        api = ApiServerLite()
+        for i in range(8):
+            api.create("Node", make_node(f"n{i}", cpu=2000, memory=8 * Gi))
+        for g in range(4):
+            for m in range(4):
+                api.create("Pod", _gang_pod(f"g{g}-{m}", f"job-{g}", 4,
+                                            cpu=450))
+        for i in range(6):
+            api.create("Pod", make_pod(f"plain-{i}", cpu=300,
+                                       memory=64 * Mi))
+        sched = Scheduler(api, record_events=False)
+        sched.start()
+        sched.run_until_drained(max_batch=5, overlap=overlap)
+        return {p.name: (p.node_name or None) for p in api.list("Pod")[0]}
+
+    assert drain(True) == drain(False)
+
+
+def test_gang_straggler_released_when_quorum_commits_in_flight():
+    """A straggler that pops while its gang's quorum is still IN FLIGHT is
+    gated before the commit lands, so it parks below quorum; the harvest
+    must release it to schedule solo as soon as the gang commits — not
+    strand it until the 60s parked-gang sweep (the classic round marks
+    degraded synchronously and never hits this window)."""
+    api = ApiServerLite()
+    for i in range(4):
+        api.create("Node", make_node(f"n{i}", cpu=4000, memory=8 * Gi))
+    for i in range(2):           # the quorum pair pops as chunk 1
+        api.create("Pod", _gang_pod(f"q-{i}", "job-s", 2))
+    api.create("Pod", _gang_pod("q-late", "job-s", 2))  # chunk 2, in-flight
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    totals = sched.run_until_drained(max_batch=2)
+    assert totals["bound"] == 3, totals
+    assert "job-s" in sched._gang_degraded
+    assert not sched._gang_waiting.get("job-s")
+    assert all(p.node_name for p in api.list("Pod")[0])
+
+
+def test_gang_fence_rollback_is_atomic_with_zero_residue():
+    """Forced fence rollback (ISSUE 5): gang B's wave is dispatched BLIND
+    to gang A's still-unharvested commits on the only node; at harvest,
+    B's members fail the capacity re-validation, so the WHOLE gang rolls
+    back atomically — nothing of B is ever assumed, zero residue — and
+    requeues with backoff. A binds untouched."""
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    api = ApiServerLite()
+    api.create("Node", make_node("n0", cpu=2000, memory=8 * Gi))
+    for i in range(2):
+        api.create("Pod", _gang_pod(f"a-{i}", "job-a", 2, cpu=1000))
+    for i in range(2):
+        api.create("Pod", _gang_pod(f"b-{i}", "job-b", 2, cpu=1000))
+    sched = Scheduler(api, record_events=True)
+    sched.start()
+    COUNTERS.reset()
+    totals = sched.run_until_drained(max_batch=2)
+    snap = COUNTERS.snapshot()
+    assert totals["bound"] == 2, totals
+    assert totals["gang_requeued"] >= 2, totals  # B rolled back as a unit
+    assert snap.get("engine.gang_fence_rollbacks", (0, 0))[0] >= 1, snap
+    pods = api.list("Pod")[0]
+    by_gang = {}
+    for p in pods:
+        by_gang.setdefault(p.annotations[GANG_NAME_ANNOTATION],
+                           []).append(bool(p.node_name))
+    assert len(set(by_gang["job-a"])) == 1  # never partial
+    assert len(set(by_gang["job-b"])) == 1
+    bound_gangs = [g for g, f in by_gang.items() if f[0]]
+    assert len(bound_gangs) == 1, by_gang  # exactly one gang won the node
+    # zero residue: only the winner's capacity is accounted
+    info = sched.cache.node_infos()["n0"]
+    assert info.requested.milli_cpu == 2000, info.requested
+    assert len(info.pods) == 2
+    evs = [e for e in sched.events
+           if e.reason == "FailedScheduling" and "wave fence" in e.message]
+    assert evs, [e.message for e in sched.events]
+
+
 def test_gang_fuzz_all_or_nothing_invariant():
     """Randomized gang mixes; the hard invariant per trial: every gang is
     either FULLY placed (>= quorum members bound) or left with ZERO

@@ -165,6 +165,50 @@ def test_gl002_silent_on_numpy_on_numpy(tmp_path):
     assert fs == []
 
 
+def test_gl002_registry_covers_tail_rounds_entry(tmp_path):
+    """ISSUE 5: the conflict-round tail (engine/waves.tail_rounds_loop)
+    is a new jitted entry point; the project-wide jit registry must pick
+    it up from the REAL source file so GL002 taint coverage extends to
+    its callers — an unblessed fetch of its packed result is a pipeline
+    stall and must fire."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    with open(waves_py, "r", encoding="utf-8") as fh:
+        waves_src = fh.read()
+    index = ProjectIndex()
+    index.scan(ast.parse(waves_src))
+    assert "tail_rounds_loop" in index.jitted_names, \
+        "new tail entry point missing from the jit registry"
+    # cross-file taint: the fixture only CALLS the entry point; the
+    # jitted-ness comes from the registry built over the real waves.py
+    fixture = tmp_path / "harvest_tail.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.waves import tail_rounds_loop
+
+        def harvest_tail(cls, nodes, state, pc, counter, prios):
+            packed, _st = tail_rounds_loop(cls, nodes, state, pc,
+                                           counter, prios)
+            return np.asarray(packed)
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "harvest_tail" in f.context
+               for f in findings), findings
+    # the blessed form (the harvest's documented fetch) stays silent
+    fixture.write_text(fixture.read_text().replace(
+        "return np.asarray(packed)",
+        "return np.asarray(packed)  # graftlint: sync-ok"))
+    findings, _sup, errors = run_paths([waves_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "harvest_tail" in f.context], findings
+
+
 def test_gl002_fires_on_device_handle_field(tmp_path):
     fs = lint_src(tmp_path, """
         import numpy as np
