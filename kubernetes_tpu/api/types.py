@@ -441,8 +441,10 @@ class Pod:
     def key(self) -> str:
         # memoized: the drain hot path calls key() ~7x per pod per round
         # (queue, cache, metrics bookkeeping). Not a dataclass field, so
-        # dataclasses.replace() never copies a stale value; name/namespace
-        # are identity and never mutated in place.
+        # dataclasses.replace() never copies it; shallow queue-admission
+        # copies (scheduler._queue_copy) DO carry it deliberately —
+        # name/namespace are identity and never mutated in place, so the
+        # memo cannot go stale across the hop.
         k = self.__dict__.get("_key")
         if k is None:
             k = self.namespace + "/" + self.name

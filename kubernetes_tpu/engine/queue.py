@@ -67,6 +67,22 @@ class SchedulingQueue:
             self._fifo.append(pod)
             self._lock.notify_all()
 
+    def add_many(self, pods: List[Pod]) -> None:
+        """add() for a batch under ONE lock with ONE waiter wakeup — the
+        arrival-storm admission path (ISSUE 7): at 20k+ creates/s the
+        per-pod lock acquire + notify_all of add() is a measurable slice
+        of the scheduler core the stream is trying to keep on waves."""
+        with self._lock:
+            keys = self._keys
+            fifo = self._fifo
+            for pod in pods:
+                key = pod.key()
+                if key in keys:
+                    continue
+                keys[key] = pod
+                fifo.append(pod)
+            self._lock.notify_all()
+
     def add_backoff(self, pod: Pod) -> float:
         """Requeue after the pod's current backoff delay; returns the delay."""
         with self._lock:

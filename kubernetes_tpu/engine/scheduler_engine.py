@@ -1459,6 +1459,10 @@ class SchedulingEngine:
             blind: set = set()
             self._blind_listeners.append(blind)
             COUNTERS.inc("engine.wave_dispatch")
+            # admitted-pod count per dispatch: wave_dispatch_pods /
+            # wave_dispatch is the realized micro-wave size, the stream
+            # loop's admission observable (ISSUE 7)
+            COUNTERS.inc("engine.wave_dispatch_pods", n)
             if gangs:
                 COUNTERS.inc("engine.gang_wave_dispatch", len(gangs))
             return WaveHandle(list(pods), pc, enc, packed, state_out,

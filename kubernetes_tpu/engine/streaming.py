@@ -1,0 +1,285 @@
+"""The always-on incremental scheduler loop (ISSUE 7).
+
+BENCH_r09 exposed the shape of the old engine: a pre-loaded 30k-pod
+backlog drained at 28.8k pods/s, but under a live 5k/s offered stream it
+bound almost nothing while pods arrived (backlog 29k at offer end, p99
+create->bound 2.2 s) — a batch drain wearing a streaming costume. A real
+kube-scheduler is never drained; it runs forever against a churning
+cluster. This module inverts the control flow: the LOOP owns the
+scheduler (pop whatever is queued the moment the device frees up)
+instead of a scenario owning rounds.
+
+ScheduleLoop is the one engine for both shapes:
+
+- FIXED mode (``budget_s=None``) is the pipelined drain of ISSUE 2,
+  byte-for-byte: each step pops one fixed-size chunk, dispatches its
+  fused wave eval without blocking, then harvests the previous chunk.
+  ``Scheduler.pipeline()`` and ``run_until_drained`` ride this mode, so
+  the pre-loaded drain scenarios (and their A/B tests) are unchanged.
+
+- STREAMING mode (``budget_s`` set) admits MICRO-WAVES on a latency
+  budget instead of fixed chunks: each step pops ``min(ready, quantum)``
+  where the quantum is a power-of-2 admission cap adapted from the
+  observed per-wave pop->bind-complete wall clock. The quantum doubles
+  while full waves finish well under budget (amortizing per-wave fixed
+  costs when the stream runs hot) and halves when a wave's latency
+  crosses the budget (bounding what one wave can make the next arrival
+  wait for). Pops pad to ``bucket(max(n, min_quantum))`` through the
+  engine's ``wave_pad_floor`` machinery, so the compiled-shape set is
+  the log2 ladder between min_quantum and max_quantum — a ragged
+  arrival stream (345, 589, 100, ...) never mints a fresh XLA compile
+  (the GL003 hazard the ladder exists to kill).
+
+Between micro-waves only the delta touches the device (the Firmament
+insight, PAPERS.md §Firmament: incremental re-solve over deltas turns a
+fast batch solver into a low-latency online scheduler): the class
+encoding is reused via the (vocab_gen, aff_seq) key, the snapshot
+refresh rides the owner's changed_hint, and fence-accepted assumes fold
+in through snapshot.apply_assume_delta — zero re-tensorization and zero
+full node walks while the loop is live (tests/test_stream_loop.py pins
+this through span counters). Correctness is unchanged from the drain:
+wave k+1 is encoded blind to wave k's commits and the harvest fence
+re-validates (capacity, topology, gang quorum) — admission control
+changes WHEN waves run, never what a wave means.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from kubernetes_tpu.ops.predicates import bucket
+from kubernetes_tpu.utils.trace import COUNTERS
+
+
+class ScheduleLoop:
+    """A live two-stage scheduling pipeline, optionally self-pacing.
+
+    step() pops one admission of pods, dispatches its fused wave eval
+    WITHOUT blocking, then harvests the PREVIOUS admission — so wave
+    k+1's device time overlaps wave k's host bookkeeping (assume, bulk
+    bind, watch drain). overlap=False is the sequential debug mode:
+    identical dataflow (same blind window, same fence), device forced to
+    complete before the host tail — placements are bit-identical, only
+    the wall-clock overlap is forfeited.
+
+    budget_s=None (fixed mode) admits exactly ``chunk`` pods per step —
+    the ISSUE 2 drain pipeline. budget_s set (streaming mode) admits up
+    to the adaptive ``quantum`` (see module docstring); ``chunk`` then
+    serves as the initial quantum when given.
+    """
+
+    def __init__(self, sched, chunk: int = 0, overlap: bool = True,
+                 budget_s: Optional[float] = None,
+                 min_quantum: int = 256, max_quantum: int = 16384):
+        self.sched = sched
+        self.overlap = overlap
+        self.budget_s = budget_s
+        self.inflight = None
+        self._pending: Dict[str, int] = {}  # stats from interrupt flushes
+        sched._pipeline = self
+        if budget_s is None:
+            # fixed mode: one compiled wave shape per drain — ragged
+            # arrival pops pad up to the chunk bucket instead of
+            # compiling per power-of-2 size
+            self.chunk = max(int(chunk or sched.pipeline_chunk), 1)
+            self.min_quantum = self.max_quantum = self.quantum = self.chunk
+            sched.engine.wave_pad_floor = self.chunk
+        else:
+            self.min_quantum = bucket(max(int(min_quantum), 1))
+            self.max_quantum = max(bucket(max(int(max_quantum), 1)),
+                                   self.min_quantum)
+            q = bucket(max(int(chunk), 1)) if chunk else self.min_quantum
+            self.quantum = min(max(q, self.min_quantum), self.max_quantum)
+            self.chunk = 0
+            # micro-waves share the bucket ladder: every pop pads to
+            # bucket(max(n, min_quantum)), so the compiled-shape set is
+            # bounded at log2(max_quantum / min_quantum) + 1
+            sched.engine.wave_pad_floor = self.min_quantum
+        # latency model (streaming mode): EWMA of per-wave pop ->
+        # bind-complete wall clock, the exact span an arriving pod adds
+        # to the next pod's worst case
+        self._lat_ewma = 0.0
+        self._grow_streak = 0
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def idle(self) -> bool:
+        return self.inflight is None
+
+    def flush(self) -> None:
+        """Harvest the in-flight wave NOW (watch-event interrupt, classic-
+        path barrier, shutdown). Its stats fold into the next step."""
+        h, self.inflight = self.inflight, None
+        if h is not None:
+            for k, v in self.sched._complete_wave(h).items():
+                self._pending[k] = self._pending.get(k, 0) + v
+            self._observe_wave(h)
+
+    # --------------------------------------------------------- admission
+
+    def _observe_wave(self, handle) -> None:
+        """Feed one completed wave into the latency model and adapt the
+        admission quantum (streaming mode only). The observed span is
+        pop -> bind-complete — with the pipeline two deep it covers the
+        residual device wait plus both host tails, which is exactly what
+        the NEXT arrival's create->bound will inherit."""
+        if self.budget_s is None:
+            return
+        lat = time.monotonic() - handle.pop_ts
+        a = 0.3
+        self._lat_ewma = lat if self._lat_ewma == 0.0 \
+            else (1.0 - a) * self._lat_ewma + a * lat
+        if self._lat_ewma > self.budget_s \
+                and self.quantum > self.min_quantum:
+            # one wave's latency crossed the budget: halve what the next
+            # admission may make an arrival wait for
+            self.quantum //= 2
+            self._grow_streak = 0
+            COUNTERS.inc("stream.quantum_shrink")
+        elif len(handle.pods) >= self.quantum \
+                and self._lat_ewma < 0.5 * self.budget_s \
+                and self.quantum < self.max_quantum:
+            # saturated waves finishing well under budget: the stream is
+            # throughput-limited — grow to amortize per-wave fixed costs.
+            # Two consecutive signals, so one lucky wave can't thrash the
+            # quantum (each growth step is a fresh compiled shape).
+            self._grow_streak += 1
+            if self._grow_streak >= 2:
+                self.quantum *= 2
+                self._grow_streak = 0
+                COUNTERS.inc("stream.quantum_grow")
+        else:
+            self._grow_streak = 0
+
+    # -------------------------------------------------------------- step
+
+    def step(self, wait: float = 0.0) -> Dict[str, int]:
+        s = self.sched
+        stats = {"popped": 0, "bound": 0, "unschedulable": 0,
+                 "bind_errors": 0, "preemptions": 0, "fence_requeued": 0}
+        s.sync()  # columnar; node/volume events flush the pipeline first
+        pods = s.queue.pop_batch(max_n=self.quantum, wait=wait)
+        stats["popped"] = len(pods)
+        handle = None
+        if not pods:
+            # parked-gang sweep on empty steps only: a pod-ful step either
+            # takes the wave path (no gang members by eligibility) and
+            # sweeps below, or falls back to _process_batch which runs the
+            # arrival-exempt sweep itself
+            s._sweep_parked_gangs(())
+        if pods:
+            pop_ts = time.monotonic()
+            chunk_pods = pods
+            if s._wave_eligible(pods):
+                # quorum-ready gangs ride the wave path as ordinary
+                # batches (ISSUE 5) — the harvest applies their
+                # all-or-nothing fence; below-quorum members park here
+                chunk_pods, gang_spans = s._release_gangs_for_wave(
+                    pods, stats)
+                if chunk_pods:
+                    handle = s.engine.dispatch_waves(chunk_pods, pop_ts,
+                                                     gangs=gang_spans)
+            if handle is None and chunk_pods:
+                # chunk needs the strict/oracle machinery (host-check
+                # classes, affinity slot overflow, policy — or gangs with
+                # gang_pipeline off): drain the pipeline so the
+                # synchronous path sees every commit, then run it classic
+                self.flush()
+                sub = s._process_batch(chunk_pods, pop_ts)
+                sub["popped"] = 0  # already counted
+                for k, v in sub.items():
+                    stats[k] = stats.get(k, 0) + v
+            elif handle is not None and not self.overlap:
+                # sequential mode: forfeit the overlap only. The span is
+                # the profiler's measure of RAW per-wave device time (no
+                # host work runs between dispatch and this block)
+                from kubernetes_tpu.utils.trace import timed_span
+                with timed_span("pipeline.device_sync"):
+                    handle.block()
+        prev, self.inflight = self.inflight, handle
+        if prev is not None:
+            for k, v in s._complete_wave(prev).items():
+                stats[k] = stats.get(k, 0) + v
+            self._observe_wave(prev)
+        if self._pending:
+            for k, v in self._pending.items():
+                stats[k] = stats.get(k, 0) + v
+            self._pending = {}
+        if not pods:
+            s._idle_gc()
+        return stats
+
+    # ------------------------------------------------------------ quiesce
+
+    def settled(self) -> bool:
+        """The ONE quiesce predicate (bench stop conditions, drain(),
+        tests): pipeline idle AND watch stream drained AND ready queue
+        empty AND backoff heap empty. The deferred check matters: a pod
+        requeued after a transient error is RETRIABLE, and declaring the
+        loop settled before it re-enters would report results over a
+        silently partial population. Calling this consumes watch events
+        (sync side effect), like every other quiesce check before it."""
+        s = self.sched
+        return (self.idle and s.sync() == 0
+                and s.queue.ready_count() == 0
+                and not s.queue._deferred)
+
+    def drain(self, idle_wait: float = 0.005) -> Dict[str, int]:
+        """Step until settled; returns accumulated stats. Termination is
+        the CALLER's contract — truly-unschedulable pods re-enter the
+        ready queue forever, so scenario drivers wrap this in a
+        wall-clock deadline (bench.run_arrival) or feed only placeable
+        pods (warm/prime phases, tests)."""
+        total: Dict[str, int] = {}
+        while True:
+            stats = self.step()
+            for k, v in stats.items():
+                total[k] = total.get(k, 0) + v
+            if stats["popped"] == 0 and self.settled():
+                return total
+            if stats["popped"] == 0 and self.idle and idle_wait > 0:
+                # a deferred pod's backoff must elapse — park on the
+                # watch instead of spinning the step loop dry
+                self.sched.sync(wait=idle_wait)
+
+    # --------------------------------------------------------------- run
+
+    def run(self, should_stop: Callable[[Dict[str, int], "ScheduleLoop"],
+                                        bool],
+            idle_wait: float = 0.002,
+            on_step: Optional[Callable[[Dict[str, int], "ScheduleLoop"],
+                                       None]] = None) -> Dict[str, int]:
+        """Run continuously until ``should_stop(stats, loop)`` answers
+        True — the loop owns the scheduler; scenarios observe through
+        ``on_step`` and the scheduler's wave_observer instead of driving
+        rounds themselves. Idle iterations (nothing popped, nothing in
+        flight) block on the apiserver watch for up to ``idle_wait``
+        seconds instead of busy-spinning, so an arrival wakes the loop
+        the moment its event lands. Returns accumulated totals
+        (close() is still the caller's job — an in-flight wave survives
+        a stop so a later loop can resume it)."""
+        total: Dict[str, int] = {}
+        while True:
+            stats = self.step()
+            for k, v in stats.items():
+                total[k] = total.get(k, 0) + v
+            if on_step is not None:
+                on_step(stats, self)
+            if should_stop(stats, self):
+                return total
+            if stats["popped"] == 0 and self.idle and idle_wait > 0:
+                # block for arrivals on the watch condition, not a sleep:
+                # sync(wait=) parks on the apiserver's lock and wakes on
+                # the next event broadcast
+                self.sched.sync(wait=idle_wait)
+
+    def close(self) -> Dict[str, int]:
+        """Drain the in-flight wave and detach from the scheduler; returns
+        any stats not yet reported through step()."""
+        self.flush()
+        out, self._pending = self._pending, {}
+        if self.sched._pipeline is self:
+            self.sched._pipeline = None
+        return out

@@ -99,8 +99,10 @@ def pod_class_key(pod: Pod) -> tuple:
     drain keys 30k pods per round. The only spec field the scheduler
     mutates IN PLACE after keying is node_name (engine assume), so the
     cache is guarded on its identity; every other mutation path in the
-    control plane goes through dataclasses.replace / fresh decode, which
-    never carries the memo over."""
+    control plane goes through dataclasses.replace / fresh decode — and
+    the one shallow-copy hop (scheduler._queue_copy, the arrival-storm
+    queue admission) DROPS this memo explicitly — so a stale class key
+    never crosses an object boundary."""
     cached = pod.__dict__.get("_class_key")
     if cached is not None and cached[0] is pod.node_name:
         return cached[1]

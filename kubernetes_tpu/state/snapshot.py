@@ -506,6 +506,11 @@ class ClusterSnapshot:
         must route placements with ports/volumes/extended resources through
         the normal dirty-note path instead — those touch more than the
         seven raw columns."""
+        from kubernetes_tpu.utils.trace import COUNTERS
+        # one count per folded placement: the streaming loop's delta-only
+        # invariant reads this against the bound total to PROVE assumes
+        # rode the raw-delta path, never a node walk (ISSUE 7)
+        COUNTERS.inc("snapshot.assume_delta_rows", len(rows))
         np.add.at(self._raw_dyn, rows, delta)
         np.add.at(self.pod_count, rows, 1)
         touched = np.unique(rows)

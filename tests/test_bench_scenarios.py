@@ -21,20 +21,29 @@ def test_compat_scheduleone_over_http_binds_everything():
 
 
 def test_arrival_stream_distribution_is_not_degenerate():
-    # warm pass compiles the kernels so the measured pass isn't skewed by
-    # a mid-stream compile burst
-    bench.run_arrival(200, rate=200, duration_s=1)
-    out = bench.run_arrival(200, rate=300, duration_s=3)
+    # warm=True compiles the micro-wave shape ladder so the measured pass
+    # isn't skewed by a mid-stream compile burst (ISSUE 7)
+    out = bench.run_arrival(200, rate=300, duration_s=3, warm=True,
+                            min_quantum=64, max_quantum=256)
     assert out["bound"] == 900
-    # intervals spread each round's binds over its duration (rounded to
-    # 0.1), so the sum matches up to rounding
-    assert abs(sum(out["intervals"]) - 900) < 1.0
+    # intervals now attribute binds at their bind instants — exact count
+    assert sum(out["intervals"]) == 900
     assert out["sustained_pods_s"] > 0
     assert out["p50_ms"] < out["p99_ms"], \
         "per-pod create->bound must be a real distribution"
-    # the host-bound honesty fields (ISSUE 2): offered rate, end-of-offer
+    # the host-bound honesty fields (ISSUE 2/7): offered rate, end-of-offer
     # backlog and unbound count are reported explicitly, and a fully-kept-up
     # run reports zero unbound
     assert out["offered_pods_s"] == 300.0
     assert out["unbound"] == 0
     assert out["backlog_at_offer_end"] >= 0
+    # the ISSUE 7 per-interval honesty plumbing: offered/backlog series
+    # aligned with the bind intervals, creator self-audit present
+    assert len(out["backlog_series"]) == len(out["intervals"])
+    assert sum(out["offered_series"]) == 900
+    assert out["offered_realized_pods_s"] > 0
+    assert isinstance(out["creator_jitter_ok"], bool)
+    assert out["creator_max_burst"] >= 1
+    # latency is creator-stamped per pod: honest distributions never report
+    # a p50 of zero while pods bound
+    assert out["p50_ms"] > 0

@@ -209,6 +209,101 @@ def test_gl002_registry_covers_tail_rounds_entry(tmp_path):
     assert not [f for f in findings if "harvest_tail" in f.context], findings
 
 
+def test_gl002_registry_covers_streaming_pop_seam(tmp_path):
+    """ISSUE 7: the always-on loop's micro-wave pop dispatches through
+    the registered jitted entry points (waves_loop and friends) — the
+    registry built over the REAL waves.py must extend GL002 taint to a
+    streaming-shaped consumer, because the pop seam is exactly where a
+    hidden device->host sync would silently serialize the loop (one
+    unblessed fetch per micro-wave = the whole overlap forfeited at
+    20k pops/s)."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    with open(waves_py, "r", encoding="utf-8") as fh:
+        index = ProjectIndex()
+        index.scan(ast.parse(fh.read()))
+    # the streaming dispatch path's device entry points, all registered
+    # via decoration
+    for entry in ("waves_loop", "tail_rounds_loop", "precompute_jit",
+                  "frozen_affinity_scores"):
+        assert entry in index.jitted_names, entry
+    fixture = tmp_path / "stream_pump.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.waves import waves_loop
+
+        def pump_micro_wave(queue, cls_arr, nodes, state, pc, ctr, prios):
+            packed, _st = waves_loop(cls_arr, nodes, state, pc, ctr,
+                                     prios)
+            return np.asarray(packed)
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "pump_micro_wave" in f.context
+               for f in findings), findings
+    # the blessed harvest fetch stays silent
+    fixture.write_text(fixture.read_text().replace(
+        "return np.asarray(packed)",
+        "return np.asarray(packed)  # graftlint: sync-ok"))
+    findings, _sup, errors = run_paths([waves_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "pump_micro_wave" in f.context], \
+        findings
+
+
+def test_gl003_fires_on_ragged_micro_wave_pop(tmp_path):
+    """ISSUE 7: the micro-wave pop is where the ragged-shape recompile
+    storm would creep back in — an arrival loop slicing its pod arrays
+    to the data-dependent pop size before a registered jitted entry
+    point must fire GL003; the pad-to-bucket idiom (wave_pad_floor /
+    predicates.bucket, what ScheduleLoop actually rides) stays silent."""
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    bad = tmp_path / "ragged_pump.py"
+    bad.write_text(textwrap.dedent("""
+        from kubernetes_tpu.engine.waves import waves_loop
+
+        def pump(queue, cls_arr, nodes, state, pc, ctr, prios):
+            out = []
+            while queue:
+                n = queue.pop()
+                out.append(waves_loop(cls_arr, nodes, state, pc[:n],
+                                      ctr, prios))
+            return out
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(bad)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert any(f.rule == "GL003" and "pump" in f.context
+               for f in findings), findings
+    # blessed: pad to a fixed bucket OUTSIDE the call's operand — no
+    # ragged slice reaches the jitted entry point
+    good = tmp_path / "bucketed_pump.py"
+    good.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.waves import waves_loop
+
+        def pump(queue, cls_arr, nodes, state, pc, ctr, prios, pad):
+            out = []
+            while queue:
+                n = queue.pop()
+                pc_pad = np.full(pad, 0, dtype=np.int32)
+                pc_pad[:n] = pc[:n]
+                out.append(waves_loop(cls_arr, nodes, state, pc_pad,
+                                      ctr, prios))
+            return out
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(good)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert not [f for f in findings if f.rule == "GL003"
+                and "bucketed_pump" in f.path], findings
+
+
 def test_gl002_fires_on_device_handle_field(tmp_path):
     fs = lint_src(tmp_path, """
         import numpy as np

@@ -1,0 +1,245 @@
+"""Always-on incremental engine (ISSUE 7): the streaming micro-wave loop.
+
+Pins the three contracts that make the arrival stream the headline
+instead of the drain:
+
+- the frozen-arrival-trace A/B: streaming micro-waves produce BIT-
+  IDENTICAL placements to the fixed-chunk pipelined drain over the same
+  admission boundaries — admission control changes WHEN waves run, never
+  what a wave means (same discipline as the PR-2 pipelined==sequential
+  and PR-5 gang A/Bs);
+- the delta-only invariant: while the loop is live, span counters prove
+  zero re-tensorization (encoding reuse only), zero full snapshot walks
+  (hinted refresh only), and every fence-accepted assume riding the
+  raw-delta fold (snapshot.apply_assume_delta) — the Firmament property
+  BENCH_r09 showed the drain-shaped engine did NOT have under arrivals;
+- quantum adaptation: the admission cap doubles only on consecutive
+  saturated under-budget waves, halves the moment latency crosses the
+  budget, and never leaves [min_quantum, max_quantum].
+
+Plus the tier-1-fast arrival smoke (ISSUE 7 satellite): a few-second
+offered stream on a small cluster must SUSTAIN the offered rate with a
+loose create->bound p99 bound, so a streaming regression surfaces
+without running the full bench.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.engine.streaming import ScheduleLoop
+from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes, load_cluster
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.utils.trace import COUNTERS
+
+Gi = 1 << 30
+
+# a ragged arrival trace: group sizes deliberately non-bucket-aligned so
+# the pad-floor machinery (not luck) is what keeps shapes stable
+TRACE = (37, 128, 5, 96, 64, 111)
+
+
+def mk_sched(n_nodes=64):
+    api = ApiServerLite()
+    load_cluster(api, hollow_nodes(n_nodes), [])
+    s = Scheduler(api, record_events=False)
+    s.start()
+    return api, s
+
+
+def feed(api, group, tag):
+    pods = PROFILES["density"](group)
+    for p in pods:
+        p.name = f"{tag}-{p.name}"
+        api.create("Pod", p)
+
+
+def placements(api):
+    return {p.name: p.node_name for p in api.list("Pod")[0]}
+
+
+def drain_idle(sched, loop):
+    loop.drain()  # the loop's shared quiesce predicate (settled())
+
+
+# ------------------------------------------------------- frozen-trace A/B
+
+
+def test_frozen_trace_streaming_equals_pipelined_drain():
+    """The ISSUE 7 A/B: the same frozen arrival trace consumed (a) by the
+    streaming loop (budget admission, micro-wave quantum) and (b) by the
+    fixed-chunk pipelined drain must place every pod on the SAME node.
+    Both admit one trace group per step (group sizes stay under the
+    quantum/chunk), so the wave boundaries — and therefore the RR draws,
+    the blind windows, and the fence decisions — are identical by
+    construction; the test pins that the admission-control layer adds
+    nothing else."""
+    quantum = 128  # >= max(TRACE): one step consumes one group exactly
+
+    # (a) streaming: latency budget generous so adaptation never shrinks
+    # the cap below a group mid-trace
+    api_a, s_a = mk_sched()
+    loop = s_a.stream(budget_s=30.0, min_quantum=quantum,
+                      max_quantum=quantum)
+    for gi, group in enumerate(TRACE):
+        feed(api_a, group, f"g{gi}")
+        loop.step()
+    drain_idle(s_a, loop)
+    loop.close()
+
+    # (b) the pipelined drain, same trace, same chunk (=> same pad floor)
+    api_b, s_b = mk_sched()
+    pipe = s_b.pipeline(chunk=quantum)
+    for gi, group in enumerate(TRACE):
+        feed(api_b, group, f"g{gi}")
+        pipe.step()
+    drain_idle(s_b, pipe)
+    pipe.close()
+
+    pa, pb = placements(api_a), placements(api_b)
+    assert pa == pb, {k: (pa[k], pb[k]) for k in pa if pa[k] != pb[k]}
+    assert all(v for v in pa.values()), "trace must fully bind"
+    assert len(pa) == sum(TRACE)
+
+
+# ----------------------------------------------------- delta-only invariant
+
+
+def test_stream_delta_only_invariants():
+    """While the loop is live, between micro-waves ONLY the delta touches
+    the device (ISSUE 7 tentpole): encoding reuse (zero ClassBatch/
+    AffinityData rebuilds), hinted refresh (zero full generation scans,
+    zero shape rebuilds), and raw-delta assume folds for every bound pod.
+    This is the counter-proof that the warm path is the ONLY path —
+    the regression BENCH_r09 exposed (arrival stream going cold between
+    rounds) trips these exact counters."""
+    api, s = mk_sched()
+    loop = s.stream(budget_s=30.0, min_quantum=128, max_quantum=128)
+    feed(api, 128, "warm")  # warm: compiles + builds the encoding
+    loop.step()
+    drain_idle(s, loop)
+
+    COUNTERS.reset()
+    groups = (96, 128, 57)
+    for gi, group in enumerate(groups):
+        feed(api, group, f"live{gi}")
+        loop.step()
+    drain_idle(s, loop)
+    loop.close()
+    snap = COUNTERS.snapshot()
+
+    def cnt(name):
+        return snap.get(name, (0, 0.0))[0]
+
+    bound = sum(groups)
+    assert {p.name: p.node_name
+            for p in api.list("Pod")[0] if p.name.startswith("live")} \
+        and all(p.node_name for p in api.list("Pod")[0])
+    # zero re-tensorization: the cached class encoding serves every wave
+    assert cnt("engine.wave_encode_build") == 0, snap
+    assert cnt("engine.wave_encode_reuse") >= len(groups)
+    # zero full snapshot walks: the owner's dirty notes cover everything
+    assert cnt("snapshot.refresh_scan") == 0, snap
+    assert cnt("snapshot.refresh_rebuild") == 0, snap
+    assert cnt("snapshot.refresh_hinted") >= len(groups)
+    # every fence-accepted assume rode the raw-delta fold, none walked
+    assert cnt("snapshot.assume_delta_rows") == bound, snap
+    # one fused dispatch per micro-wave
+    assert cnt("engine.wave_dispatch") == len(groups), snap
+
+
+# ------------------------------------------------------ quantum adaptation
+
+
+class _FakeEngine:
+    wave_pad_floor = 0
+
+
+class _FakeSched:
+    def __init__(self):
+        self.engine = _FakeEngine()
+        self._pipeline = None
+        self.pipeline_chunk = 4096
+
+
+class _FakeHandle:
+    def __init__(self, n, latency):
+        self.pods = [None] * n
+        self.pop_ts = time.monotonic() - latency
+
+
+def test_quantum_adaptation_bounds_and_hysteresis():
+    """Unit contract of the admission model: grow only after TWO
+    consecutive saturated waves well under budget (one lucky wave must
+    not mint a compiled shape), shrink immediately when the EWMA crosses
+    the budget, clamp to [min_quantum, max_quantum]."""
+    s = _FakeSched()
+    loop = ScheduleLoop(s, budget_s=0.2, min_quantum=64, max_quantum=256)
+    assert loop.quantum == 64
+    assert s.engine.wave_pad_floor == 64  # the shape-ladder floor
+
+    # one fast full wave: no growth yet (hysteresis)
+    loop._observe_wave(_FakeHandle(64, 0.01))
+    assert loop.quantum == 64
+    # second consecutive: grows
+    loop._observe_wave(_FakeHandle(64, 0.01))
+    assert loop.quantum == 128
+    # a partial wave resets the streak
+    loop._observe_wave(_FakeHandle(10, 0.01))
+    loop._observe_wave(_FakeHandle(128, 0.01))
+    assert loop.quantum == 128
+    loop._observe_wave(_FakeHandle(128, 0.01))
+    assert loop.quantum == 256
+    # cap: saturated fast waves cannot exceed max_quantum
+    loop._observe_wave(_FakeHandle(256, 0.01))
+    loop._observe_wave(_FakeHandle(256, 0.01))
+    assert loop.quantum == 256
+    # over-budget wave shrinks immediately...
+    loop._observe_wave(_FakeHandle(256, 5.0))
+    assert loop.quantum == 128
+    # ...and the floor holds no matter how slow it gets
+    loop._observe_wave(_FakeHandle(128, 5.0))
+    loop._observe_wave(_FakeHandle(64, 5.0))
+    loop._observe_wave(_FakeHandle(64, 5.0))
+    assert loop.quantum == 64
+
+
+def test_fixed_mode_pins_one_shape():
+    """budget_s=None is the drain: quantum == chunk, pad floor == chunk —
+    the ISSUE 2 contract the headline drain's compile stability rides."""
+    s = _FakeSched()
+    loop = ScheduleLoop(s, chunk=1000)
+    assert loop.quantum == 1000
+    assert s.engine.wave_pad_floor == 1000
+    loop._observe_wave(_FakeHandle(1000, 9.9))  # adaptation inert
+    assert loop.quantum == 1000
+
+
+# ------------------------------------------------------- tier-1 fast smoke
+
+
+def test_arrival_smoke_sustains_offered_rate():
+    """The CI streaming smoke (ISSUE 7 satellite): a small offered stream
+    must be consumed AT the offered rate with a loose latency bound.
+    Asserts through bench.run_arrival so the smoke exercises the same
+    honesty plumbing (creator stamps, per-interval series) the headline
+    uses; shapes are tiny so the ladder warm is cheap on CI."""
+    import bench
+
+    out = bench.run_arrival(64, rate=300, duration_s=2.0, warm=True,
+                            min_quantum=64, max_quantum=256,
+                            budget_ms=500.0)
+    assert out["bound"] == 600 and out["unbound"] == 0
+    assert sum(out["intervals"]) == 600
+    assert sum(out["offered_series"]) == 600
+    # sustained >= offered: the loop kept up INSIDE the offer window
+    # (tolerance for interval-edge rounding on a 2-bucket window)
+    assert out["sustained_pods_s"] >= 0.95 * out["offered_pods_s"], out
+    # loose p99: double-digit ms warm on this box; anything near a second
+    # means the stream went cold mid-offer
+    assert out["p99_ms"] is not None and out["p99_ms"] < 1500.0, out
+    assert out["backlog_at_offer_end"] < 300, out
+    assert isinstance(out["creator_jitter_ok"], bool)
+    assert len(out["backlog_series"]) == len(out["intervals"])
