@@ -20,7 +20,11 @@ BENCH_ARRIVAL_BUDGET_MS (create->bound latency budget driving micro-wave
 admission, default 250), BENCH_ARRIVAL_SECONDS (offer window; default auto),
 BENCH_ARRIVAL_BURST (creator max pods per wakeup; default ~4ms of rate),
 BENCH_ARRIVAL_SWEEP (comma rates; "" disables), BENCH_ARRIVAL_SAT=0 to skip
-the saturation search.
+the saturation search. Churn scenario (ISSUE 8): BENCH_CHURN=0 to skip,
+BENCH_CHURN_RATE (offered rate; default the arrival rate),
+BENCH_CHURN_SEED, BENCH_CHURN_NODE_PCT_MIN (node churn fraction/min,
+default 0.10), BENCH_CHURN_BIND_FAIL / BENCH_CHURN_BIND_TIMEOUT
+(injected bind-fault rates).
 """
 
 from __future__ import annotations
@@ -305,7 +309,8 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
                 profile: str = "density", pipeline: bool = True,
                 budget_ms: float = 250.0, max_burst: int = 0,
                 min_quantum: int = 256, max_quantum: int = 16384,
-                interval_s: float = 0.0, warm: bool = False):
+                interval_s: float = 0.0, warm: bool = False,
+                churn_cfg=None):
     """THE headline scenario (ISSUE 7): pods are CREATED at a configured
     rate while the ALWAYS-ON loop runs — the reference's density suite
     semantics (test/integration/scheduler_perf/scheduler_test.go:34-39
@@ -332,7 +337,15 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     - the creator enforces ``max_burst`` (default: ~4 ms of the offered
       rate) and reports its own realized jitter; ``creator_jitter_ok``
       is False when the creator — not the scheduler — was the bottleneck
-      or burst source, and high-rate numbers must not be read over it."""
+      or burst source, and high-rate numbers must not be read over it.
+
+    churn_cfg (ISSUE 8): a testing.churn.ChurnConfig turns the quiet-box
+    scenario into the CHURN scenario — the same offered stream with a
+    seeded fault schedule applied concurrently (node kills/respawns,
+    NotReady flaps, cordons, zone relabels, evictions) and bind faults
+    injected at the configured rates through FaultyBindApi. The result
+    then carries the fault load offered, the requeue/degrade telemetry,
+    and an exactly-once audit (zero duplicate bind events)."""
     from kubernetes_tpu.engine.scheduler import Scheduler
     from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes, load_cluster
     from kubernetes_tpu.server.apiserver_lite import ApiServerLite
@@ -358,7 +371,20 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
             s *= 2
         _warm_stream_shapes(n_nodes, sizes, profile=profile)
     api = ApiServerLite(max_log=max(200_000, 3 * (n_nodes + total)))
-    load_cluster(api, hollow_nodes(n_nodes), [])
+    nodes = hollow_nodes(n_nodes)
+    load_cluster(api, nodes, [])
+    injector = None
+    if churn_cfg is not None:
+        from kubernetes_tpu.testing.churn import (
+            ChurnInjector,
+            FaultyBindApi,
+            make_churn_schedule,
+        )
+        api = FaultyBindApi(api, fail_rate=churn_cfg.bind_fail_rate,
+                            timeout_rate=churn_cfg.bind_timeout_rate,
+                            seed=churn_cfg.seed)
+        injector = ChurnInjector(api, make_churn_schedule(
+            [n.name for n in nodes], churn_cfg, duration_s))
     pods = PROFILES[profile](total)
     pod_index = {p.key(): i for i, p in enumerate(pods)}
     sched = Scheduler(api, record_events=False)
@@ -434,10 +460,18 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
 
     creator_thread = threading.Thread(target=creator, daemon=True)
     creator_thread.start()
+    churn_stop = None
+    churn_thread = None
+    if injector is not None:
+        churn_stop = threading.Event()
+        churn_thread = injector.run_thread(churn_stop, t0=t0)
     # wall-clock safety net, NOT a round budget: a round-count backstop
     # silently truncates low-rate runs (empty rounds take microseconds),
-    # returning a plausible-looking JSON over a partial window
-    deadline = t0 + max(60.0, duration_s * 20)
+    # returning a plausible-looking JSON over a partial window. Churn
+    # runs get more rope: backoff-requeued rows (liveness rejects, bind
+    # faults) legitimately wait out their delay in the drain tail.
+    deadline = t0 + max(60.0, duration_s * 20) \
+        + (120.0 if injector is not None else 0.0)
     backlog_at_offer_end = [None]
     backlog_samples = []               # (t_rel, queued + in-flight)
     quantum_peak = [0]
@@ -449,8 +483,13 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
             inflight = len(loop.inflight.pods)
         return len(sched.queue) + inflight
 
+    agg = {"bind_errors": 0, "fence_requeued": 0, "liveness_requeued": 0,
+           "degraded_steps": 0}
+
     def note(stats, loop):
         now = time.monotonic() - t0
+        for k in agg:
+            agg[k] += stats.get(k, 0)
         if loop is not None:
             quantum_peak[0] = max(quantum_peak[0], loop.quantum)
         if now - last_sample[0] >= 0.05 or stats["bound"]:
@@ -500,14 +539,28 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     finally:
         gc.enable()
         gc.unfreeze()
+        if churn_stop is not None:
+            churn_stop.set()
     creator_thread.join(timeout=10)
+    if churn_thread is not None:
+        churn_thread.join(timeout=10)
     sched.wave_observer = None
 
     # ---- per-pod create->bound joined from creator stamps + bind instants
+    # (plus the exactly-once audit: the store refuses double binds, so a
+    # pod key appearing in TWO bind-observer passes would mean the engine
+    # bound the same pod twice — the invariant injected faults must not
+    # break)
     lat = np.full(total, -1.0)
     bound = 0
+    duplicate_binds = 0
+    seen_bound = set()
     for ts, keys in bind_events:
         for k in keys:
+            if k in seen_bound:
+                duplicate_binds += 1
+                continue
+            seen_bound.add(k)
             i = pod_index.get(k)
             if i is None:
                 continue  # prime pod / retry echo: not in the offer
@@ -515,6 +568,15 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
             if create_ts[i] >= 0:
                 lat[i] = ts - create_ts[i]
     lat = lat[lat >= 0]
+    # reconcile against STORE truth: a landed-but-timed-out bind (the
+    # injected at-most-once ambiguity) is bound in the store but never
+    # reached the observer — it must count as bound (it is not lost),
+    # it just has no honest latency sample. Evicted pods bound before
+    # their eviction keep their observer sample.
+    if injector is not None:
+        api_state = {p.key(): bool(p.node_name)
+                     for p in api.list("Pod")[0]}
+        bound = sum(1 for p in pods if api_state.get(p.key(), True))
 
     # ---- per-interval series: binds at bind instants, backlog sampled,
     # offered from the creator's own log
@@ -553,7 +615,7 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     jitter_ok = bool(lag_p99_ms <= lag_bound_ms
                      and realized_rate >= 0.95 * rate)
 
-    return {
+    out = {
         "intervals": [int(v) for v in intervals],
         "interval_s": interval_s,
         "offered_series": [int(v) for v in offered_series],
@@ -572,7 +634,24 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
         "creator_lag_p99_ms": round(lag_p99_ms, 3),
         "creator_lag_bound_ms": round(lag_bound_ms, 3),
         "creator_jitter_ok": jitter_ok,
+        # robustness telemetry (ISSUE 8): bind errors now travel with
+        # every arrival number (injected faults MUST increment this), and
+        # the fence/degrade story is visible next to the throughput it
+        # protected
+        "bind_errors": int(agg["bind_errors"]),
+        "fence_requeued": int(agg["fence_requeued"]),
+        "liveness_requeued": int(agg["liveness_requeued"]),
+        "degraded_steps": int(agg["degraded_steps"]),
+        "duplicate_binds": int(duplicate_binds),
     }
+    if injector is not None:
+        out.update({
+            "churn_ops_applied": dict(injector.applied),
+            "churn_ops_noop": int(injector.noop),
+            "injected_bind_failures": int(api.injected_failures),
+            "injected_bind_timeouts": int(api.injected_timeouts),
+        })
+    return out
 
 
 def arrival_sweep(n_nodes: int, rates, budget_ms: float = 250.0,
@@ -634,6 +713,82 @@ def saturation_search(n_nodes: int, budget_ms: float = 250.0,
             best = mid
     return {"max_sustained_pods_s": float(best), "budget_ms": budget_ms,
             "probes": probes}
+
+
+def measure_churn(n_nodes: int, rate: float, duration_s: float,
+                  budget_ms: float = 250.0, profile: str = "churn"):
+    """THE ISSUE 8 scenario: the arrival stream measured twice on the same
+    box — once quiet, once under the seeded `churn` fault schedule
+    (ROADMAP shape: sustained 10%/min node churn + NotReady flaps +
+    cordons + zone relabels + evictions + injected bind failures AND
+    landed-but-timed-out binds) — and reported as a RATIO, so the number
+    is "how much of the quiet throughput survives production-rate faults"
+    rather than an absolute a different box can't compare. Alongside the
+    ratio travel the counters that prove HOW it survived: Protean patch
+    rows vs wholesale rebuilds (the acceptance bound: rebuilds stay
+    O(vocab/class growth), not O(foreign binds)), liveness-fence
+    requeues (rows that would have bound into ghosts), degraded-mode
+    transitions, and the exactly-once audit (zero duplicate binds under
+    injected bind faults)."""
+    from kubernetes_tpu.testing.churn import ChurnConfig
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    quiet = run_arrival(n_nodes, rate=rate, duration_s=duration_s,
+                        profile=profile, budget_ms=budget_ms, warm=True)
+    cfg = ChurnConfig(
+        seed=int(os.environ.get("BENCH_CHURN_SEED", "11")),
+        node_churn_per_min=float(
+            os.environ.get("BENCH_CHURN_NODE_PCT_MIN", "0.10")),
+        bind_fail_rate=float(
+            os.environ.get("BENCH_CHURN_BIND_FAIL", "0.002")),
+        bind_timeout_rate=float(
+            os.environ.get("BENCH_CHURN_BIND_TIMEOUT", "0.001")))
+    COUNTERS.reset()
+    churned = run_arrival(n_nodes, rate=rate, duration_s=duration_s,
+                          profile=profile, budget_ms=budget_ms, warm=True,
+                          churn_cfg=cfg)
+    snap = COUNTERS.snapshot()
+
+    def cnt(name):
+        return snap.get(name, (0, 0.0))[0]
+
+    quiet_s = quiet["sustained_pods_s"]
+    churn_s = churned["sustained_pods_s"]
+    # the exactly-once invariant is a hard gate, like the gang-atomicity
+    # raise: numbers over a double bind are not numbers
+    if churned["duplicate_binds"] or quiet["duplicate_binds"]:
+        raise RuntimeError(
+            f"duplicate binds: quiet={quiet['duplicate_binds']} "
+            f"churn={churned['duplicate_binds']}")
+    return {
+        "churn_offered_pods_s": float(rate),
+        "churn_quiet_sustained_pods_s": quiet_s,
+        "churn_sustained_pods_s": churn_s,
+        "churn_vs_quiet": round(churn_s / quiet_s, 3) if quiet_s else 0.0,
+        "churn_p99_create_to_bound_ms": round(churned["p99_ms"], 3)
+        if churned["p99_ms"] is not None else None,
+        "churn_bound": churned["bound"],
+        "churn_unbound": churned["unbound"],
+        "churn_bind_errors": churned["bind_errors"],
+        "churn_injected_bind_failures": churned.get(
+            "injected_bind_failures", 0),
+        "churn_injected_bind_timeouts": churned.get(
+            "injected_bind_timeouts", 0),
+        "churn_duplicate_binds": churned["duplicate_binds"],
+        "churn_ops_applied": churned.get("churn_ops_applied", {}),
+        "churn_liveness_requeued": churned["liveness_requeued"],
+        "churn_fence_requeued": churned["fence_requeued"],
+        "churn_degraded_steps": churned["degraded_steps"],
+        # Protean invalidation observability (ISSUE 8 acceptance):
+        # patch rows O(foreign churn), full rebuilds O(vocab growth)
+        "churn_aff_patch_rows": cnt("engine.aff_patch_rows"),
+        "churn_aff_full_rebuilds": cnt("engine.aff_full_rebuilds"),
+        "churn_label_patch_rows": cnt("engine.label_patch_rows"),
+        "churn_liveness_fence_requeues":
+            cnt("engine.liveness_fence_requeues"),
+        "churn_degraded_enter": cnt("stream.degraded_enter"),
+        "churn_degraded_exit": cnt("stream.degraded_exit"),
+    }
 
 
 def measure_extender_latency(n_nodes: int, rounds: int = 20):
@@ -955,6 +1110,27 @@ def main():
             import sys
             print(f"bench: saturation search failed: {e}", file=sys.stderr)
 
+    # churn scenario (ISSUE 8): the arrival stream under the seeded fault
+    # schedule, reported as a ratio against the same-box quiet run
+    # (BENCH_CHURN=0 to skip; BENCH_CHURN_RATE overrides the offered rate)
+    churn = None
+    if os.environ.get("BENCH_CHURN", "1") != "0":
+        try:
+            # the churn profile's wave path (6% anti classes) runs well
+            # under the density ceiling — offer a rate the quiet run can
+            # actually absorb so `sustained` measures engine capacity in
+            # BOTH runs (offering 20k/s against a ~2k/s mixed ceiling
+            # measures backlog growth, not the churn degradation)
+            churn_rate = float(os.environ.get(
+                "BENCH_CHURN_RATE", min(arrival_rate, 5000)))
+            churn = measure_churn(
+                n_nodes, rate=churn_rate,
+                duration_s=max(4.0, min(10.0, 40_000 / churn_rate)),
+                budget_ms=arrival_budget)
+        except Exception as e:
+            import sys
+            print(f"bench: churn measurement failed: {e}", file=sys.stderr)
+
     # mixed-affinity drain (ISSUE 3 headline): same box, same protocol,
     # >=15% required (anti-)affinity pods (BENCH_MIXED=0 to skip)
     mixed = None
@@ -1051,18 +1227,27 @@ def main():
         if arrival else None,
         "arrival_creator_jitter_ok": arrival["creator_jitter_ok"]
         if arrival else None,
+        # robustness telemetry (ISSUE 8): bind errors + fence/degrade
+        # counters travel with the headline arrival numbers
+        "arrival_bind_errors": arrival["bind_errors"] if arrival else None,
+        "arrival_fence_requeued": arrival["fence_requeued"]
+        if arrival else None,
+        "arrival_liveness_requeued": arrival["liveness_requeued"]
+        if arrival else None,
+        "arrival_degraded_steps": arrival["degraded_steps"]
+        if arrival else None,
         # offered sweeps + saturation search: the max offered rate the
         # engine sustains with p99 create->bound under the budget
         "arrival_sweeps": sweeps,
         "arrival_saturation": saturation,
-    }, **(mixed or {}), **(gangmix or {}))
+    }, **(churn or {}), **(mixed or {}), **(gangmix or {}))
     print(json.dumps(out))
 
     # resume the bench trajectory: persist this round's numbers as the
     # BENCH_r10 artifact — same {cmd, rc, parsed} shape as the
     # driver-written BENCH_r01..r05 files, so trajectory readers keep
     # working. BENCH_ARTIFACT= (empty) disables, or names another round.
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r10.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r11.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

@@ -158,7 +158,8 @@ def _batch_pre(pods: Arrays, nodes: Arrays,
     with the cluster once hostname domains are interned: computing them
     per POD was the dominant hidden cost of the r08 affinity tail
     (PROFILE_r08.md §3)."""
-    static_fit = preds.static_fits(pods, nodes)
+    static_fit = preds.static_fits(pods, nodes) \
+        & preds.node_condition_fit(pods, nodes)
     tt_cnt = jnp.einsum("pt,nt->pn", pods["intolerated_pref"],
                         nodes["taints_pref"].astype(jnp.int8),
                         preferred_element_type=jnp.int32)

@@ -285,8 +285,22 @@ class Scheduler:
                 if kind == "Pod":
                     self._on_pod_event(ev.type, obj)
                 elif kind == "Node":
-                    self._interrupt_pipeline()
+                    # liveness fence (ISSUE 8): a dying node (deletion,
+                    # cordon, NotReady flap) is marked doomed BEFORE any
+                    # pipeline flush, so a wave harvested against the
+                    # pre-event cache requeues rows targeting it instead
+                    # of binding into a ghost. Cleared after the event
+                    # applies: the refreshed snapshot then carries the
+                    # verdict itself.
+                    dying = (ev.type == "DELETED" or obj.unschedulable
+                             or not obj.is_ready())
+                    if dying:
+                        self.engine.note_node_doomed(obj.name)
+                    if self._node_event_needs_flush(ev.type, obj):
+                        self._interrupt_pipeline()
                     self._on_node_event(ev.type, obj)
+                    if dying:
+                        self.engine.clear_node_doomed(obj.name)
                 elif kind in self.VOLUME_KINDS:
                     self._interrupt_pipeline()
                     self._on_volume_event(kind, ev.type, obj)
@@ -358,6 +372,43 @@ class Scheduler:
         spec/membership, volume topology)."""
         if self._pipeline is not None:
             self._pipeline.flush()
+
+    def _node_event_needs_flush(self, etype: str, node: Node) -> bool:
+        """Does this node event invalidate anything the in-flight wave's
+        fence cannot re-validate? (ISSUE 8: flushing per event was ~all of
+        the churn throughput collapse — at 10%/min on 5k nodes the
+        pipeline never kept two waves in flight.)
+
+        LIVENESS-ONLY transitions don't need the flush anymore: rows
+        targeting a dead/cordoned/NotReady node are caught by the fence's
+        liveness re-validation (doomed set + refreshed schedulable/valid),
+        and a DELETED node tombstones in place so node order — which the
+        fence's row indices bake — never moves. A respawn onto a
+        tombstone is safe too: the in-flight wave was dispatched while
+        the row was invalid, so no row targets it. What still flushes:
+        SPEC changes (labels/taints/allocatable/avoid — the static
+        predicates are evaluated at dispatch and never re-checked) and
+        genuinely NEW nodes (membership growth reorders the snapshot
+        under the fence's indices)."""
+        pipe = self._pipeline
+        if pipe is None or pipe.idle:
+            return False
+        if etype == "DELETED":
+            return False  # tombstone + liveness fence cover it
+        with self.cache._lock:
+            info = self.cache._nodes.get(node.name)
+            prev = info.node if info is not None else None
+        if info is None:
+            return True   # new name: membership reorder at next refresh
+        if prev is None:
+            return False  # respawn onto a tombstone: no in-flight row
+            # can target it, and the name keeps its row
+        return not (prev.labels == node.labels
+                    and prev.taints == node.taints
+                    and prev.allocatable == node.allocatable
+                    and prev.capacity == node.capacity
+                    and prev.allowed_pod_number == node.allowed_pod_number
+                    and prev.annotations == node.annotations)
 
     # ------------------------------------------------------------ scheduling
 
@@ -531,12 +582,27 @@ class Scheduler:
                 self.queue.add_backoff(m)
 
     def _idle_gc(self) -> None:
-        """Empty-round housekeeping: expire unconfirmed assumes, gc backoff
-        stamps. An expiry mutates NodeInfos the scheduler cannot attribute
-        to a node it tracked — force the next refresh to walk everything."""
+        """Housekeeping (empty rounds + the streaming loop's wall-clock
+        cadence): expire unconfirmed assumes, gc backoff stamps, compact
+        node tombstones. An expiry mutates NodeInfos the scheduler cannot
+        attribute to a node it tracked — force the next refresh to walk
+        everything."""
         if self.cache.cleanup_assumed():
             self.engine.note_full_refresh()
         self.queue.backoff.gc()
+        # amortized membership compaction (ISSUE 8): dead nodes tombstone
+        # in place so churn never restructures the snapshot per event;
+        # once enough podless tombstones accumulate, pay ONE full rebuild
+        # to reclaim their rows. ONLY while the pipeline is idle: an
+        # in-flight wave's fence/assume path maps row indices baked at
+        # dispatch through the refreshed snapshot, and the whole point of
+        # tombstoning is that node order never moves under it.
+        if self._pipeline is not None and not self._pipeline.idle:
+            return
+        n_nodes = max(len(self.engine.snapshot.node_names), 8)
+        if self.cache.purgeable_tombstones() > max(8, n_nodes // 8) \
+                and self.cache.purge_tombstones():
+            self.engine.note_full_refresh()
 
     def _preempt_round(self, unschedulable: List[Pod]) -> int:
         """Preemption pass (1.8 generic_scheduler.Preempt, feature-gated
@@ -699,8 +765,18 @@ class Scheduler:
         out = {"popped": 0, "bound": 0, "bind_errors": 0, "preemptions": 0,
                "unschedulable": len(res.unschedulable),
                "fence_requeued": len(res.conflicts),
-               "gang_requeued": len(res.gang_requeued)}
+               "gang_requeued": len(res.gang_requeued),
+               "liveness_requeued": len(res.liveness_requeued)}
         record = self.record_events
+        for pod in res.liveness_requeued:
+            # the target node died/cordoned mid-flight (ISSUE 8): requeue
+            # WITH backoff — the topology is not coming back on a
+            # capacity-race timescale
+            if record:
+                self._event(pod, "Warning", "FailedScheduling",
+                            f"node {pod.node_name or '?'} no longer live "
+                            "at the wave fence")
+            self.queue.add_backoff(dataclasses.replace(pod, node_name=""))
         for name in res.gang_committed:
             # quorum committed through the wave fence: the gang is past
             # its atomicity point — later members/retries go solo
@@ -798,7 +874,7 @@ class Scheduler:
         any chunk the engine cannot wave-place falls back per chunk."""
         total = {"popped": 0, "bound": 0, "unschedulable": 0,
                  "bind_errors": 0, "preemptions": 0, "fence_requeued": 0,
-                 "gang_requeued": 0}
+                 "gang_requeued": 0, "liveness_requeued": 0}
         if pipeline is None:
             pipeline = (self.batch_mode == "wave"
                         and not features.enabled("PodPriority"))
@@ -806,7 +882,7 @@ class Scheduler:
             for _ in range(max_rounds):
                 stats = self.schedule_round(max_batch=max_batch)
                 for k in stats:
-                    total[k] += stats[k]
+                    total[k] = total.get(k, 0) + stats[k]
                 if stats["popped"] == 0 and self.sync() == 0 \
                         and self.queue.ready_count() == 0:
                     break
@@ -823,14 +899,14 @@ class Scheduler:
             for _ in range(max_rounds):
                 stats = pipe.step()
                 for k in stats:
-                    total[k] += stats[k]
+                    total[k] = total.get(k, 0) + stats[k]
                 if stats["popped"] == 0 and pipe.idle \
                         and self.sync() == 0 \
                         and self.queue.ready_count() == 0:
                     break
         finally:
             for k, v in pipe.close().items():
-                total[k] += v
+                total[k] = total.get(k, 0) + v
         return total
 
     # ------------------------------------------------------------- handlers
@@ -872,7 +948,23 @@ class Scheduler:
         # what changed (vocab interning, node order) — next refresh walks all
         self.engine.note_full_refresh()
         if etype == "DELETED":
-            self.cache.remove_node(node.name)
+            # assumed pods on the dead node are forgotten by the cache
+            # (ISSUE 8 audit: their optimistic capacity claim pointed at a
+            # node that no longer exists). Any that the apiserver still
+            # shows UNBOUND requeue with backoff — the assume raced the
+            # node's death and the bind never landed; already-bound ones
+            # are ghost orphans for node lifecycle to evict, not ours to
+            # double-bind.
+            for pod in self.cache.remove_node(node.name):
+                key = pod.key()
+                prev = self._pods.get(key)
+                if prev is not None and not prev.node_name:
+                    self._event(pod, "Warning", "FailedScheduling",
+                                f"assumed node {node.name} deleted "
+                                "before bind")
+                    self._first_queued.setdefault(key, time.monotonic())
+                    self.queue.add_backoff(
+                        dataclasses.replace(pod, node_name=""))
         else:
             self.cache.update_node(node)
 

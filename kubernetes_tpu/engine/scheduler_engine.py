@@ -671,7 +671,9 @@ class _WaveEncoding:
                  "raw_rows", "delta_ok", "adata", "wave_strict",
                  "has_aff_pod", "fits_on", "prio_on", "aff_seq",
                  "committed_nodes", "key_node", "static_forbid_hit",
-                 "tail_cols", "aff_wave_dev", "aff_tail_dev")
+                 "tail_cols", "aff_wave_dev", "aff_tail_dev",
+                 "anti_terms", "aff_terms", "foreign_forbid",
+                 "foreign_forbid_dom", "aff_patch_dirty")
 
     def __init__(self, vocab_gen, key_index, reps, cls_arr, num_classes,
                  c_pad, req_rows, special, derived, ports_max,
@@ -709,6 +711,26 @@ class _WaveEncoding:
         self.tail_cols = tail_cols                  # np int64 [Lp]
         self.committed_nodes = np.zeros((c_pad, n_pad), dtype=np.int32) \
             if fits_on else None
+        # Protean overlays (ISSUE 8): FOREIGN churn patched in since the
+        # build instead of rebuilt over. foreign_forbid [C, N] counts
+        # foreign pods matching class c's required-anti selectors resident
+        # on node n (merged into the device static_forbid + both fence
+        # views); foreign_forbid_dom is the same over the tail's projected
+        # domain columns (multi-node-domain terms of strict-tail classes).
+        # Counts, not booleans, so an unbind of a PATCHED source decrements
+        # exactly; a build-time static source leaving keeps its baked 0/1
+        # hit (forbidding too much is the safe side — the next full
+        # rebuild, whenever vocab growth forces one, trues it up).
+        self.foreign_forbid = np.zeros((c_pad, n_pad), dtype=np.int32) \
+            if fits_on else None
+        self.foreign_forbid_dom = np.zeros(
+            (c_pad, len(tail_cols)), dtype=np.int32) \
+            if fits_on and tail_cols is not None else None
+        self.aff_patch_dirty = False
+        # per-class required term lists for foreign-event matching
+        # [(class, slot, term, rep)] — empty for affinity-free encodings
+        self.anti_terms: list = []
+        self.aff_terms: list = []
         # raw int64 per-class delta rows (requested cpu/mem/gpu/scratch/
         # overlay + nonzero cpu/mem) for snapshot.apply_assume_delta, and
         # which classes qualify for it (no ports/volumes/extended — those
@@ -773,16 +795,20 @@ class WaveHarvest:
     unit, exactly the below-quorum rollback of the classic round)."""
 
     __slots__ = ("bound", "conflicts", "unschedulable", "t_block",
-                 "gang_committed", "gang_requeued")
+                 "gang_committed", "gang_requeued", "liveness_requeued")
 
     def __init__(self, bound, conflicts, unschedulable, t_block,
-                 gang_committed=None, gang_requeued=None):
+                 gang_committed=None, gang_requeued=None,
+                 liveness_requeued=None):
         self.bound = bound
         self.conflicts = conflicts
         self.unschedulable = unschedulable
         self.t_block = t_block
         self.gang_committed = gang_committed or []
         self.gang_requeued = gang_requeued or []  # [(pod, reason)]
+        # rows whose target node died / was cordoned mid-flight (ISSUE 8):
+        # requeue WITH backoff — not a capacity race, not unschedulability
+        self.liveness_requeued = liveness_requeued or []
 
 
 class SchedulingEngine:
@@ -819,6 +845,13 @@ class SchedulingEngine:
         self.track_dirty = False
         self._pending_dirty: set = set()
         self._need_full_refresh = True
+        # liveness fence (ISSUE 8): node names the OWNER declared dying
+        # (DELETED / cordoned / NotReady watch event observed but not yet
+        # applied to the cache) — the harvest fence requeues any blind-wave
+        # row targeting one instead of binding into a ghost. The owner
+        # marks BEFORE flushing the pipeline and clears after the event is
+        # applied (the refreshed snapshot then carries the verdict itself).
+        self._doomed_nodes: set = set()
         # pipelined-drain state (dispatch_waves/harvest_waves)
         self._wave_enc = None
         self._rr_chain = None  # device RR counter chaining between waves
@@ -1141,6 +1174,18 @@ class SchedulingEngine:
         assumed-pod TTL expiry) — the next refresh walks everything."""
         self._need_full_refresh = True
 
+    def note_node_doomed(self, *node_names: str) -> None:
+        """The owner observed a node-dying watch event (DELETED, cordon,
+        NotReady) it has NOT yet applied: any in-flight wave row targeting
+        these nodes must requeue at the fence, not bind (ISSUE 8)."""
+        self._doomed_nodes.update(node_names)
+
+    def clear_node_doomed(self, *node_names: str) -> None:
+        """The dying event is applied — the snapshot now carries the
+        verdict (schedulable=False / node absent), so the doom mark is
+        redundant for every later dispatch."""
+        self._doomed_nodes.difference_update(node_names)
+
     def _refresh(self) -> Dict[str, object]:
         """Snapshot refresh with the targeted-hint fast path when the owner
         tracks dirt (ISSUE 2: the batch drain's analog of the extender's
@@ -1209,7 +1254,13 @@ class SchedulingEngine:
 
     _STATE_NODE_KEYS = frozenset({
         "requested", "nonzero", "pod_count", "port_bitmap",
-        "vol_present", "vol_rw", "pd_present", "pd_counts"})
+        "vol_present", "vol_rw", "pd_present", "pd_counts",
+        # node CONDITION arrays flip under churn (kills, NotReady flaps,
+        # cordons, respawns) but precompute does not read them since
+        # ISSUE 8 (node_condition_fit is ANDed fresh per dispatch) —
+        # keying on them rebuilt the ~1s-at-5k-nodes static pre once per
+        # fault event, which IS the churn throughput collapse
+        "schedulable", "valid", "mem_pressure", "disk_pressure"})
 
     def _tail_wave_pre(self, enc: "_WaveEncoding", nodes):
         """The drain's shared waves.precompute instance (see _pre_cache).
@@ -1235,6 +1286,231 @@ class SchedulingEngine:
         self._pre_cache = (enc, key, pre)
         return pre
 
+    # ---------------------------------------- Protean delta patch (ISSUE 8)
+
+    def _try_patch_foreign(self, enc: "_WaveEncoding") -> bool:
+        """Absorb FOREIGN occupancy churn into the cached wave encoding by
+        patching exactly the rows it touched (PAPERS.md §Protean: key the
+        cache on what invalidates it) instead of rebuilding AffinityData
+        wholesale. Patchable events are plain pods entering/leaving known
+        nodes: a plain pod matching an encoded class's required-ANTI
+        selector adds/removes a forbidden source on exactly one node (and,
+        for strict-tail classes, its projected domain columns); a plain
+        pod matching nothing is a no-op for every topology view. Returns
+        False — rebuild — when the event log no longer covers the gap, a
+        churned pod CARRIES (anti-)affinity terms (it is a potential
+        symmetry source whose own terms bake into forbid_static), it
+        matches an encoded class's own required-AFFINITY selector (the
+        allow set must both grow and shrink exactly), or its node is
+        unknown to the snapshot. Delta-0 events (the pod's NodeInfo
+        became a tombstone stub under the same name) are no-op patches:
+        the snapshot keeps the row and its labels, so nothing the build
+        resolved through that node moved."""
+        from kubernetes_tpu.ops.affinity import _has_affinity
+        from kubernetes_tpu.ops.oracle_ext import term_matches_pod
+        from kubernetes_tpu.utils.trace import COUNTERS
+
+        events = self.cache.aff_events_since(enc.aff_seq)
+        if events is None:
+            return False
+        if not events:
+            return True
+        snap = self.snapshot
+        ad = enc.adata
+        patched = 0
+        touched = False
+        for _seq, pod, node_name, delta in events:
+            if delta == 0:
+                # "structure moved" sentinel: the pod's NodeInfo became a
+                # TOMBSTONE stub under the same name (cache.remove_node).
+                # The snapshot keeps the row and its label content, so
+                # every domain the build resolved through that node —
+                # the pod's own contributions AND any symmetry terms it
+                # carries — is still exact: a tombstone move is a no-op
+                # for the topology views whatever the pod carries.
+                patched += 1
+                continue
+            if _has_affinity(pod):
+                return False  # potential symmetry source entering or
+                # leaving: its own terms bake into forbid_static — no
+                # row patch expresses that
+            if ad is None:
+                # affinity-free encoding: plain churn cannot touch it —
+                # advancing the expectation IS the patch
+                patched += 1
+                continue
+            for _c, _s, term, rep in enc.aff_terms:
+                if term_matches_pod(term, rep, pod):
+                    return False  # allow-set delta: must be exact both ways
+            n_idx = snap.node_index.get(node_name, -1)
+            if n_idx < 0:
+                return False
+            for c, a, term, rep in enc.anti_terms:
+                if not term_matches_pod(term, rep, pod):
+                    continue
+                ff = enc.foreign_forbid
+                if ff is not None:
+                    if delta > 0:
+                        ff[c, n_idx] += 1
+                        touched = True
+                    elif ff[c, n_idx] > 0:
+                        ff[c, n_idx] -= 1
+                        touched = True
+                    # else: a build-time static source left — the baked
+                    # 0/1 hit cannot decrement; stay forbidden (safe side)
+                fd = enc.foreign_forbid_dom
+                if fd is not None and enc.tail_cols is not None \
+                        and enc.tail_cols.size:
+                    cols_hit = (
+                        (ad.anti_keymask[c, a, enc.tail_cols] > 0)
+                        & (snap.labels[n_idx, enc.tail_cols] > 0))
+                    if delta > 0:
+                        fd[c, cols_hit] += 1
+                        touched = True
+                    else:
+                        dec = cols_hit & (fd[c] > 0)
+                        if dec.any():
+                            fd[c, dec] -= 1
+                            touched = True
+            patched += 1
+        enc.aff_seq = events[-1][0]
+        if touched:
+            enc.aff_patch_dirty = True
+        COUNTERS.inc("engine.aff_patch_rows", patched)
+        return True
+
+    def _try_patch_labels(self, enc: "_WaveEncoding", infos) -> bool:
+        """Absorb label-CONTENT churn (relabels to already-interned
+        columns) into the cached encoding by re-deriving the topology
+        projections of exactly the touched node ROWS. The gate is
+        COLUMN-aware: a relabel only forces a rebuild when the changed
+        columns intersect the domains a baked array actually resolved
+        through — a zone flip on a node hosting anti-affinity targets is
+        patchable when every anti term keys on hostname columns (the
+        dominant production shape). Rebuild triggers: a changed column
+        under a term keymask whose selector matches a resident pod (the
+        baked forbid/allow domain moved), a resident pods_with_affinity
+        whose OWN term topology keys cover a changed column (its symmetry
+        contribution moved), patched foreign-forbid weight riding changed
+        columns, or a relabel that merges two nodes into one anti domain
+        of a wave-eligible class (the singleton-domain invariant the
+        per-node wave mask rides)."""
+        from kubernetes_tpu.ops.affinity import _term_topology_keys
+        from kubernetes_tpu.ops.oracle_ext import term_matches_pod
+        from kubernetes_tpu.utils.trace import COUNTERS
+
+        snap = self.snapshot
+        entries = snap.labels_rows_since(enc.labels_gen)
+        if entries is None:
+            return False
+        if not entries:
+            return True
+        ad = enc.adata
+        if ad is None:
+            enc.labels_gen = snap.labels_gen
+            return True
+        L = ad.anti_keymask.shape[2]
+        by_row: Dict[int, set] = {}
+        for r, cols in entries:
+            by_row.setdefault(r, set()).update(
+                int(c) for c in cols if c < L)
+        rows = sorted(by_row)
+        names = snap.node_names
+        vocab_cols = snap.label_vocab.by_key
+        for r in rows:
+            if r >= len(names):
+                return False
+            info = infos.get(names[r])
+            if info is None:
+                return False
+            cols = np.asarray(sorted(by_row[r]), dtype=np.int64)
+            if cols.size == 0:
+                continue
+            for c, a, term, rep in enc.anti_terms:
+                if ad.anti_keymask[c, a, cols].any() and any(
+                        term_matches_pod(term, rep, q) for q in info.pods):
+                    return False  # a baked forbid source's domain moved
+            for c, s, term, rep in enc.aff_terms:
+                if ad.aff_keymask[c, s, cols].any() and any(
+                        term_matches_pod(term, rep, q) for q in info.pods):
+                    return False  # a baked allow source's domain moved
+            colset = by_row[r]
+            for q in info.pods_with_affinity:
+                for key in _term_topology_keys(q):
+                    if any(k < L and k in colset
+                           for k in vocab_cols.get(key, ())):
+                        return False  # a symmetry source's domain moved
+            if enc.foreign_forbid is not None \
+                    and enc.foreign_forbid[:, r].any() and any(
+                        ad.anti_keymask[c, a, cols].any()
+                        for c, a, _t, _rep in enc.anti_terms):
+                return False  # patched per-node weight resolved through
+                # a column this relabel moved
+            if enc.foreign_forbid_dom is not None \
+                    and enc.tail_cols is not None and enc.tail_cols.size:
+                in_tail = np.isin(enc.tail_cols, cols)
+                if in_tail.any() \
+                        and enc.foreign_forbid_dom[:, in_tail].any():
+                    return False
+        if enc.key_node is not None:
+            km = ad.anti_keymask                            # [C, A, L]
+            wave_cls = ~ad.wave_strict                      # [C]
+            km_wave = km[wave_cls]
+            all_cols = sorted(set().union(*by_row.values())) \
+                if by_row else []
+            if km_wave.size and all_cols:
+                # singleton-domain invariant check over the wave-eligible
+                # classes' anti columns this relabel touched
+                cols_arr = np.asarray(all_cols, dtype=np.int64)
+                active = km_wave.astype(bool).any(axis=(0, 1))[cols_arr]
+                hit = cols_arr[active]
+                if hit.size and np.any(
+                        snap.domain_node_counts()[hit] > 1):
+                    return False
+            C_, A_, L_ = km.shape
+            lab_t = snap.labels[rows].astype(np.float64).T  # [L, r]
+            kn_rows = ((km.reshape(C_ * A_, L_).astype(np.float64) @ lab_t)
+                       > 0).reshape(C_, A_, len(rows))
+            # copy-on-write: the current arrays back frozen device uploads
+            # (sanitize seals them) — never mutate them in place
+            key_node = enc.key_node.copy()
+            key_node[:, :, rows] = kn_rows.astype(np.int8)
+            enc.key_node = key_node
+            sfh = enc.static_forbid_hit.copy()
+            sfh[:, rows] = ((ad.forbid_static.astype(np.float64) @ lab_t)
+                            > 0).astype(np.int8)
+            enc.static_forbid_hit = sfh
+            enc.aff_patch_dirty = True
+        if enc.tail_cols is not None and enc.aff_tail_dev is not None:
+            enc.aff_tail_dev["labels_aff"] = sanitize.upload_frozen(
+                snap.labels[:, enc.tail_cols])
+        enc.labels_gen = snap.labels_gen
+        COUNTERS.inc("engine.label_patch_rows", len(rows))
+        return True
+
+    def _flush_aff_patches(self, enc: "_WaveEncoding") -> None:
+        """Re-upload the device views a patch invalidated — one batched
+        refresh per dispatch, however many events were absorbed. Fresh
+        temporaries are frozen (never the live overlays: those keep
+        mutating patch over patch)."""
+        if not enc.aff_patch_dirty:
+            return
+        if enc.aff_wave_dev is not None:
+            merged = enc.static_forbid_hit.astype(np.int32)
+            if enc.foreign_forbid is not None:
+                merged = merged + enc.foreign_forbid
+            enc.aff_wave_dev["static_forbid"] = sanitize.upload_frozen(
+                np.minimum(merged, 127).astype(np.int8))
+            enc.aff_wave_dev["key_node"] = sanitize.upload_frozen(
+                enc.key_node.copy())
+        if enc.aff_tail_dev is not None and enc.tail_cols is not None:
+            base = enc.adata.forbid_static[:, enc.tail_cols].astype(np.int32)
+            if enc.foreign_forbid_dom is not None:
+                base = base + enc.foreign_forbid_dom
+            enc.aff_tail_dev["forbid_static"] = sanitize.upload_frozen(
+                np.minimum(base, 127).astype(np.int8))
+        enc.aff_patch_dirty = False
+
     def _wave_encoding(self, pods: Sequence[Pod], infos):
         """(encoding, pod_class[n]) for a pipeline chunk, via the
         (vocab_gen, aff_seq)-keyed reuse cache; None when any class is not
@@ -1257,10 +1533,16 @@ class SchedulingEngine:
 
         snap = self.snapshot
         enc = self._wave_enc
-        if enc is not None and enc.vocab_gen == snap.vocab_gen \
-                and enc.aff_seq == self.cache.aff_seq \
-                and (enc.adata is None
-                     or enc.labels_gen == snap.labels_gen):
+        fresh = enc is not None and enc.vocab_gen == snap.vocab_gen
+        if fresh and enc.adata is not None \
+                and enc.labels_gen != snap.labels_gen:
+            # label content moved: patch the touched rows (Protean,
+            # ISSUE 8) or fall through to the rebuild
+            fresh = self._try_patch_labels(enc, infos)
+        if fresh and enc.aff_seq != self.cache.aff_seq:
+            # foreign occupancy churn: patch the touched rows or rebuild
+            fresh = self._try_patch_foreign(enc)
+        if fresh:
             key_index = enc.key_index
             pc = np.empty(len(pods), dtype=np.int32)
             hit = True
@@ -1311,6 +1593,11 @@ class SchedulingEngine:
         key_node = static_forbid_hit = tail_cols = None
         if chunk_aff or cluster_aff:
             COUNTERS.inc("engine.wave_aff_build")
+            # the churn-robustness observable (ISSUE 8): every wholesale
+            # AffinityData build the patch paths could NOT absorb. Under
+            # the churn profile this must stay O(vocab growth + class-set
+            # growth), not O(foreign binds) — the bench reports it.
+            COUNTERS.inc("engine.aff_full_rebuilds")
             adata = AffinityData(batch.reps, snap, all_pairs, aff_pairs,
                                  (), self.hard_pod_affinity_weight,
                                  c_pad=c_pad)
@@ -1350,7 +1637,7 @@ class SchedulingEngine:
         # clone the reps for reuse: the originals get node_name assigned at
         # assume time, which would corrupt their class key as seeds
         reps = [_dc.replace(p) for p in batch.reps]
-        self._wave_enc = _WaveEncoding(
+        self._wave_enc = enc2 = _WaveEncoding(
             snap.vocab_gen, key_index, reps, cls_arr, n_cls, c_pad,
             rb.req[:n_cls].astype(np.int64), special, derived, ports_max,
             adata=adata, fits_on=fits_on, prio_on=prio_on,
@@ -1359,7 +1646,14 @@ class SchedulingEngine:
             key_node=key_node, static_forbid_hit=static_forbid_hit,
             tail_cols=tail_cols, n_pad=snap.valid.shape[0],
             labels_gen=snap.labels_gen)
-        return self._wave_enc, batch.pod_class[len(seed):].copy()
+        if adata is not None:
+            from kubernetes_tpu.ops.oracle_ext import _own_terms
+            for c, rep in enumerate(reps):
+                for a, term in enumerate(_own_terms(rep, anti=True)):
+                    enc2.anti_terms.append((c, a, term, rep))
+                for s, term in enumerate(_own_terms(rep, anti=False)):
+                    enc2.aff_terms.append((c, s, term, rep))
+        return enc2, batch.pod_class[len(seed):].copy()
 
     def dispatch_waves(self, pods: Sequence[Pod], pop_ts: float = 0.0,
                        gangs=None) -> Optional[WaveHandle]:
@@ -1398,6 +1692,10 @@ class SchedulingEngine:
             if out is None:
                 return None
             enc, pc = out
+            if enc.adata is not None:
+                # patched topology views re-upload once per dispatch,
+                # however many churn events were absorbed since the last
+                self._flush_aff_patches(enc)
             n = len(pods)
             p_pad = bucket(max(n, self.wave_pad_floor or 1))
             pc_pad = np.full(p_pad, enc.num_classes, dtype=np.int32)
@@ -1490,6 +1788,13 @@ class SchedulingEngine:
         self._refresh()
         enc = handle.enc
         snap = self.snapshot
+        if enc is self._wave_enc and enc.adata is not None \
+                and enc.aff_seq != self.cache.aff_seq:
+            # foreign churn landed while this wave was in flight: patch
+            # the overlays NOW so the topology fence below compares
+            # against it exactly; a failed patch leaves the mismatch and
+            # _fence_affinity requeues every relevant row conservatively
+            self._try_patch_foreign(enc)
         n = len(handle.pods)
         p_pad = bucket(max(n, handle.pad_floor or 1))
         t0 = _time.perf_counter()
@@ -1605,9 +1910,10 @@ class SchedulingEngine:
         acc_node = np.empty(0, dtype=np.int64)
         acc_cls = np.empty(0, dtype=np.int32)
         conflict_idx: List[int] = []
+        liveness_idx: List[int] = []
         if placed_idx.size:
             with timed_span("pipeline.fence"):
-                acc_idx, acc_node, acc_cls, conflict_idx = \
+                acc_idx, acc_node, acc_cls, conflict_idx, liveness_idx = \
                     self._fence(handle, sel, placed_idx)
         # the GANG FENCE (ISSUE 5): all-or-nothing atomicity for gangs that
         # rode this wave as ordinary batches. A gang COMMITS when >= quorum
@@ -1650,6 +1956,13 @@ class SchedulingEngine:
                                 if drop is None or not drop[i]]
         conflicts += [pods[i] for i in conflict_idx
                       if drop is None or not drop[i]]
+        # liveness rejects (ISSUE 8): the target node died / was cordoned
+        # mid-flight — requeue WITH backoff (the caller's contract): the
+        # node is not coming back on a capacity-race timescale, and a
+        # plain re-add would hot-loop the doomed rows against the same
+        # dying topology until the event drains
+        liveness = [pods[i] for i in liveness_idx
+                    if drop is None or not drop[i]]
         if acc_idx.size:
             names = snap.node_names
             groups = []
@@ -1687,23 +2000,25 @@ class SchedulingEngine:
                                if nm not in dirty_names]
                 for s in self._blind_listeners:
                     s.update(blind_names)
-            if enc.adata is not None and enc is self._wave_enc:
+            if enc is self._wave_enc:
                 # fold fence-accepted commits into the encoding's
                 # cumulative per-node topology occupancy — the host
                 # mirror the next dispatch seeds the device loop from —
                 # and into its aff_seq expectation (assume_pods_grouped
-                # just bumped cache.aff_seq once per affinity pod). A
-                # stale enc skips both: its aff_seq mismatch forces the
-                # next dispatch to rebuild from the live NodeInfos,
-                # which already contain these assumes.
+                # just bumped cache.aff_seq once per assumed pod; the
+                # churn sequence covers ALL pods since ISSUE 8). A stale
+                # enc skips both: its aff_seq mismatch routes the next
+                # dispatch through the patch/rebuild gate, which already
+                # sees these assumes in the live NodeInfos.
                 if enc.committed_nodes is not None:
                     np.add.at(enc.committed_nodes, (acc_cls, acc_node),
                               1)
-                enc.aff_seq += int(enc.has_aff_pod[acc_cls].sum())
+                enc.aff_seq += len(acc_l)
             bound = [pods[i] for i in sorted(acc_l)]
         return WaveHarvest(bound, conflicts, unschedulable, t_block,
                            gang_committed=gang_committed,
-                           gang_requeued=gang_requeued)
+                           gang_requeued=gang_requeued,
+                           liveness_requeued=liveness)
 
     def _fence(self, handle: WaveHandle, sel: np.ndarray,
                placed_idx: np.ndarray):
@@ -1774,8 +2089,25 @@ class SchedulingEngine:
                 if n_rej:
                     COUNTERS.inc("engine.affinity_fence_requeues", n_rej)
                 ok &= ~aff_bad
+        # liveness re-validation (ISSUE 8): a row targeting a node the
+        # owner declared dying (watch event seen, not yet applied — the
+        # doomed set) or one the refreshed snapshot already rules out
+        # (deleted membership, cordon/NotReady since dispatch) must not
+        # bind into a ghost. These rows requeue WITH backoff, separately
+        # from capacity conflicts.
+        live_bad = ~(snap.schedulable[gnode] & snap.valid[gnode])
+        if self._doomed_nodes:
+            idx_map = snap.node_index
+            dm = [idx_map[nm] for nm in self._doomed_nodes if nm in idx_map]
+            if dm:
+                live_bad |= np.isin(gnode, np.asarray(dm))
+        if live_bad.any():
+            COUNTERS.inc("engine.liveness_fence_requeues",
+                         int(live_bad.sum()))
+            ok &= ~live_bad
         return (gidx[ok], gnode[ok], cls_rows[ok],
-                sorted(gidx[~ok].tolist()))
+                sorted(gidx[~ok & ~live_bad].tolist()),
+                sorted(gidx[live_bad].tolist()))
 
     def _fence_affinity(self, enc: "_WaveEncoding", cls_rows: np.ndarray,
                         gnode: np.ndarray) -> Optional[np.ndarray]:
@@ -1806,6 +2138,11 @@ class SchedulingEngine:
         own_forb = (occ * enc.key_node).sum(axis=1)           # [C, N]
         sym = (m2.T @ (kn * np.repeat(cn, A_, axis=0)))       # [C, N]
         forb = own_forb + sym + enc.static_forbid_hit
+        if enc.foreign_forbid is not None:
+            # Protean overlay (ISSUE 8): foreign churn patched in since
+            # the build — exactly the rows the wholesale rebuild would
+            # have re-derived
+            forb = forb + enc.foreign_forbid
         aff_bad = forb[cls_rows, gnode] > 0
         cols = enc.tail_cols
         lab_p = cd = None
@@ -1829,8 +2166,10 @@ class SchedulingEngine:
             own_dom = (occ_dom * kp).sum(axis=1)              # [C, Lp]
             sym_dom = np.einsum("dac,dal->cl", m3,
                                 kp * cd[:, None, :])          # [C, Lp]
-            aff_bad |= np.einsum("ml,ml->m",
-                                 (own_dom + sym_dom)[cls_rows],
+            dom = own_dom + sym_dom
+            if enc.foreign_forbid_dom is not None:
+                dom = dom + enc.foreign_forbid_dom
+            aff_bad |= np.einsum("ml,ml->m", dom[cls_rows],
                                  lab_p[gnode]) > 0
         own = ad.aff_active.any(axis=1)
         own_rows = np.nonzero(own[cls_rows])[0]

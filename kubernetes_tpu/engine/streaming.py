@@ -101,6 +101,25 @@ class ScheduleLoop:
         # to the next pod's worst case
         self._lat_ewma = 0.0
         self._grow_streak = 0
+        # housekeeping under load (ISSUE 8): empty-round gating starved
+        # backoff gc + assume-TTL expiry on a saturated stream — run them
+        # on a wall-clock cadence regardless of load
+        self.gc_interval_s = 2.0
+        self._last_gc = time.monotonic()
+        # DEGRADED MODE (ISSUE 8): when the fence keeps throwing waves
+        # back (fence conflicts, liveness rejects, gang rollbacks breach
+        # degrade_threshold of the attempts for degrade_window consecutive
+        # pod-ful steps), the optimistic blind-wave pipeline is losing to
+        # churn — drop to the classic SYNCHRONOUS round (every placement
+        # sees every commit; no blind window to fence) for recover_steps
+        # pod-ful steps, then re-try streaming. Re-entering is cheap and
+        # the hysteresis window keeps one bad wave from flapping the mode.
+        self.degraded = False
+        self.degrade_threshold = 0.5
+        self.degrade_window = 3
+        self.recover_steps = 16
+        self._breach_streak = 0
+        self._degraded_left = 0
 
     # ------------------------------------------------------------- state
 
@@ -153,13 +172,54 @@ class ScheduleLoop:
         else:
             self._grow_streak = 0
 
+    # ---------------------------------------------------------- degraded
+
+    def _note_health(self, stats: Dict[str, int]) -> None:
+        """Feed one completed step into the churn-health model (streaming
+        mode only). Attempts = binds + requeues this step surfaced; a step
+        that surfaced none leaves the window untouched (idle ticks must
+        not decay a breach streak the next loaded step would continue)."""
+        if self.budget_s is None:
+            return
+        requeues = (stats.get("fence_requeued", 0)
+                    + stats.get("liveness_requeued", 0)
+                    + stats.get("gang_requeued", 0))
+        attempts = stats.get("bound", 0) + requeues
+        if self.degraded:
+            if attempts > 0:
+                self._degraded_left -= 1
+                if self._degraded_left <= 0:
+                    self.degraded = False
+                    self._breach_streak = 0
+                    COUNTERS.inc("stream.degraded_exit")
+            return
+        if attempts <= 0:
+            return
+        if requeues >= self.degrade_threshold * attempts:
+            self._breach_streak += 1
+            if self._breach_streak >= self.degrade_window:
+                self.degraded = True
+                self._degraded_left = self.recover_steps
+                COUNTERS.inc("stream.degraded_enter")
+        else:
+            self._breach_streak = 0
+
     # -------------------------------------------------------------- step
 
     def step(self, wait: float = 0.0) -> Dict[str, int]:
         s = self.sched
         stats = {"popped": 0, "bound": 0, "unschedulable": 0,
-                 "bind_errors": 0, "preemptions": 0, "fence_requeued": 0}
+                 "bind_errors": 0, "preemptions": 0, "fence_requeued": 0,
+                 "liveness_requeued": 0, "degraded_steps": 0}
         s.sync()  # columnar; node/volume events flush the pipeline first
+        now = time.monotonic()
+        if now - self._last_gc >= self.gc_interval_s:
+            # housekeeping regardless of load (ISSUE 8): a saturated
+            # stream never sees an empty round, so the empty-round-gated
+            # gc would let backoff stamps for long-bound pods and expired
+            # assumes grow without bound over a long run
+            s._idle_gc()
+            self._last_gc = now
         pods = s.queue.pop_batch(max_n=self.quantum, wait=wait)
         stats["popped"] = len(pods)
         handle = None
@@ -169,10 +229,15 @@ class ScheduleLoop:
             # sweeps below, or falls back to _process_batch which runs the
             # arrival-exempt sweep itself
             s._sweep_parked_gangs(())
+        if pods and self.degraded:
+            # degraded mode: churn is beating the blind-wave fence — run
+            # the classic synchronous round (every placement sees every
+            # commit; nothing to fence) until the health model recovers
+            stats["degraded_steps"] = 1
         if pods:
             pop_ts = time.monotonic()
             chunk_pods = pods
-            if s._wave_eligible(pods):
+            if not self.degraded and s._wave_eligible(pods):
                 # quorum-ready gangs ride the wave path as ordinary
                 # batches (ISSUE 5) — the harvest applies their
                 # all-or-nothing fence; below-quorum members park here
@@ -209,6 +274,7 @@ class ScheduleLoop:
             self._pending = {}
         if not pods:
             s._idle_gc()
+        self._note_health(stats)
         return stats
 
     # ------------------------------------------------------------ quiesce

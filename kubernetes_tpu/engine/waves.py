@@ -278,7 +278,11 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     iota = jnp.arange(P, dtype=jnp.int32)
     idx_n = jnp.arange(N, dtype=jnp.int32)
 
-    fits = pre["static_fit"] & _dynamic_fits(cls, nodes, state)  # [C,N]
+    # conditions fresh per dispatch (NOT from pre): the cached precompute
+    # survives node kills/flaps/cordons/respawns since ISSUE 8, so the
+    # liveness verdict must come from the nodes dict of THIS dispatch
+    fits = pre["static_fit"] & preds.node_condition_fit(cls, nodes) \
+        & _dynamic_fits(cls, nodes, state)  # [C,N]
     if aff is not None:
         fits = fits & _wave_aff_mask(aff, committed)
     fitcnt = fits.sum(axis=1).astype(jnp.int32)  # [C]
@@ -439,7 +443,9 @@ def frozen_affinity_scores(cls: Arrays, nodes: Arrays, state: NodeState,
     from kubernetes_tpu.ops import affinity as aff_ops
 
     w_ip, w_sp = weights
-    fits = preds.static_fits(cls, nodes) & _dynamic_fits(cls, nodes, state)
+    fits = preds.static_fits(cls, nodes) \
+        & preds.node_condition_fit(cls, nodes) \
+        & _dynamic_fits(cls, nodes, state)
     extra = jnp.zeros(fits.shape, dtype=jnp.int32)
     if w_ip:
         # jnp einsum, not the Pallas incidence kernel: this matrix is also
@@ -647,7 +653,8 @@ def tail_rounds_loop(cls: Arrays, nodes: Arrays, state: NodeState,
         (state, active, counter, fsel, ffc, commdom, committed,
          comm_cnt, w) = carry
         # ---- exact round-start evaluation, class-level [C, N] -----------
-        fits_c = pre["static_fit"] & _dynamic_fits(cls, nodes, state)
+        fits_c = pre["static_fit"] & preds.node_condition_fit(cls, nodes) \
+            & _dynamic_fits(cls, nodes, state)
         if fits_on:
             fits_c = fits_c & aff_ops.step_fits_all(aff, pre_aff, commdom,
                                                     comm_cnt, labels)

@@ -150,6 +150,42 @@ def mixed_affinity_pods(n: int, seed: int = 0,
     return out
 
 
+def churn_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
+    """ISSUE 8 churn-hardening mix: the density stream with enough
+    affinity structure that node churn exercises every invalidation path
+    instead of only capacity rows.
+
+       6%  "one replica per host" anti-affinity pods (4 apps) — their
+           topology views are what Protean delta-patching protects; a
+           node kill mid-wave is what the liveness fence protects.
+      10%  plain pods LABELED like the anti apps — anti-affinity TARGETS:
+           their churn (binds, evictions) is the patchable foreign-event
+           stream (a plain target entering/leaving a node patches one
+           forbid row; it must NOT rebuild AffinityData wholesale).
+      84%  plain density pods — the no-op patch majority.
+    """
+    out: List[Pod] = []
+    for i in range(n):
+        r = i % 100
+        if r < 6:
+            app = f"churn-iso-{r % 4}"
+            p = make_pod(f"churn-anti-{i}", namespace=namespace, cpu=100,
+                         memory=256 * Mi, labels={"app": app})
+            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required_terms=[PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": app}),
+                    namespaces=[], topology_key=HOSTNAME_KEY)]))
+        elif r < 16:
+            p = make_pod(f"churn-tgt-{i}", namespace=namespace, cpu=100,
+                         memory=500 * Mi,
+                         labels={"app": f"churn-iso-{r % 4}"})
+        else:
+            p = make_pod(f"churn-web-{i}", namespace=namespace, cpu=100,
+                         memory=500 * Mi, labels={"app": f"web-{i % 8}"})
+        out.append(p)
+    return out
+
+
 def hetero_gpu_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
     """Config 5: GPU/extended-resource requests + tolerations on 10k
     heterogeneous nodes."""
@@ -246,6 +282,7 @@ PROFILES = {
     "binpack": binpack_pods,
     "affinity": affinity_pods,
     "mixed_affinity": mixed_affinity_pods,
+    "churn": churn_pods,
     "hetero": hetero_gpu_pods,
     "gang": gang_pods,
     "gang_mix": gang_mix_pods,

@@ -374,15 +374,19 @@ def node_condition_fit(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
 
 
 def static_fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
-    """All capacity-INdependent predicates -> [P,N]. Computed once per batch;
+    """All spec-INdependent predicates -> [P,N]. Computed once per batch;
     safe to reuse across the placement scan because nothing here changes as
-    pods commit (labels/taints/host/conditions are node-spec facts)."""
+    pods commit (labels/taints/host are node-spec facts). Node CONDITIONS
+    (Ready/pressure/cordon/membership) are deliberately NOT in here since
+    ISSUE 8: they flip under churn while the engine's cached precompute
+    (waves.precompute) holds a static_fit across kills/flaps/respawns —
+    every consumer ANDs node_condition_fit against its FRESH node arrays
+    instead."""
     n = nodes["alloc"].shape[0]
     out = (
         selector_fit(pods, nodes["labels"])
         & taints_fit(pods["intolerated"], nodes["taints_sched"])
         & host_fit(pods["has_host"], pods["host_required"], n)
-        & node_condition_fit(pods, nodes)
         & volume_zone_fit(pods["vz_req"], pods["vz_err"], nodes["labels"],
                           nodes["has_zone"])
         & pv_affinity_fit(pods, nodes["labels"])
@@ -407,6 +411,7 @@ def fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
     from kubernetes_tpu.ops.pallas_kernels import resources_fit_fast
     return (
         static_fits(pods, nodes)
+        & node_condition_fit(pods, nodes)
         & resources_fit_fast(pods["req"], pods["zero_req"], nodes["alloc"],
                              nodes["requested"])
         & pod_count_fit(nodes["pod_count"], nodes["allowed_pods"])[None, :]

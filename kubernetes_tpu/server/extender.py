@@ -312,9 +312,16 @@ class TPUExtenderBackend:
             for n in nodes:
                 self.cache.update_node(n)
                 seen.add(n.name)
+            removed = False
             for name in list(self.cache.node_infos().keys()):
                 if name not in seen:
                     self.cache.remove_node(name)
+                    removed = True
+            if removed:
+                # the sidecar's sync is a wholesale reconcile that already
+                # escalates to a full refresh — compact the ISSUE 8
+                # tombstones right away instead of accruing dead rows
+                self.cache.purge_tombstones()
 
     def sync_pods(self, pods: List[Pod]) -> None:
         from kubernetes_tpu.ops.affinity import _has_affinity

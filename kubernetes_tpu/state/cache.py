@@ -43,25 +43,69 @@ class _PodState:
         self.binding_finished = False
 
 
-def _pod_has_affinity(pod: Pod) -> bool:
-    return pod.has_pod_affinity()
-
-
 class SchedulerCache:
+    # how many affinity-churn events the Protean patch log retains; a
+    # consumer further behind than this rebuilds wholesale (ISSUE 8)
+    AFF_LOG_MAX = 8192
+
     def __init__(self, ttl_seconds: float = 30.0, now: Callable[[], float] = time.monotonic):
         self._ttl = ttl_seconds
         self._now = now
         self._lock = threading.Lock()
         self._pod_states: Dict[str, _PodState] = {}
         self._nodes: Dict[str, NodeInfo] = {}
-        # affinity-churn sequence: bumped once per (anti-)affinity-carrying
-        # pod entering or leaving any NodeInfo (assume, confirm-move,
-        # foreign add/remove, TTL expiry, forget). The wave engine's cached
-        # AffinityData folds its OWN assumes into this counter, so
-        # aff_seq != expected means a FOREIGN mutation invalidated the
-        # static topology arrays (ISSUE 3). Confirming our own assume in
-        # place mutates no NodeInfo and does not bump.
+        # occupancy-churn sequence: bumped once per pod entering or leaving
+        # any NodeInfo (assume, confirm-move, foreign add/remove, TTL
+        # expiry, forget). The wave engine's cached AffinityData folds its
+        # OWN assumes into this counter, so aff_seq != expected means a
+        # FOREIGN mutation may have invalidated the static topology arrays
+        # (ISSUE 3). Confirming our own assume in place mutates no NodeInfo
+        # and does not bump. Widened from affinity-carrying pods to ALL
+        # pods in ISSUE 8: a PLAIN pod whose labels match a pending class's
+        # anti-affinity selector is a new forbidden-domain source the old
+        # keying silently missed; the Protean patch log below keeps the
+        # widened invalidation from degrading into wholesale rebuilds.
         self.aff_seq = 0
+        # Protean patch log (ISSUE 8, PAPERS.md §Protean: key caches on
+        # exactly what invalidates them): every aff_seq bump appends
+        # (seq_after, pod, node_name, delta) with delta +1 for a pod
+        # entering a NodeInfo and -1 for one leaving, so a consumer whose
+        # expectation fell behind can PATCH the exact rows foreign churn
+        # touched instead of rebuilding its topology arrays wholesale.
+        # delta == 0 is the "structure moved under this pod" sentinel
+        # (node removed: the pod's NodeInfo became a tombstone stub under
+        # the same name — a no-op for label-derived views, since the
+        # snapshot keeps the row and its label content in place).
+        # Bounded ring: _aff_log_start is the lowest seq whose delta is
+        # still retained; consumers behind it must rebuild.
+        self._aff_log: List[tuple] = []
+        self._aff_log_start = 0
+
+    # ---------------------------------------------------------- churn log
+
+    def _aff_event_locked(self, pod: Pod, node_name: str, delta: int) -> None:
+        """Bump aff_seq AND record what moved (caller holds the lock)."""
+        self.aff_seq += 1
+        log = self._aff_log
+        log.append((self.aff_seq, pod, node_name, delta))
+        # amortized trim: shifting per append would be O(ring) on the
+        # 20k-assumes/s path; trimming at 2x keeps memory bounded at one
+        # extra ring while the shift cost amortizes to O(1) per event
+        if len(log) >= 2 * self.AFF_LOG_MAX:
+            del log[:len(log) - self.AFF_LOG_MAX]
+
+    def aff_events_since(self, seq: int) -> Optional[List[tuple]]:
+        """The (seq, pod, node_name, delta) events after `seq`, oldest
+        first — or None when the bounded ring no longer covers the gap
+        (the consumer fell too far behind and must rebuild). Sequences are
+        consecutive integers, so coverage is a length check, not a scan."""
+        with self._lock:
+            behind = self.aff_seq - seq
+            if behind <= 0:
+                return []
+            if behind > len(self._aff_log):
+                return None
+            return list(self._aff_log[len(self._aff_log) - behind:])
 
     # ------------------------------------------------------------------ pods
 
@@ -91,8 +135,7 @@ class SchedulerCache:
                     info = NodeInfo()
                     self._nodes[pod.node_name] = info
                 info.add_pod_precomputed(pod, req, ncpu, nmem, ports)
-                if _pod_has_affinity(pod):
-                    self.aff_seq += 1
+                self._aff_event_locked(pod, pod.node_name, 1)
                 st = _PodState(pod)
                 st.assumed = True
                 self._pod_states[key] = st
@@ -116,8 +159,8 @@ class SchedulerCache:
                     info = NodeInfo()
                     self._nodes[node_name] = info
                 info.add_pods_same_class(pods, req, ncpu, nmem, ports)
-                if pods and _pod_has_affinity(pods[0]):
-                    self.aff_seq += len(pods)
+                for pod in pods:
+                    self._aff_event_locked(pod, node_name, 1)
                 touched[node_name] = info
                 for pod in pods:
                     key = pod.key()
@@ -267,20 +310,64 @@ class SchedulerCache:
     def update_node(self, node: Node) -> None:
         self.add_node(node)
 
-    def remove_node(self, name: str) -> None:
+    def remove_node(self, name: str) -> List[Pod]:
+        """RemoveNode (cache.go:328) + the ISSUE 8 liveness audit: ASSUMED
+        pods on the removed node are FORGOTTEN (their optimistic capacity
+        claim pointed at a node that no longer exists — keeping it would
+        leak phantom occupancy until TTL, and the owner must requeue them
+        before their bind turns into a ghost) and returned so the owner
+        can decide requeue vs orphan. Confirmed pods survive into the
+        stub (the informer owns their lifecycle).
+
+        The entry itself becomes a TOMBSTONE (node=None NodeInfo) instead
+        of disappearing: the snapshot then marks the row valid=False in
+        place — one static-row rewrite — rather than restructuring node
+        membership, which costs a FULL re-tensorization + device upload +
+        encoding/precompute rebuild per event (at 5k nodes that is
+        seconds per kill; 10%/min churn would spend the whole budget
+        rebuilding). A respawn under the same name rides the same
+        delta path. Podless tombstones are purged in amortized batches
+        (purge_tombstones) so permanent departures still reclaim rows."""
+        requeue: List[Pod] = []
         with self._lock:
-            info = self._nodes.pop(name, None)
-            # the reference keeps the entry if pods remain (cache.go:334-339);
-            # we drop it — orphaned pods re-add a nodeless NodeInfo below
-            if info is not None and info.pods:
-                stub = NodeInfo()
-                for p in info.pods:
-                    stub.add_pod(p)
-                    if _pod_has_affinity(p):
-                        # the pods' NodeInfo (and its node object) moved —
-                        # cached topology arrays resolved domains through it
-                        self.aff_seq += 1
-                self._nodes[name] = stub
+            info = self._nodes.get(name)
+            if info is None:
+                return requeue
+            assumed_keys = set()
+            for key, st in self._pod_states.items():
+                if st.assumed and st.pod.node_name == name:
+                    assumed_keys.add(key)
+            for key in assumed_keys:
+                st = self._pod_states.pop(key)
+                requeue.append(st.pod)
+                self._aff_event_locked(st.pod, name, -1)
+            survivors = [p for p in info.pods
+                         if p.key() not in assumed_keys]
+            stub = NodeInfo()
+            for p in survivors:
+                stub.add_pod(p)
+                # the pods' NodeInfo (and its node object) moved —
+                # cached topology arrays resolved domains through it;
+                # delta 0 = "structure moved", never patchable
+                self._aff_event_locked(p, name, 0)
+            self._nodes[name] = stub
+        return requeue
+
+    def purgeable_tombstones(self) -> int:
+        with self._lock:
+            return sum(1 for i in self._nodes.values()
+                       if i.node is None and not i.pods)
+
+    def purge_tombstones(self) -> int:
+        """Drop podless tombstones — the amortized membership compaction.
+        The caller must force a full snapshot refresh afterwards (this IS
+        the membership restructuring remove_node defers)."""
+        with self._lock:
+            names = [nm for nm, i in self._nodes.items()
+                     if i.node is None and not i.pods]
+            for nm in names:
+                del self._nodes[nm]
+            return len(names)
 
     # -------------------------------------------------------------- snapshot
 
@@ -319,12 +406,10 @@ class SchedulerCache:
             info = NodeInfo()
             self._nodes[pod.node_name] = info
         info.add_pod(pod)
-        if _pod_has_affinity(pod):
-            self.aff_seq += 1
+        self._aff_event_locked(pod, pod.node_name, 1)
 
     def _remove_pod_locked(self, pod: Pod) -> None:
         info = self._nodes.get(pod.node_name)
         if info is not None:
             info.remove_pod(pod)
-            if _pod_has_affinity(pod):
-                self.aff_seq += 1
+            self._aff_event_locked(pod, pod.node_name, -1)

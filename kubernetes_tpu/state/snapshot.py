@@ -165,6 +165,12 @@ class ClusterSnapshot:
         # materialized label content — the wave encoding's key_node /
         # static_forbid / labels_aff topology views — is stale then)
         self.labels_gen = 0
+        # Protean patch log for label-row churn (ISSUE 8): every
+        # labels_gen bump appends (gen_after, row), so a consumer whose
+        # baked topology views fell one relabel behind can re-derive the
+        # touched ROWS instead of rebuilding wholesale. Bounded ring;
+        # a consumer further behind than the ring rebuilds.
+        self._labels_log: List[Tuple[int, int]] = []
         self.dirty: set = set()
         self._label_index: Dict[str, set] = {}  # key -> values across nodes
         self._row_labels: List[Dict[str, str]] = []  # per-row node label maps
@@ -816,43 +822,75 @@ class ClusterSnapshot:
                                "pd_counts"))
         self.dirty.update(self.DYNAMIC)
 
+    def _assign_row(self, name: str, i: int, value) -> None:
+        """Row write with CHANGE DETECTION: dirty only what actually moved
+        (ISSUE 8). Under churn most static-row rewrites carry identical
+        values (a flap touches only conditions; a respawn restores the
+        same spec) — marking every static array dirty per event re-uploads
+        megabytes and invalidates the cached wave precompute once per
+        fault, which measured as the churn throughput collapse."""
+        arr = getattr(self, name)
+        if np.array_equal(arr[i], value):
+            return
+        arr[i] = value
+        self.dirty.add(name)
+
     def _write_static_row(self, i: int, info: NodeInfo) -> None:
         node = info.node
         r = self.num_resources
         if node is None:
-            self.schedulable[i] = False
-            self.valid[i] = False
-            self.dirty.update(("schedulable", "valid"))
+            # tombstone (cache.remove_node): the row stays allocated, only
+            # the liveness verdicts flip — membership never restructures
+            # per churn event
+            self._assign_row("schedulable", i, False)
+            self._assign_row("valid", i, False)
             return
-        self.alloc[i] = self.resource_row(
+        self._assign_row("alloc", i, self.resource_row(
             milli_cpu=node.allocatable.milli_cpu, memory=node.allocatable.memory,
             gpu=node.allocatable.nvidia_gpu, scratch=node.allocatable.storage_scratch,
             overlay=node.allocatable.storage_overlay,
-            extended=node.allocatable.extended, up=False, width=r)
-        self.allowed_pods[i] = node.allowed_pod_number
-        self.schedulable[i] = node.is_ready()
-        self.mem_pressure[i] = node.condition("MemoryPressure") == ConditionStatus.TRUE
-        self.disk_pressure[i] = node.condition("DiskPressure") == ConditionStatus.TRUE
-        self.valid[i] = True
+            extended=node.allocatable.extended, up=False, width=r))
+        self._assign_row("allowed_pods", i, node.allowed_pod_number)
+        self._assign_row("schedulable", i, node.is_ready())
+        self._assign_row("mem_pressure", i,
+                         node.condition("MemoryPressure") == ConditionStatus.TRUE)
+        self._assign_row("disk_pressure", i,
+                         node.condition("DiskPressure") == ConditionStatus.TRUE)
+        self._assign_row("valid", i, True)
         self._row_labels[i] = node.labels
-        self._write_label_row(i, node.labels)
+        gen0 = self.labels_gen
+        self._write_label_row(i, node.labels)  # content-compared inside
+        if self.labels_gen != gen0:
+            self.dirty.add("labels")
 
+        old_ts = self.taints_sched[i].copy()
+        old_tp = self.taints_pref[i].copy()
         self.taints_sched[i] = 0
         self.taints_pref[i] = 0
         self._write_taint_row(i, node)
+        if not np.array_equal(old_ts, self.taints_sched[i]):
+            self.dirty.add("taints_sched")
+        if not np.array_equal(old_tp, self.taints_pref[i]):
+            self.dirty.add("taints_pref")
 
         av = np.zeros(self.avoid.shape[1], dtype=np.int8)
         for kind, uid in _parse_avoid_annotation(node.annotations):
             idx = self.avoid_vocab.get(kind, uid)
             if idx >= 0:
                 av[idx] = 1
-        self.avoid[i] = av
+        self._assign_row("avoid", i, av)
 
         self._row_images[i] = node.images
+        old_img = self.image_sizes[i].copy() \
+            if getattr(self, "image_sizes", None) is not None \
+            and self.image_sizes.shape[1] == self._images_width else None
         self._write_image_row(i, node.images)
-        self.has_zone[i] = any(k in (volmod.ZONE_LABEL, volmod.REGION_LABEL)
-                               for k in node.labels)
-        self.dirty.update(self.STATIC)
+        if old_img is not None \
+                and not np.array_equal(old_img, self.image_sizes[i]):
+            self.dirty.add("image_sizes")
+        self._assign_row("has_zone", i,
+                         any(k in (volmod.ZONE_LABEL, volmod.REGION_LABEL)
+                             for k in node.labels))
 
     # graftlint: gen-ok — per-row helper; every caller (_write_dynamic_row,
     # finalize_images' rebuild loop) owns the dirty note for the batch
@@ -895,6 +933,8 @@ class ClusterSnapshot:
                 pdrow[idx] = 1
         self.pd_present[i] = pdrow
 
+    LABELS_LOG_MAX = 1024
+
     def _write_label_row(self, i: int, labels: Dict[str, str]) -> None:
         lbl = np.zeros(self.labels.shape[1], dtype=np.int8)
         for k, v in labels.items():
@@ -902,8 +942,30 @@ class ClusterSnapshot:
             if idx >= 0:
                 lbl[idx] = 1
         if not np.array_equal(self.labels[i], lbl):
+            changed = np.nonzero(self.labels[i] != lbl)[0]
             self.labels_gen += 1
+            self._labels_log.append((self.labels_gen, i, changed))
+            if len(self._labels_log) >= 2 * self.LABELS_LOG_MAX:
+                del self._labels_log[:len(self._labels_log)
+                                     - self.LABELS_LOG_MAX]
         self.labels[i] = lbl
+
+    def labels_rows_since(self, gen: int) -> Optional[List[tuple]]:
+        """(row, changed_columns) entries after `gen` (rows may repeat),
+        or None when the bounded ring no longer covers the gap (the
+        consumer must rebuild its label-derived views). The changed-column
+        sets let a consumer decide PER TERM whether a relabel touched the
+        columns its baked domains resolve through — a zone flip must not
+        rebuild views whose terms key on hostname columns (ISSUE 8).
+        Generations are consecutive integers, so coverage is a length
+        check."""
+        behind = self.labels_gen - gen
+        if behind <= 0:
+            return []
+        if behind > len(self._labels_log):
+            return None
+        return [(i, cols) for _g, i, cols in
+                self._labels_log[len(self._labels_log) - behind:]]
 
     def _write_ports_row(self, i: int, info: NodeInfo) -> None:
         if info.used_ports:

@@ -1,0 +1,312 @@
+"""Seeded churn / fault-injection harness for the always-on engine (ISSUE 8).
+
+The r10 headline (20k pods/s sustained, p99 create->bound ~100 ms) was
+measured on a QUIET cluster. The reference system's whole design is
+level-triggered reconciliation under exactly the conditions that number
+never saw (SURVEY §5.3/§5.4): nodes die and flap mid-storm, pods are
+evicted, labels mutate under rolling updates, and the bind API fails or
+times out. This module makes those conditions a deterministic, seeded,
+replayable input so the streaming loop's robustness claims are MEASURED:
+
+- ``FaultyBindApi`` wraps an ApiServerLite and injects bind faults at
+  seeded per-binding rates. Two fault shapes, because they heal
+  differently: a FAILURE returns an error and the write never lands
+  (the scheduler forgets + requeues — the clean retry); a TIMEOUT
+  returns an error but the write DID land — the at-most-once ambiguity
+  every RPC client lives with. The scheduler forgets + requeues, the
+  retry's bind is refused by the store ("already assigned"), and the
+  watch confirmation heals the cache — exactly-once holds at the store,
+  which is the invariant tests/test_chaos.py audits end to end.
+
+- ``make_churn_schedule`` compiles a ChurnConfig into a frozen,
+  seed-deterministic list of timed operations (node kills + respawns,
+  NotReady flaps, cordon/uncordon, zone relabels, evictions, rolling
+  updates). The SAME schedule object can drive a wall-clock thread
+  (bench.py's churn scenario) or be applied at step boundaries (the
+  frozen churn-trace A/B in tests) — determinism is the point: a churn
+  bug reproduces from (seed, config), not from a lucky race.
+
+- ``ChurnInjector`` applies a schedule against a live apiserver and
+  counts what it did, so the bench JSON reports the offered fault load
+  next to the sustained throughput it was absorbed under.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import ConditionStatus, Node, NodeCondition
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite, NotFound
+
+ZONES = ["zone-a", "zone-b", "zone-c"]
+
+
+# ---------------------------------------------------------------- bind faults
+
+
+class FaultyBindApi:
+    """ApiServerLite proxy injecting seeded bind faults on the BULK paths
+    (the only bind paths the scheduler uses — engine/scheduler._bind_bulk
+    prefers bind_pods_bulk and falls back to bind_many; both are wrapped,
+    so injected faults exercise the backoff requeue on the streaming AND
+    classic rounds). Reads delegate untouched.
+
+    fail_rate:    probability a binding errors WITHOUT landing.
+    timeout_rate: probability a binding errors but DID land (the
+                  at-most-once ambiguity: the caller cannot distinguish a
+                  lost request from a lost response).
+    """
+
+    def __init__(self, api: ApiServerLite, fail_rate: float = 0.0,
+                 timeout_rate: float = 0.0, seed: int = 0):
+        self._api = api
+        self._rng = random.Random(seed)
+        self.fail_rate = fail_rate
+        self.timeout_rate = timeout_rate
+        self.injected_failures = 0
+        self.injected_timeouts = 0
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
+
+    def _bind_with_faults(self, items, inner_bind) -> List[Optional[str]]:
+        """Shared fault body: draw per-binding faults, delegate everything
+        except pure failures to ``inner_bind`` as ONE batch (timeouts
+        included — the write LANDS, only the response is lost), then
+        stitch results back in order, injected errors winning."""
+        out: List[Optional[str]] = [None] * len(items)
+        apply_idx: List[int] = []
+        for i in range(len(items)):
+            r = self._rng.random()
+            if r < self.fail_rate:
+                out[i] = "injected: bind unavailable"
+                self.injected_failures += 1
+            elif r < self.fail_rate + self.timeout_rate:
+                out[i] = "injected: bind timeout"
+                self.injected_timeouts += 1
+                apply_idx.append(i)
+            else:
+                apply_idx.append(i)
+        if apply_idx:
+            real = inner_bind([items[i] for i in apply_idx])
+            for i, err in zip(apply_idx, real):
+                if out[i] is None:  # keep the injected-timeout error
+                    out[i] = err
+        return out
+
+    def bind_pods_bulk(self, pods) -> List[Optional[str]]:
+        return self._bind_with_faults(pods, self._api.bind_pods_bulk)
+
+    def bind_many(self, bindings) -> List[Optional[str]]:
+        return self._bind_with_faults(bindings, self._api.bind_many)
+
+
+# ------------------------------------------------------------------ schedule
+
+
+@dataclass(frozen=True)
+class ChurnOp:
+    t: float          # due instant, seconds from schedule start
+    kind: str         # kill | respawn | flap_down | flap_up | cordon |
+    #                   uncordon | relabel | evict
+    node: str = ""
+    zone: str = ""    # relabel target
+    evict_slot: int = 0  # seeded pick among currently-bound pods
+
+
+@dataclass
+class ChurnConfig:
+    """Production-shaped fault rates (all per minute, fractions of the
+    node count where applicable). Defaults follow the ROADMAP acceptance
+    shape: sustained 10%/min node churn plus flaps/evictions/relabels."""
+
+    seed: int = 0
+    node_churn_per_min: float = 0.10   # fraction of nodes killed/min
+    respawn_s: float = 2.0             # dead node returns after this
+    flap_per_min: float = 0.05         # fraction of nodes NotReady-flapped
+    flap_down_s: float = 1.0
+    cordon_per_min: float = 0.02
+    cordon_s: float = 1.5
+    relabel_per_min: float = 0.05      # zone-label mutations (rolling-
+    #                                    update-shaped topology drift)
+    evict_per_min_abs: float = 60.0    # absolute evictions per minute
+    bind_fail_rate: float = 0.0
+    bind_timeout_rate: float = 0.0
+
+
+def make_churn_schedule(node_names: List[str], cfg: ChurnConfig,
+                        duration_s: float) -> List[ChurnOp]:
+    """Compile a config into a frozen op list, sorted by due time.
+    Deterministic in (node_names, cfg, duration_s) — the replayable churn
+    trace both the bench thread and the A/B tests consume. Kill targets
+    are drawn without replacement per overlapping window so a node is
+    never killed while already dead."""
+    rng = random.Random(cfg.seed)
+    ops: List[ChurnOp] = []
+    n = len(node_names)
+    minutes = duration_s / 60.0
+
+    def uniform_times(count: float) -> List[float]:
+        c = int(count)
+        if rng.random() < count - c:
+            c += 1
+        return sorted(rng.uniform(0.0, duration_s) for _ in range(c))
+
+    # node kills + respawns: draw targets without replacement among nodes
+    # not currently dead at the kill instant
+    dead_until: Dict[str, float] = {}
+    for t in uniform_times(cfg.node_churn_per_min * n * minutes):
+        alive = [nm for nm in node_names if dead_until.get(nm, -1.0) < t]
+        if not alive:
+            continue
+        nm = alive[rng.randrange(len(alive))]
+        dead_until[nm] = t + cfg.respawn_s
+        ops.append(ChurnOp(t, "kill", node=nm))
+        ops.append(ChurnOp(t + cfg.respawn_s, "respawn", node=nm))
+    for t in uniform_times(cfg.flap_per_min * n * minutes):
+        nm = node_names[rng.randrange(n)]
+        ops.append(ChurnOp(t, "flap_down", node=nm))
+        ops.append(ChurnOp(t + cfg.flap_down_s, "flap_up", node=nm))
+    for t in uniform_times(cfg.cordon_per_min * n * minutes):
+        nm = node_names[rng.randrange(n)]
+        ops.append(ChurnOp(t, "cordon", node=nm))
+        ops.append(ChurnOp(t + cfg.cordon_s, "uncordon", node=nm))
+    for t in uniform_times(cfg.relabel_per_min * n * minutes):
+        nm = node_names[rng.randrange(n)]
+        ops.append(ChurnOp(t, "relabel", node=nm,
+                           zone=ZONES[rng.randrange(len(ZONES))]))
+    for t in uniform_times(cfg.evict_per_min_abs * minutes):
+        ops.append(ChurnOp(t, "evict", evict_slot=rng.randrange(1 << 30)))
+    ops.sort(key=lambda op: (op.t, op.kind, op.node))
+    return ops
+
+
+# ------------------------------------------------------------------ injector
+
+
+class ChurnInjector:
+    """Applies a frozen schedule against a live apiserver. Call
+    ``apply_until(t)`` from the owner's clock (a wall-clock thread in the
+    bench, a step counter in tests) — ops are consumed in order, each
+    applied exactly once. Idempotent against the cluster's own drift: a
+    kill of an already-gone node or an eviction with nothing bound is
+    counted as a no-op, not an error."""
+
+    def __init__(self, api: ApiServerLite, schedule: List[ChurnOp]):
+        self.api = api
+        self.schedule = schedule
+        self._next = 0
+        self._spec: Dict[str, Node] = {}  # last-seen spec for respawn
+        self.applied: Dict[str, int] = {}
+        self.noop = 0
+
+    def done(self) -> bool:
+        return self._next >= len(self.schedule)
+
+    def apply_until(self, t: float) -> int:
+        applied = 0
+        while self._next < len(self.schedule) \
+                and self.schedule[self._next].t <= t:
+            self._apply(self.schedule[self._next])
+            self._next += 1
+            applied += 1
+        return applied
+
+    def _get_node(self, name: str) -> Optional[Node]:
+        try:
+            return self.api.get("Node", "", name)
+        except NotFound:
+            return None
+
+    def _count(self, op: ChurnOp) -> None:
+        self.applied[op.kind] = self.applied.get(op.kind, 0) + 1
+
+    def _apply(self, op: ChurnOp) -> None:
+        api = self.api
+        if op.kind == "kill":
+            node = self._get_node(op.node)
+            if node is None:
+                self.noop += 1
+                return
+            self._spec[op.node] = node
+            try:
+                api.delete("Node", "", op.node)
+            except NotFound:
+                self.noop += 1
+                return
+        elif op.kind == "respawn":
+            spec = self._spec.get(op.node)
+            if spec is None or self._get_node(op.node) is not None:
+                self.noop += 1
+                return
+            api.create("Node", dataclasses.replace(
+                spec, labels=dict(spec.labels),
+                conditions=[dataclasses.replace(c) for c in spec.conditions],
+                resource_version=0))
+        elif op.kind in ("flap_down", "flap_up", "cordon", "uncordon",
+                         "relabel"):
+            node = self._get_node(op.node)
+            if node is None:
+                self.noop += 1
+                return
+            conditions = [dataclasses.replace(c) for c in node.conditions]
+            if op.kind in ("flap_down", "flap_up"):
+                status = ConditionStatus.FALSE if op.kind == "flap_down" \
+                    else ConditionStatus.TRUE
+                for c in conditions:
+                    if c.type == "Ready":
+                        c.status = status
+                        break
+                else:
+                    conditions.append(NodeCondition("Ready", status))
+            labels = dict(node.labels)
+            if op.kind == "relabel":
+                labels["failure-domain.beta.kubernetes.io/zone"] = op.zone
+            api.update("Node", dataclasses.replace(
+                node, labels=labels, conditions=conditions,
+                unschedulable=(op.kind == "cordon"
+                               if op.kind in ("cordon", "uncordon")
+                               else node.unschedulable)))
+        elif op.kind == "evict":
+            bound = [p for p in api.list("Pod")[0] if p.node_name]
+            if not bound:
+                self.noop += 1
+                return
+            victim = bound[op.evict_slot % len(bound)]
+            try:
+                api.delete("Pod", victim.namespace, victim.name)
+            except NotFound:
+                self.noop += 1
+                return
+        self._count(op)
+
+    # ------------------------------------------------------------- thread
+
+    def run_thread(self, stop: threading.Event,
+                   t0: Optional[float] = None) -> threading.Thread:
+        """Wall-clock driver for the bench: applies ops as they come due
+        until the schedule is exhausted or ``stop`` is set."""
+        start = time.monotonic() if t0 is None else t0
+
+        def _run():
+            while not self.done() and not stop.is_set():
+                now = time.monotonic() - start
+                self.apply_until(now)
+                if self._next < len(self.schedule):
+                    delay = self.schedule[self._next].t - (
+                        time.monotonic() - start)
+                    if delay > 0:
+                        stop.wait(min(delay, 0.05))
+
+        th = threading.Thread(target=_run, daemon=True)
+        th.start()
+        return th
+
+
+__all__ = ["ChurnConfig", "ChurnInjector", "ChurnOp", "FaultyBindApi",
+           "make_churn_schedule", "ZONES"]

@@ -230,6 +230,94 @@ def test_watch_compaction_forces_relist_and_converges():
     assert len(storm) == 60
 
 
+def _bind_transitions(api):
+    """Per-pod distinct bound nodes across the event log — the store-level
+    exactly-once audit: a pod bound twice (to anywhere) would show two
+    distinct transitions; the store refuses them, so >1 here is a real
+    double bind."""
+    nodes_by_pod = {}
+    for ev in api._log:
+        if ev.kind == "Pod" and ev.type == "MODIFIED" and ev.obj.node_name:
+            nodes_by_pod.setdefault(ev.obj.key(), set()).add(
+                ev.obj.node_name)
+    return nodes_by_pod
+
+
+def _drain_stream(sched, loop, deadline_s=60):
+    import time as _time
+    deadline = _time.monotonic() + deadline_s
+    total = {}
+    while _time.monotonic() < deadline:
+        stats = loop.step()
+        for k, v in stats.items():
+            total[k] = total.get(k, 0) + v
+        if loop.settled():
+            return total
+        sched.sync(wait=0.02)
+    raise AssertionError("stream drain did not settle")
+
+
+def test_streaming_loop_crash_midoffer_exactly_once():
+    """The ISSUE 8 streaming mirror of the scheduler-killed storm: the
+    ALWAYS-ON loop dies mid-offer with a wave in flight (popped pods
+    never harvested — exactly the state a process crash leaves). A
+    replacement scheduler + loop relists and converges: every pod bound
+    exactly once, zero double binds, zero bind errors, zero lost pods."""
+    api = ApiServerLite()
+    _cluster(api, n_pods=300)
+    sched = Scheduler(api, record_events=False)
+    sched.start()
+    loop = sched.stream(budget_s=30.0, min_quantum=64, max_quantum=64)
+    loop.step()   # wave 1 dispatched, in flight
+    loop.step()   # wave 1 harvested+bound, wave 2 in flight
+    assert loop.inflight is not None
+    bound_before = sum(1 for p in api.list("Pod")[0] if p.node_name)
+    assert 0 < bound_before < 300
+    del loop, sched  # CRASH: no close(), no flush — the in-flight wave
+    # and every queue-resident pod die with the process
+
+    cm = Chaosmonkey(lambda: None)
+    outcome = {}
+
+    def replacement():
+        s2 = Scheduler(api, record_events=False)
+        s2.start()  # relist: bound pods into cache, the rest pend
+        l2 = s2.stream(budget_s=30.0, min_quantum=64, max_quantum=64)
+        outcome.update(_drain_stream(s2, l2))
+        outcome.update(l2.close())
+
+    cm.register(Test(test=replacement, name="streaming-replacement"))
+    cm.do()
+    assert outcome["bind_errors"] == 0, outcome
+    _assert_converged(api, 300)
+    assert all(len(v) == 1 for v in _bind_transitions(api).values())
+
+
+def test_streaming_injected_bind_faults_exactly_once():
+    """Injected bind failures AND landed-but-timed-out binds on the
+    STREAMING path: the backoff requeue heals both end to end — all
+    pods bound, bind_errors counted (the faults really fired), and the
+    store-level audit shows every pod bound exactly once (the timeout
+    retries were refused, never double-applied)."""
+    from kubernetes_tpu.testing.churn import FaultyBindApi
+
+    api = ApiServerLite()
+    _cluster(api, n_pods=0)
+    faulty = FaultyBindApi(api, fail_rate=0.05, timeout_rate=0.03, seed=7)
+    sched = Scheduler(faulty, record_events=False)
+    sched.start()
+    loop = sched.stream(budget_s=30.0, min_quantum=64, max_quantum=64)
+    for i in range(300):
+        api.create("Pod", make_pod(f"pod-{i:04d}", cpu=100))
+    total = _drain_stream(sched, loop)
+    total.update(loop.close())
+    assert faulty.injected_failures > 0 and faulty.injected_timeouts > 0
+    assert total["bind_errors"] >= (faulty.injected_failures
+                                    + faulty.injected_timeouts), total
+    _assert_converged(api, 300)
+    assert all(len(v) == 1 for v in _bind_transitions(api).values())
+
+
 def test_apiserver_crash_restart_midstorm(tmp_path):
     """Durable apiserver dies mid-storm (nothing flushed beyond the WAL);
     a new process restores and a new scheduler converges — the

@@ -1,0 +1,472 @@
+"""Churn-hardened always-on engine (ISSUE 8).
+
+Pins the four robustness contracts the streaming loop gained:
+
+- LIVENESS FENCE: a blind-wave row targeting a node deleted or cordoned
+  mid-flight requeues WITH backoff instead of binding into a ghost —
+  including the flush ordering (the dying event marks the node doomed
+  BEFORE the pipeline flush harvests against the pre-event cache), and
+  cache.remove_node forgetting assumed pods on the dead node.
+
+- PROTEAN INVALIDATION: foreign binds/unbinds of plain pods — including
+  anti-affinity TARGETS — patch exactly the forbid rows they touch
+  (engine.aff_patch_rows) instead of rebuilding AffinityData wholesale
+  (engine.aff_full_rebuilds stays at zero); label-row churn on nodes
+  hosting nothing affinity-relevant patches too (label_patch_rows);
+  events the patch CANNOT absorb exactly (an affinity-carrying foreign
+  pod) still rebuild.
+
+- DEGRADED MODE: sustained fence losses drop the loop to the classic
+  synchronous round (no blind window to fence) and recover automatically
+  — hysteresis pinned at the unit level, the classic fallback pinned
+  end-to-end.
+
+- HOUSEKEEPING UNDER LOAD: backoff gc + assume-TTL expiry run on a
+  wall-clock cadence even when no round is ever empty (the saturated
+  stream), so bookkeeping cannot grow without bound.
+
+Plus the frozen churn-trace A/B: the SAME seeded churn schedule applied
+at the same step boundaries to the streaming loop and the fixed-chunk
+pipelined drain yields bit-identical placements — churn changes WHAT the
+cluster is, never what a wave means.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    make_node,
+    make_pod,
+)
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.engine.streaming import ScheduleLoop
+from kubernetes_tpu.models.hollow import load_cluster
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.testing.churn import ChurnInjector, ChurnOp
+from kubernetes_tpu.utils.trace import COUNTERS
+from tests.test_nodes import FakeClock
+
+Gi = 1 << 30
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def iso_pod(name, app="iso"):
+    p = make_pod(name, cpu=100, memory=128 << 20, labels={"app": app})
+    p.affinity = Affinity(pod_anti_affinity=PodAffinity(
+        required_terms=[PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            namespaces=[], topology_key=HOSTNAME_KEY)]))
+    return p
+
+
+def mk_nodes(n, cpu=4000):
+    return [make_node(f"n{i:02d}", cpu=cpu, memory=16 * Gi, pods=110,
+                      labels={HOSTNAME_KEY: f"n{i:02d}",
+                              "zone": "z0" if i % 2 == 0 else "z1"})
+            for i in range(n)]
+
+
+def mk_sched(nodes, now=None):
+    api = ApiServerLite()
+    load_cluster(api, nodes, [])
+    kw = {"record_events": False}
+    if now is not None:
+        kw["now"] = now
+    s = Scheduler(api, **kw)
+    s.start()
+    return api, s
+
+
+def placements(api, prefix=""):
+    return {p.name: p.node_name for p in api.list("Pod")[0]
+            if p.name.startswith(prefix)}
+
+
+# ---------------------------------------------------------- liveness fence
+
+
+def test_node_deleted_mid_wave_liveness_fence_requeues_every_row():
+    """The ISSUE 8 acceptance shape: a wave is IN FLIGHT when its target
+    node is deleted. The fence must requeue every affected row (not bind
+    into the ghost), and the pods must land on surviving nodes."""
+    api, s = mk_sched(mk_nodes(2))
+    loop = s.pipeline(chunk=64)
+    # 60 x 100m pods on 2 x 4000m nodes: the wave MUST spread over both
+    for i in range(60):
+        api.create("Pod", make_pod(f"lv-{i:03d}", cpu=100,
+                                   memory=128 << 20))
+    COUNTERS.reset()
+    loop.step()                      # dispatch in flight, nothing harvested
+    assert loop.inflight is not None
+    api.delete("Node", "", "n01")    # the node dies mid-wave
+    loop.step()                      # sync dooms n01, flushes, fences
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.liveness_fence_requeues", (0, 0))[0] > 0, snap
+    # nothing may have bound into the ghost — at any point
+    for p in api.list("Pod")[0]:
+        assert p.node_name != "n01", f"{p.name} bound into deleted n01"
+    # capacity for the requeued rows arrives; the backoff elapses; all bind
+    api.create("Node", make_node("n99", cpu=4000, memory=16 * Gi, pods=110,
+                                 labels={HOSTNAME_KEY: "n99"}))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        loop.step()
+        if loop.settled():
+            break
+        s.sync(wait=0.05)
+    loop.close()
+    where = placements(api, "lv-")
+    assert len(where) == 60 and all(where.values()), where
+    assert set(where.values()) <= {"n00", "n99"}, set(where.values())
+
+
+def test_remove_node_forgets_and_returns_assumed_pods():
+    """The cache-level audit: an assumed pod on a removed node is
+    forgotten (no phantom capacity until TTL) and handed back for
+    requeue; confirmed pods survive into the nodeless stub."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("nx", cpu=4000, memory=16 * Gi))
+    confirmed = make_pod("conf", cpu=100, node_name="nx")
+    cache.add_pod(confirmed)
+    assumed = make_pod("assumed", cpu=100)
+    assumed.node_name = "nx"
+    cache.assume_pod(assumed)
+    assert cache.is_assumed(assumed.key())
+    back = cache.remove_node("nx")
+    assert [p.name for p in back] == ["assumed"]
+    assert not cache.is_assumed(assumed.key())
+    assert cache.pod_count() == 1  # only the confirmed pod remains
+    infos = cache.node_infos()
+    assert [q.name for q in infos["nx"].pods] == ["conf"]
+
+
+def test_cordon_mid_wave_liveness_fence_requeues():
+    """Cordon (spec.unschedulable) is a dying event for the in-flight
+    wave exactly like deletion: rows targeting the cordoned node requeue
+    with backoff and bind elsewhere."""
+    api, s = mk_sched(mk_nodes(2))
+    loop = s.pipeline(chunk=64)
+    for i in range(60):
+        api.create("Pod", make_pod(f"cd-{i:03d}", cpu=100,
+                                   memory=128 << 20))
+    COUNTERS.reset()
+    loop.step()
+    assert loop.inflight is not None
+    node = api.get("Node", "", "n01")
+    import dataclasses
+    api.update("Node", dataclasses.replace(node, unschedulable=True))
+    loop.step()
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.liveness_fence_requeues", (0, 0))[0] > 0, snap
+    for p in api.list("Pod")[0]:
+        assert p.node_name != "n01", f"{p.name} bound into cordoned n01"
+    loop.close()
+
+
+# ------------------------------------------------------ Protean invalidation
+
+
+def warm_iso(api, s, loop, n=4):
+    for i in range(n):
+        api.create("Pod", iso_pod(f"warm-iso-{i}"))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        loop.step()
+        if loop.settled():
+            return
+        s.sync(wait=0.02)
+    raise AssertionError("warm drain did not settle")
+
+
+def drain_loop(s, loop, deadline_s=30):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        loop.step()
+        if loop.settled():
+            return
+        s.sync(wait=0.02)
+    raise AssertionError("drain did not settle")
+
+
+def test_foreign_plain_bind_patches_not_rebuilds():
+    """A PLAIN foreign pod labeled like an anti-affinity target binding
+    onto a node is exactly one new forbidden source: the cached encoding
+    PATCHES that row (aff_patch_rows), never rebuilds (aff_full_rebuilds
+    == 0) — and the constraint HOLDS: the next iso pod avoids the node
+    the foreign target landed on."""
+    api, s = mk_sched(mk_nodes(8))
+    loop = s.pipeline(chunk=64)
+    warm_iso(api, s, loop, n=4)
+    occupied = {p.node_name for p in api.list("Pod")[0]}
+    free = sorted(set(f"n{i:02d}" for i in range(8)) - occupied)
+    assert free
+    COUNTERS.reset()
+    # foreign bind: an already-bound pod arrives on the watch (a foreign
+    # scheduler's work) with labels MATCHING the iso anti selector
+    api.create("Pod", make_pod("foreign-tgt", cpu=100,
+                               labels={"app": "iso"},
+                               node_name=free[0]))
+    api.create("Pod", iso_pod("iso-after-foreign"))
+    drain_loop(s, loop)
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.aff_full_rebuilds", (0, 0))[0] == 0, snap
+    assert snap.get("engine.aff_patch_rows", (0, 0))[0] >= 1, snap
+    where = placements(api)
+    assert where["iso-after-foreign"], where
+    assert where["iso-after-foreign"] != free[0], \
+        (where["iso-after-foreign"], free[0])
+    assert where["iso-after-foreign"] not in occupied
+    loop.close()
+
+
+def test_foreign_unbind_patches_and_frees_the_node():
+    """The foreign target leaving decrements the patched forbid count
+    exactly — the freed node is placeable again, still without a rebuild."""
+    api, s = mk_sched(mk_nodes(6, cpu=400))  # 4 pods per node by cpu
+    loop = s.pipeline(chunk=64)
+    warm_iso(api, s, loop, n=4)
+    occupied = {p.node_name for p in api.list("Pod")[0]}
+    free = sorted(set(f"n{i:02d}" for i in range(6)) - occupied)
+    assert len(free) >= 2
+    COUNTERS.reset()
+    api.create("Pod", make_pod("foreign-tgt", cpu=100,
+                               labels={"app": "iso"}, node_name=free[0]))
+    api.create("Pod", iso_pod("iso-a"))
+    drain_loop(s, loop)
+    assert placements(api)["iso-a"] == free[1]  # only free[1] is legal
+    api.delete("Pod", "default", "foreign-tgt")  # the target leaves
+    api.create("Pod", iso_pod("iso-b"))
+    drain_loop(s, loop)
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.aff_full_rebuilds", (0, 0))[0] == 0, snap
+    assert snap.get("engine.aff_patch_rows", (0, 0))[0] >= 2, snap
+    assert placements(api)["iso-b"] == free[0]  # freed exactly
+    loop.close()
+
+
+def test_foreign_affinity_carrier_forces_rebuild():
+    """A foreign pod CARRYING anti-affinity is a potential symmetry
+    source — its own terms bake into forbid_static, which no row patch
+    can express. The encoding must rebuild, and the symmetry must hold
+    against the rebuilt arrays."""
+    api, s = mk_sched(mk_nodes(6))
+    loop = s.pipeline(chunk=64)
+    # warm with PLAIN pods labeled like a guard's target, so the
+    # encoding exists and carries the 'tgt' class
+    for i in range(3):
+        api.create("Pod", make_pod(f"warm-tgt-{i}", cpu=100,
+                                   labels={"app": "tgt"}))
+    drain_loop(s, loop)
+    COUNTERS.reset()
+    guard = iso_pod("foreign-guard", app="tgt")
+    guard.node_name = "n05"
+    api.create("Pod", guard)  # bound foreign pod WITH anti-affinity
+    api.create("Pod", make_pod("tgt-after", cpu=100,
+                               labels={"app": "tgt"}))
+    drain_loop(s, loop)
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.aff_full_rebuilds", (0, 0))[0] >= 1, snap
+    # symmetry: the new target may not land beside the foreign guard
+    assert placements(api)["tgt-after"] != "n05", placements(api)
+    loop.close()
+
+
+def test_relabel_of_unoccupied_node_patches_not_rebuilds():
+    """Label-content churn on a node hosting nothing affinity-relevant
+    re-derives just that ROW of the topology views (label_patch_rows);
+    the selector side reads the refreshed labels either way."""
+    import dataclasses
+    api, s = mk_sched(mk_nodes(8))
+    loop = s.pipeline(chunk=64)
+    # intern the zone pairs via a selector class, and build an affinity
+    # encoding via iso pods
+    sel = make_pod("warm-sel", cpu=100)
+    sel.node_selector = {"zone": "z0"}
+    api.create("Pod", sel)
+    warm_iso(api, s, loop, n=2)
+    empty = sorted(set(f"n{i:02d}" for i in range(8))
+                   - {p.node_name for p in api.list("Pod")[0]})
+    assert empty
+    COUNTERS.reset()
+    node = api.get("Node", "", empty[0])
+    api.update("Node", dataclasses.replace(
+        node, labels=dict(node.labels, zone="z1" if
+                          node.labels["zone"] == "z0" else "z0")))
+    api.create("Pod", iso_pod("iso-after-relabel"))
+    drain_loop(s, loop)
+    snap = COUNTERS.snapshot()
+    assert snap.get("engine.label_patch_rows", (0, 0))[0] >= 1, snap
+    assert snap.get("engine.aff_full_rebuilds", (0, 0))[0] == 0, snap
+    assert placements(api)["iso-after-relabel"], placements(api)
+    loop.close()
+
+
+# ------------------------------------------------------------ degraded mode
+
+
+class _FakeEngine:
+    wave_pad_floor = 0
+
+
+class _FakeSched:
+    def __init__(self):
+        self.engine = _FakeEngine()
+        self._pipeline = None
+        self.pipeline_chunk = 4096
+
+
+def test_degraded_mode_hysteresis_and_recovery():
+    """Unit contract of the churn-health model: enter only after
+    degrade_window CONSECUTIVE breached pod-ful steps (one bad wave must
+    not flap the mode), idle steps freeze the window, recovery after
+    recover_steps pod-ful classic steps."""
+    loop = ScheduleLoop(_FakeSched(), budget_s=0.2, min_quantum=64,
+                        max_quantum=256)
+    loop.degrade_window = 3
+    loop.recover_steps = 2
+
+    def stats(bound, requeues):
+        return {"bound": bound, "fence_requeued": requeues,
+                "liveness_requeued": 0, "gang_requeued": 0}
+
+    loop._note_health(stats(10, 90))
+    loop._note_health(stats(10, 90))
+    assert not loop.degraded          # 2 < window
+    loop._note_health(stats(0, 0))    # idle: freezes, does not reset...
+    loop._note_health(stats(90, 10))  # ...a healthy step DOES reset
+    loop._note_health(stats(10, 90))
+    loop._note_health(stats(10, 90))
+    assert not loop.degraded
+    loop._note_health(stats(10, 90))  # third consecutive: enter
+    assert loop.degraded
+    loop._note_health(stats(0, 0))    # idle: not a recovery step
+    assert loop.degraded
+    loop._note_health(stats(50, 0))
+    loop._note_health(stats(50, 0))   # recover_steps pod-ful steps: exit
+    assert not loop.degraded
+
+
+def test_degraded_mode_classic_round_still_binds():
+    """End-to-end: force the loop degraded and verify pods still bind
+    through the classic synchronous fallback, the step is counted, and
+    the mode recovers."""
+    api, s = mk_sched(mk_nodes(4))
+    loop = s.stream(budget_s=30.0, min_quantum=64, max_quantum=64)
+    loop.degraded = True
+    loop.recover_steps = 2
+    COUNTERS.reset()
+    for i in range(40):
+        api.create("Pod", make_pod(f"dg-{i:03d}", cpu=100))
+    total = {}
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        stats = loop.step()
+        for k, v in stats.items():
+            total[k] = total.get(k, 0) + v
+        if loop.settled():
+            break
+        s.sync(wait=0.02)
+    loop.close()
+    where = placements(api, "dg-")
+    assert len(where) == 40 and all(where.values()), where
+    assert total.get("degraded_steps", 0) >= 1, total
+    assert not loop.degraded  # recovered after the storm bound
+
+
+# -------------------------------------------------- housekeeping under load
+
+
+def test_housekeeping_runs_under_sustained_load():
+    """A saturated stream never has an empty round — backoff stamps and
+    assume-TTL expiry must still gc on the wall-clock cadence (ISSUE 8
+    satellite: the empty-round gate starved them before)."""
+    clock = FakeClock()
+    api, s = mk_sched(mk_nodes(4), now=clock)
+    loop = s.stream(budget_s=30.0, min_quantum=64, max_quantum=64)
+    loop.gc_interval_s = 0.0  # every step, regardless of load
+    # a stale backoff stamp for a pod long since bound
+    s.queue.backoff.next_delay("ghost-pod")
+    assert "ghost-pod" in s.queue.backoff._durations
+    clock.t += 1000.0  # far past 2 * MAX_BACKOFF
+    api.create("Pod", make_pod("hk-0", cpu=100))
+    stats = loop.step()  # pod-ful step: housekeeping must run anyway
+    assert stats["popped"] == 1, stats
+    assert "ghost-pod" not in s.queue.backoff._durations
+    loop.close()
+
+
+# ------------------------------------------------- frozen churn-trace A/B
+
+
+TRACE = (
+    # (arrival group size, churn ops applied BEFORE the step)
+    (37, ()),
+    (48, (ChurnOp(0.0, "kill", node="n03"),)),
+    (25, (ChurnOp(0.0, "respawn", node="n03"),
+          ChurnOp(0.0, "cordon", node="n05"),
+          ChurnOp(0.0, "evict", evict_slot=7),)),
+    (40, (ChurnOp(0.0, "uncordon", node="n05"),
+          ChurnOp(0.0, "relabel", node="n06", zone="zone-b"),
+          ChurnOp(0.0, "evict", evict_slot=3),)),
+)
+
+
+def _run_trace(streaming: bool):
+    clock = FakeClock()
+    api, s = mk_sched(mk_nodes(16), now=clock)
+    if streaming:
+        loop = s.stream(budget_s=30.0, min_quantum=64, max_quantum=64)
+    else:
+        loop = s.pipeline(chunk=64)
+    injector = ChurnInjector(api, [])
+    gi = 0
+    for group, ops in TRACE:
+        injector.schedule = list(ops)
+        injector._next = 0
+        injector.apply_until(0.0)
+        for i in range(group):
+            kind = "iso" if i % 10 == 0 else "web"
+            if kind == "iso":
+                p = iso_pod(f"tr-g{gi}-iso-{i:03d}")
+            else:
+                p = make_pod(f"tr-g{gi}-web-{i:03d}", cpu=100,
+                             memory=128 << 20)
+            api.create("Pod", p)
+        loop.step()
+        gi += 1
+    # make every backoff deterministic-ready before the final drain: the
+    # fake clock jumps past MAX_BACKOFF, so both sides promote the same
+    # deferred set in the same order
+    clock.t += 120.0
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        loop.step()
+        if loop.settled():
+            break
+        clock.t += 120.0
+        s.sync(wait=0.02)
+    assert loop.settled(), "trace drain did not settle"
+    loop.close()
+    return {p.name: p.node_name for p in api.list("Pod")[0]}
+
+
+def test_frozen_churn_trace_streaming_equals_pipelined():
+    """The ISSUE 8 A/B: the same frozen arrival + churn trace consumed by
+    the streaming loop and by the fixed-chunk pipelined drain — same
+    quantum, same step boundaries, same seeded churn ops — places every
+    surviving pod on the SAME node. Churn (node kills, cordons, relabels,
+    evictions, liveness requeues) changes what the cluster IS, never what
+    a wave means."""
+    pa = _run_trace(streaming=True)
+    pb = _run_trace(streaming=False)
+    assert set(pa) == set(pb), set(pa) ^ set(pb)
+    diff = {k: (pa[k], pb[k]) for k in pa if pa[k] != pb[k]}
+    assert not diff, diff
+    assert all(v for v in pa.values()), \
+        [k for k, v in pa.items() if not v]

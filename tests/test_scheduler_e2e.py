@@ -131,6 +131,10 @@ def test_pod_deletion_releases_capacity():
 
 
 def test_node_deletion_reflected_in_cache():
+    """Since ISSUE 8 a deleted node TOMBSTONES in place (node=None stub —
+    the snapshot flips its row to valid=False instead of restructuring
+    membership per churn event); it must never be placed on, and the
+    amortized purge reclaims the entry."""
     api = ApiServerLite()
     api.create("Node", make_node("gone"))
     api.create("Node", make_node("stays"))
@@ -138,10 +142,14 @@ def test_node_deletion_reflected_in_cache():
     sched.start()
     api.delete("Node", "", "gone")
     sched.sync()
-    assert set(sched.cache.node_infos().keys()) == {"stays"}
+    infos = sched.cache.node_infos()
+    assert set(infos.keys()) == {"gone", "stays"}
+    assert infos["gone"].node is None  # tombstone, zero capacity
     api.create("Pod", make_pod("p", cpu=100))
     assert sched.schedule_round()["bound"] == 1
-    assert api.get("Pod", "bench" if False else "default", "p").node_name == "stays"
+    assert api.get("Pod", "default", "p").node_name == "stays"
+    assert sched.cache.purge_tombstones() == 1
+    assert set(sched.cache.node_infos().keys()) == {"stays"}
 
 
 def test_foreign_scheduler_pods_ignored():

@@ -43,9 +43,28 @@ def test_pod_with_ports_also_dirties_port_bitmap():
 
 
 def test_node_spec_change_dirties_static_arrays():
+    """Change detection (ISSUE 8): a spec change dirties exactly the
+    arrays whose values moved; re-setting an identical spec (the respawn
+    /flap-heavy churn shape) dirties NO static array — a dirty mark per
+    fault event re-uploaded megabytes and invalidated the cached wave
+    precompute once per kill, which measured as the churn collapse."""
+    import dataclasses
     rng, nodes, infos, snap = build()
     snap.dirty.clear()
-    infos[nodes[2].name].set_node(nodes[2])
+    infos[nodes[2].name].set_node(nodes[2])  # identical values
+    snap.refresh(infos)
+    assert not (snap.dirty & set(snap.STATIC)), snap.dirty
+    snap.dirty.clear()
+    node = nodes[2]
+    changed = dataclasses.replace(
+        node, labels=dict(node.labels, zone="zz-new"),
+        allocatable=dataclasses.replace(node.allocatable,
+                                        milli_cpu=node.allocatable.milli_cpu
+                                        + 1000))
+    # intern the new pair so the label ROW actually changes content
+    snap.ensure_label_pair("zone", "zz-new")
+    snap.finalize_labels()
+    infos[node.name].set_node(changed)
     snap.refresh(infos)
     assert "labels" in snap.dirty and "alloc" in snap.dirty
 
