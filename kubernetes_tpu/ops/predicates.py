@@ -117,15 +117,24 @@ _SLOT_AXES = {
 }
 
 
-def pod_arrays_bucketed(batch) -> Arrays:
+def pod_arrays_bucketed(batch, rows: int = 0) -> Arrays:
     """pod_arrays with the selector-term / any-group / preferred-term axes
     padded up to power-of-2 buckets. PodBatch sizes those axes to the batch's
     actual usage, so [1,N] single-pod evaluations (the extender fast lane)
     would otherwise compile one kernel variant per distinct term count;
     bucketing bounds the variants at log2(slot caps) like every other batch
-    axis (bucket())."""
+    axis (bucket()).
+
+    ``rows`` > 0 additionally pads the CLASS axis to that many rows (the
+    coalesced multi-class extender eval, ISSUE 9): padding rows are
+    `impossible` — they fit nothing and score nothing — exactly the
+    pod_arrays_padded contract, so a batch of B distinct classes compiles
+    one kernel per bucket(B), not one per B."""
     import numpy as _np
     arrs = _pod_arrays_np(batch)
+    c = len(batch)
+    if rows and rows < c:
+        raise ValueError(f"rows {rows} < batch size {c}")
     dims = {"T": bucket(arrs["sel_req_all"].shape[1], lo=1),
             "A": bucket(arrs["sel_req_any"].shape[2], lo=1),
             "TP": bucket(arrs["pref_req_all"].shape[1], lo=1)}
@@ -142,6 +151,11 @@ def pod_arrays_bucketed(batch) -> Arrays:
                     grow = True
             if grow:
                 a = _np.pad(a, widths)
+        if rows and rows > c:
+            pad = _np.zeros((rows - c,) + a.shape[1:], dtype=a.dtype)
+            if k == "impossible":
+                pad[:] = True
+            a = _np.concatenate([a, pad], axis=0)
         out[k] = jnp.asarray(a)
     return out
 

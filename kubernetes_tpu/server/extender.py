@@ -20,17 +20,50 @@ nodeCacheCapable mode (extender.go:113-124): only candidate node NAMES cross
 the wire; the sidecar keeps full node/pod state in its own cache, synced via
 the bulk endpoints POST /cache/nodes and /cache/pods (the "snapshot POSTs"
 variant of SURVEY.md §7 step 3) and updated optimistically by bind calls.
+
+Multi-frontend service (ISSUE 9) — the same verbs, hardened for a FLEET of
+concurrent schedulers sharing one sidecar:
+
+  - COALESCED DISPATCH: concurrent /filter + /prioritize evaluations ride
+    a micro-batch window (server/coalescer.py) into ONE fused [C, N]
+    kernel dispatch over the shared device-resident snapshot.
+  - OPTIMISTIC CONCURRENCY (PAPERS.md §Omega): verdicts carry a
+    "SnapshotGen"; each frontend evaluates against a possibly-stale
+    snapshot (bounded by ``stale_window_s``) and /bind commits through a
+    FENCE that re-validates capacity/ports/liveness/topology against
+    current cache truth, answering a typed HTTP 409 CONFLICT (body carries
+    "RetryAfterMs") the client retries with jittered backoff.
+  - EXACTLY-ONCE BINDS: /bind accepts an "IdempotencyKey"; a timed-out-
+    but-landed bind replays safely through the BindLedger (state/cache.py)
+    — the retry converges on the recorded node instead of double-booking.
+  - BACKPRESSURE: bounded coalescer queue + per-verb in-flight cap answer
+    HTTP 429 + Retry-After past the dispatch budget; a request whose
+    client deadline ("DeadlineMs") elapsed while queued is shed (504).
+
+Optional request fields (ignored by a stock kube-scheduler, used by our
+multi-frontend clients): /filter {"Compact": true} elides the echo of an
+all-passed candidate list; /prioritize {"TopK": k} returns only the k
+top-scored hosts (still a valid HostPriorityList); /bind {"SnapshotGen",
+"IdempotencyKey", "DeadlineMs", "Pod": <spec>} — shipping the spec lets
+the fence do exact capacity math instead of the identifiers-only wire's
+zero-resource assume.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Protocol, Tuple
 
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.server.coalescer import (
+    DeadlineExceeded,
+    EvalCoalescer,
+    Overloaded,
+)
 
 
 class ExtenderBackend(Protocol):
@@ -52,15 +85,34 @@ class ExtenderBackend(Protocol):
     def metrics_text(self) -> str: ...
 
 
+class _FleetHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer tuned for a fleet of keep-alive frontends
+    (ISSUE 9 satellite): the stock accept backlog of 5 refuses connections
+    the moment ~100 clients dial in together, and a non-daemon handler
+    thread wedged on a dead client would block shutdown."""
+
+    request_queue_size = 256
+    daemon_threads = True
+
+
 class ExtenderHTTPServer:
     def __init__(self, backend: ExtenderBackend, host: str = "127.0.0.1",
-                 port: int = 0, prefix: str = ""):
+                 port: int = 0, prefix: str = "", max_inflight: int = 256):
         self.backend = backend
         self.prefix = prefix.rstrip("/")
+        # per-verb in-flight admission (the HTTP half of the backpressure
+        # story; the coalescer bounds its own queue below this)
+        self.max_inflight = max_inflight
+        self._inflight = 0
+        self._adm_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # a dead client's half-open socket must not pin a handler
+            # thread forever (daemon_threads bounds shutdown, this bounds
+            # the thread count)
+            timeout = 120
 
             def log_message(self, *a):  # quiet
                 pass
@@ -69,19 +121,25 @@ class ExtenderHTTPServer:
                 length = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(length) if length else b""
 
-            def _read_json(self):
-                return json.loads(self._read_raw() or b"{}")
-
-            def _write_json(self, obj, code: int = 200):
+            def _write_json(self, obj, code: int = 200, headers=None):
                 # compact separators: a 5k-node HostPriorityList is ~230KB
                 # of response; the default ", " padding costs measurable
                 # serialize+wire time at compat-mode request rates
                 body = json.dumps(obj, separators=(",", ":")).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client gave up (its timeout elapsed) — a fleet
+                    # norm, not a server error: drop the socket quietly
+                    # instead of letting ThreadingHTTPServer print a
+                    # traceback per dead peer
+                    self.close_connection = True
 
             def do_GET(self):
                 if self.path == "/healthz":
@@ -104,6 +162,11 @@ class ExtenderHTTPServer:
                 path = self.path
                 if outer.prefix and path.startswith(outer.prefix):
                     path = path[len(outer.prefix):]
+                # read the body FIRST, unconditionally: on a keep-alive
+                # connection an unread body (unknown path, early error)
+                # would desync every later request on the socket — the
+                # head-of-line audit of the ISSUE 9 satellite
+                raw = self._read_raw()
                 try:
                     if path in ("/cache/nodes", "/cache/pods"):
                         # bulk sync: binary fast path (protobuf, SURVEY
@@ -111,7 +174,6 @@ class ExtenderHTTPServer:
                         # the JSON contract, picked by Content-Type
                         from kubernetes_tpu.api import protowire
                         ctype = self.headers.get("Content-Type", "")
-                        raw = self._read_raw()
                         is_nodes = path == "/cache/nodes"
                         if ctype == protowire.CONTENT_TYPE:
                             if not protowire.available():
@@ -135,21 +197,59 @@ class ExtenderHTTPServer:
                             outer.backend.sync_pods(items)
                         self._write_json({"synced": len(items)})
                         return
-                    payload = self._read_json()
-                    if path == "/filter":
-                        self._write_json(outer.handle_filter(payload))
-                    elif path == "/prioritize":
-                        self._write_json(outer.handle_prioritize(payload))
-                    elif path == "/bind":
-                        self._write_json(outer.handle_bind(payload))
-                    else:
-                        self._write_json({"error": f"unknown path {self.path}"}, 404)
+                    if path not in ("/filter", "/prioritize", "/bind"):
+                        self._write_json(
+                            {"error": f"unknown path {self.path}"}, 404)
+                        return
+                    if not outer._admit():
+                        # jittered Retry-After: a fleet shed together must
+                        # not return together (thundering-herd starvation
+                        # of the same unlucky clients every window)
+                        self._write_json(
+                            {"Error": "overloaded",
+                             "RetryAfterMs": random.randint(10, 80)},
+                            429, headers={"Retry-After": "1"})
+                        return
+                    try:
+                        payload = json.loads(raw or b"{}")
+                        if path == "/filter":
+                            out, code = outer.handle_filter(payload), 200
+                        elif path == "/prioritize":
+                            out, code = outer.handle_prioritize(payload), 200
+                        else:
+                            out, code = outer.handle_bind(payload)
+                        self._write_json(out, code)
+                    finally:
+                        outer._release()
+                except Overloaded as e:
+                    self._write_json(
+                        {"Error": "overloaded",
+                         "RetryAfterMs": int(e.retry_after_s * 1e3)},
+                        429, headers={"Retry-After": "1"})
+                except DeadlineExceeded:
+                    self._write_json({"Error": "DEADLINE_EXCEEDED"}, 504)
                 except Exception as e:  # wire errors surface in-band, like the
                     # reference's ExtenderFilterResult.Error (types.go:177)
                     self._write_json({"Error": f"{type(e).__name__}: {e}"}, 500)
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd = _FleetHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- admission gate
+
+    def _admit(self) -> bool:
+        with self._adm_lock:
+            if self._inflight >= self.max_inflight:
+                count = getattr(self.backend, "_count", None)
+                if count is not None:
+                    count("admission_shed")
+                return False
+            self._inflight += 1
+            return True
+
+    def _release(self) -> None:
+        with self._adm_lock:
+            self._inflight -= 1
 
     # -------------------------------------------------------------- handlers
 
@@ -173,9 +273,30 @@ class ExtenderHTTPServer:
         names = self._get(payload, "NodeNames", "nodenames", "nodeNames")
         return pod, nodes, names
 
+    @staticmethod
+    def _deadline_of(payload: Dict) -> Optional[float]:
+        ms = payload.get("DeadlineMs")
+        return float(ms) / 1e3 if ms else None
+
     def handle_filter(self, payload: Dict) -> Dict:
         pod, nodes, names = self._parse_args(payload)
-        passed, failed = self.backend.filter(pod, nodes, names)
+        fv = getattr(self.backend, "filter_verdict", None)
+        fused = getattr(self.backend, "fused_verdict", None)
+        top_k = int(payload.get("TopK") or 0)
+        gen = None
+        top = None
+        if fv is None or nodes is not None:
+            passed, failed = self.backend.filter(pod, nodes, names)
+        elif top_k and fused is not None:
+            # fused verbs on ONE window ticket: the response carries the
+            # top-k scores of the same verdict, so a fleet scheduleOne
+            # skips the /prioritize round trip entirely
+            passed, failed, top, gen = fused(
+                pod, names, deadline_s=self._deadline_of(payload),
+                top_k=top_k)
+        else:
+            passed, failed, gen = fv(
+                pod, names, deadline_s=self._deadline_of(payload))
         if nodes is not None:
             by_name = {n.name: n for n in nodes}
             return {
@@ -184,20 +305,65 @@ class ExtenderHTTPServer:
                 "FailedNodes": failed,
                 "Error": "",
             }
-        return {"NodeNames": passed, "FailedNodes": failed, "Error": ""}
+        out = {"NodeNames": passed, "FailedNodes": failed, "Error": ""}
+        if gen is not None:
+            out["SnapshotGen"] = gen
+        if top is not None:
+            out["TopScores"] = [{"Host": h, "Score": int(s)}
+                                for h, s in top]
+        if payload.get("Compact") and not failed and names is None:
+            # multi-frontend compact mode: the echo of an all-passed 5k-
+            # name candidate list costs more wire time than the verdict —
+            # "everything passed" is one bit + a count
+            out["NodeNames"] = None
+            out["AllPassed"] = True
+            out["PassedCount"] = len(passed)
+        return out
 
     def handle_prioritize(self, payload: Dict) -> List[Dict]:
         pod, nodes, names = self._parse_args(payload)
-        scores = self.backend.prioritize(pod, nodes, names)
+        top_k = int(payload.get("TopK") or 0)
+        pv = getattr(self.backend, "prioritize_verdict", None)
+        if pv is None or nodes is not None:
+            scores = self.backend.prioritize(pod, nodes, names)
+        else:
+            # TopK resolves server-side, vectorized (prioritize_verdict):
+            # truncation stays a valid HostPriorityList; our frontends
+            # pick among the max-score entries, so shipping the tail is
+            # pure wire cost (PAPERS.md §Sparrow: sample, don't census)
+            scores, _gen = pv(
+                pod, names, deadline_s=self._deadline_of(payload),
+                top_k=top_k if names is None else 0)
+        if top_k and len(scores) > top_k:
+            import heapq
+            scores = heapq.nlargest(top_k, scores, key=lambda e: e[1])
         return [{"Host": h, "Score": int(s)} for h, s in scores]
 
-    def handle_bind(self, payload: Dict) -> Dict:
-        err = self.backend.bind(
-            self._get(payload, "PodName", "podName") or "",
-            self._get(payload, "PodNamespace", "podNamespace") or "",
-            str(self._get(payload, "PodUID", "podUID") or ""),
-            self._get(payload, "Node", "node") or "")
-        return {"Error": err}
+    def handle_bind(self, payload: Dict) -> Tuple[Dict, int]:
+        pod_name = self._get(payload, "PodName", "podName") or ""
+        pod_ns = self._get(payload, "PodNamespace", "podNamespace") or ""
+        pod_uid = str(self._get(payload, "PodUID", "podUID") or "")
+        node = self._get(payload, "Node", "node") or ""
+        bv = getattr(self.backend, "bind_verdict", None)
+        if bv is None:
+            return {"Error": self.backend.bind(
+                pod_name, pod_ns, pod_uid, node)}, 200
+        spec_obj = self._get(payload, "Pod", "pod")
+        spec = serde.decode_pod(spec_obj) if spec_obj else None
+        gen = payload.get("SnapshotGen")
+        err, kind, retry_after_s = bv(
+            pod_name, pod_ns, pod_uid, node,
+            snapshot_gen=int(gen) if gen is not None else None,
+            idem_key=payload.get("IdempotencyKey") or None,
+            deadline_s=self._deadline_of(payload), pod_spec=spec)
+        out: Dict = {"Error": err}
+        if kind in ("conflict", "pending"):
+            out["Conflict"] = True
+            out["RetryAfterMs"] = max(int(retry_after_s * 1e3), 1)
+            return out, 409
+        if kind == "shed":
+            return out, 504
+        return out, 200
 
     # ------------------------------------------------------------- lifecycle
 
@@ -214,6 +380,21 @@ class ExtenderHTTPServer:
         self.httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
+
+
+class _Verdict:
+    """One pod's evaluation against the shared snapshot, captured with the
+    node order / index / generation of the SAME critical section — so the
+    HTTP response builds outside every lock without torn state."""
+
+    __slots__ = ("m", "s", "names", "idx", "gen")
+
+    def __init__(self, m, s, names, idx, gen):
+        self.m = m
+        self.s = s
+        self.names = names
+        self.idx = idx
+        self.gen = gen
 
 
 class TPUExtenderBackend:
@@ -246,10 +427,12 @@ class TPUExtenderBackend:
     dirty-only host->HBM sync), so a bind re-uploads three small dynamic
     arrays, not the 40MB+ snapshot."""
 
-    def __init__(self, binder=None):
+    def __init__(self, binder=None, stale_window_s: float = 0.0,
+                 coalesce_window_s: float = 0.0, coalesce_max_batch: int = 64,
+                 coalesce_max_depth: int = 512):
         # jax-dependent imports are local so the wire layer stays importable
         # without a TPU runtime
-        from kubernetes_tpu.state.cache import SchedulerCache
+        from kubernetes_tpu.state.cache import BindLedger, SchedulerCache
         from kubernetes_tpu.engine.scheduler_engine import (
             EvalCache,
             SchedulingEngine,
@@ -278,6 +461,35 @@ class TPUExtenderBackend:
         self._assumed_bare: Dict[str, Pod] = {}
         self._last_cleanup = 0.0
         self.eval_cache.cluster_aff_free = True
+        # ---- multi-frontend service state (ISSUE 9) ----
+        # Omega-style bounded staleness: within this window, bind-hinted
+        # snapshot refreshes are DEFERRED, so verdicts serve from the memo
+        # while commits advance — the bind fence re-validates every commit
+        # against live cache truth, so staleness costs conflicts (reported),
+        # never correctness. 0.0 = always fresh (the PR 1-8 behavior).
+        self.stale_window_s = stale_window_s
+        self._last_refresh = 0.0
+        # commit_gen: bumped per committed mutation (bind assume/rollback,
+        # bulk sync). _snap_gen: the commit_gen the snapshot reflects —
+        # what verdicts report as "SnapshotGen"; a /bind whose verdict gen
+        # equals the CURRENT commit_gen provably re-validated nothing away
+        # and may skip the fence.
+        self.commit_gen = 0
+        self._snap_gen = 0
+        self.ledger = BindLedger()
+        # service counters: own lock, so /metrics scrapes and coalescer
+        # increments never contend with (or tear against) the eval lock —
+        # the ISSUE 9 torn-read audit
+        self._counters_lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._rng = random.Random(0xB19D)
+        self.coalescer = EvalCoalescer(self, window_s=coalesce_window_s,
+                                       max_batch=coalesce_max_batch,
+                                       max_depth=coalesce_max_depth)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._counters_lock:
+            self._counters[name] = self._counters.get(name, 0) + n
 
     # -- cache sync ---------------------------------------------------------
 
@@ -301,11 +513,16 @@ class TPUExtenderBackend:
             for k in expired:
                 self._assumed_bare.pop(k, None)
             self._state_dirty = True  # released capacity: full re-walk
+            # cache truth moved like any other mutation: a verdict issued
+            # before the expiry must NOT satisfy the fence-skip gen check
+            # against the post-expiry state
+            self.commit_gen += 1
 
     def sync_nodes(self, nodes: List[Node]) -> None:
         with self._lock:
             self.eval_cache.on_sync()
             self._state_dirty = True
+            self.commit_gen += 1
             self._bind_hint.clear()
             self._maybe_cleanup_assumed()
             seen = set()
@@ -328,6 +545,7 @@ class TPUExtenderBackend:
         with self._lock:
             self.eval_cache.on_sync()
             self._state_dirty = True
+            self.commit_gen += 1
             self._bind_hint.clear()
             self._maybe_cleanup_assumed()
             seen = set()
@@ -362,8 +580,18 @@ class TPUExtenderBackend:
     def _refresh_warm(self):
         """Bring the persistent snapshot up to date with the cache, paying
         only for what actually moved (class docstring). Returns the live
-        infos view."""
-        from kubernetes_tpu.utils.trace import timed_span
+        infos view.
+
+        Bounded staleness (ISSUE 9, PAPERS.md §Omega): when a stale_window
+        is configured, BIND-hinted refreshes are deferred inside it —
+        verdicts keep serving from the current snapshot version (memo
+        hits, zero device work) while commits advance, and the bind fence
+        re-validates every commit against live cache truth. Sync-driven
+        dirtiness always refreshes immediately: membership/spec changes
+        are not a staleness the fence is allowed to absorb."""
+        import time as _time
+
+        from kubernetes_tpu.utils.trace import COUNTERS, timed_span
         snap = self.engine.snapshot
         self._maybe_cleanup_assumed()  # time-gated; a bind-only deployment
         # (no syncs ever) must still expire unconfirmed assumptions
@@ -373,11 +601,20 @@ class TPUExtenderBackend:
                 snap.refresh(self._infos)
             self._state_dirty = False
             self._bind_hint.clear()
+            self._snap_gen = self.commit_gen
+            self._last_refresh = _time.monotonic()
         elif self._bind_hint:
+            if self.stale_window_s > 0 and (
+                    _time.monotonic() - self._last_refresh
+                    < self.stale_window_s):
+                COUNTERS.inc("extender.stale_served")
+                return self._infos
             with timed_span("extender.refresh_hint"):
                 hint = tuple(self._bind_hint)
                 self._bind_hint.clear()
                 snap.refresh(self._infos, changed_hint=hint)
+            self._snap_gen = self.commit_gen
+            self._last_refresh = _time.monotonic()
         return self._infos
 
     def _port_words_for(self, pod: Pod) -> int:
@@ -425,16 +662,42 @@ class TPUExtenderBackend:
 
     FAIL_REASON = "node(s) didn't satisfy TPU predicate kernel"
 
-    def filter(self, pod, nodes, node_names):
-        # response building runs OUTSIDE the lock: names/index/m are
-        # captured references (a refresh REPLACES the list/dict objects,
-        # never mutates them in place), so concurrent compat drivers only
-        # serialize on the evaluation itself
+    # ---- coalescer seams (ISSUE 9): the leader evaluates whole batches
+    # under ONE lock acquisition; verdict objects capture names/index/gen
+    # from the same critical section so responses build outside it -------
+
+    def _eval_many(self, pods):
+        """Leader-side batch evaluation: one fused [C, N] dispatch for the
+        batch's unique classes (engine.evaluate_pods_batch). Returns one
+        _Verdict per pod, in order."""
+        from kubernetes_tpu.engine.scheduler_engine import evaluate_pods_batch
         with self._lock:
-            snap, m, _ = self._eval(pod, nodes)
+            infos = self._refresh_warm()
+            snap = self.engine.snapshot
+            port_words = max(self._port_words_for(p) for p in pods)
+            provider = (lambda: self.engine._nodes_on_device(
+                port_words=port_words))
+            outs = evaluate_pods_batch(
+                pods, infos, snap, self.engine.priorities,
+                workloads=self.engine.workloads_provider(),
+                hard_weight=self.engine.hard_pod_affinity_weight,
+                volume_ctx=self.engine.volume_ctx,
+                eval_cache=self.eval_cache, device_nodes_provider=provider)
             names = snap.node_names
             idx = snap.node_index
-        if node_names is None and nodes is None:
+            gen = self._snap_gen
+        return [_Verdict(m, s, names, idx, gen) for (m, s) in outs]
+
+    def _eval_one(self, pod):
+        """Degraded per-request fallback (coalescer fault path)."""
+        with self._lock:
+            snap, m, s = self._eval(pod, None)
+            return _Verdict(m, s, snap.node_names, snap.node_index,
+                            self._snap_gen)
+
+    def _split_passed(self, m, names, idx, node_names):
+        """Shared /filter response split (verdict mask -> passed/failed)."""
+        if node_names is None:
             # whole-cluster candidate set: vectorized split instead of
             # a per-name dict-lookup loop over N nodes
             import numpy as np
@@ -445,10 +708,8 @@ class TPUExtenderBackend:
             failed = {names[i]: self.FAIL_REASON
                       for i in np.nonzero(~mask)[0]}
             return passed, failed
-        candidates = node_names if node_names is not None else \
-            [n.name for n in nodes]
         passed, failed = [], {}
-        for nm in candidates:
+        for nm in node_names:
             i = idx.get(nm, -1)
             if i >= 0 and m[i]:
                 passed.append(nm)
@@ -456,35 +717,250 @@ class TPUExtenderBackend:
                 failed[nm] = self.FAIL_REASON
         return passed, failed
 
+    def filter_verdict(self, pod, node_names=None, deadline_s=None):
+        """/filter through the coalescing window: (passed, failed, gen)."""
+        v = self.coalescer.submit(pod, deadline_s)
+        passed, failed = self._split_passed(v.m, v.names, v.idx, node_names)
+        return passed, failed, v.gen
+
+    @staticmethod
+    def _top_scores(v: "_Verdict", top_k: int):
+        """Vectorized top-k (host, score) over a verdict's FITTING nodes —
+        argpartition, not a 5k-tuple Python sort (at fleet request rates
+        the marshalling would cost more than the evaluation)."""
+        import numpy as np
+        n = len(v.names)
+        if not (top_k and n):
+            return []
+        s = np.where(np.asarray(v.m[:n]), np.asarray(v.s[:n]),
+                     np.iinfo(np.int64).min)
+        k = min(int(top_k), n)
+        part = np.argpartition(s, n - k)[n - k:]
+        order = part[np.argsort(-s[part], kind="stable")]
+        sl = s[order].tolist()
+        return [(v.names[i], sl[j])
+                for j, i in enumerate(order.tolist())
+                if sl[j] != np.iinfo(np.int64).min]
+
+    def fused_verdict(self, pod, node_names=None, deadline_s=None,
+                      top_k: int = 0):
+        """ONE coalescer submit answering both verbs (the wire mirror of
+        the PR 1 fused-verb memo): (passed, failed, top_scores, gen).
+        A fleet scheduleOne becomes two round trips (filter+, bind)
+        instead of three, and one window ticket instead of two.
+        top_scores honors the caller's candidate restriction: a fused
+        verdict must never steer a frontend to a node its own scheduler
+        already excluded."""
+        v = self.coalescer.submit(pod, deadline_s)
+        passed, failed = self._split_passed(v.m, v.names, v.idx, node_names)
+        if node_names is None:
+            top = self._top_scores(v, top_k)
+        else:
+            # restricted candidate set: rank only the PASSED subset
+            sl = [(nm, int(v.s[v.idx[nm]])) for nm in passed]
+            sl.sort(key=lambda e: -e[1])
+            top = sl[:max(int(top_k), 0)]
+        return passed, failed, top, v.gen
+
+    def prioritize_verdict(self, pod, node_names=None, deadline_s=None,
+                           top_k: int = 0):
+        """/prioritize through the coalescing window: (scores, gen).
+        ``top_k`` > 0 returns only the k top-scored hosts, selected
+        VECTORIZED (argpartition over the score row) — at fleet request
+        rates, materializing 5k (host, score) Python tuples per request
+        just to pick a winner costs more than the evaluation did."""
+        v = self.coalescer.submit(pod, deadline_s)
+        if top_k and node_names is None:
+            # whole-cluster TopK masks to FITTING nodes (the verbs are
+            # fused on one verdict; a top score on a failed node would
+            # send the frontend into a guaranteed fence conflict)
+            return self._top_scores(v, top_k), v.gen
+        sl = v.s.tolist()  # one bulk convert beats N np-scalar __int__s
+        if node_names is None:
+            return list(zip(v.names, sl[:len(v.names)])), v.gen
+        idx = v.idx
+        return [(nm, sl[idx[nm]]) for nm in node_names if nm in idx], v.gen
+
+    def filter(self, pod, nodes, node_names):
+        if nodes is not None:
+            # non-cache-capable args-mode: full state ships per request —
+            # nothing to coalesce against, evaluate directly
+            with self._lock:
+                snap, m, _ = self._eval(pod, nodes)
+                names = snap.node_names
+                idx = snap.node_index
+            cand = node_names if node_names is not None \
+                else [n.name for n in nodes]
+            return self._split_passed(m, names, idx, cand)
+        passed, failed, _gen = self.filter_verdict(pod, node_names)
+        return passed, failed
+
     def prioritize(self, pod, nodes, node_names):
-        with self._lock:
-            snap, _, s = self._eval(pod, nodes)
-            names = snap.node_names
-            idx = snap.node_index
-        sl = s.tolist()  # one bulk convert beats N np-scalar __int__s
-        if node_names is None and nodes is None:
-            return list(zip(names, sl[:len(names)]))
-        candidates = node_names if node_names is not None else \
-            [n.name for n in nodes]
-        return [(nm, sl[idx[nm]]) for nm in candidates if nm in idx]
+        if nodes is not None:
+            with self._lock:
+                snap, _, s = self._eval(pod, nodes)
+                names = snap.node_names
+                idx = snap.node_index
+            sl = s.tolist()
+            cand = node_names if node_names is not None \
+                else [n.name for n in nodes]
+            return [(nm, sl[idx[nm]]) for nm in cand if nm in idx]
+        scores, _gen = self.prioritize_verdict(pod, node_names)
+        return scores
+
+    def _bind_fence(self, pod: Pod, node: str) -> Optional[str]:
+        """Single-commit mirror of the engine's harvest fence (ISSUE 9):
+        re-validate capacity / pod count / host ports / liveness — and,
+        when affinity is in play, the full topology verdict via a FRESH
+        evaluation — for one (pod, node) commit against CURRENT cache
+        truth. This is the Omega transaction re-validator at the wire:
+        verdicts may be stale (stale_window_s), commits never are. Called
+        with the lock held, BEFORE the assume. Returns the typed conflict
+        reason, or None to admit."""
+        from kubernetes_tpu.ops import oracle
+        from kubernetes_tpu.ops.affinity import _has_affinity
+        infos = self._infos if self._infos is not None \
+            else self.cache.node_infos()
+        info = infos.get(node)
+        if info is None:
+            return f"node {node} unknown"
+        if info.node is None:
+            return f"node {node} gone"
+        if info.node.unschedulable:
+            return f"node {node} cordoned"
+        if not oracle.check_node_condition(info.node):
+            return f"node {node} not ready"
+        # NodeInfo.requested includes every assume committed so far —
+        # exactly the occupancy the harvest fence's prefix math re-checks
+        ok, fails = oracle.pod_fits_resources(pod, info)
+        if not ok:
+            return f"insufficient capacity on {node}: {','.join(fails)}"
+        if not oracle.pod_fits_host_ports(pod, info):
+            return f"host port conflict on {node}"
+        if _has_affinity(pod) or not self.eval_cache.cluster_aff_free:
+            # topology mirror: an affinity verdict can be invalidated by
+            # ANY foreign commit — force the deferred hint refresh past
+            # the staleness window and re-check the chosen node against
+            # the fresh evaluation
+            self._last_refresh = 0.0
+            snap, m, _s = self._eval(pod, None)
+            i = snap.node_index.get(node, -1)
+            if i < 0 or not m[i]:
+                return f"topology re-validation failed on {node}"
+        return None
 
     def bind(self, pod_name, pod_namespace, pod_uid, node):
-        # NOTE on affinity: the /bind wire carries identifiers only
-        # (ExtenderBindingArgs), so a freshly bound pod's SPEC — including
-        # any pod (anti-)affinity — is unknown here and stays unknown
-        # until the bulk cache sync ships the real object. cluster_aff_free
-        # therefore changes only at sync boundaries (sync_pods recount):
-        # between bind and sync, NO evaluation path (fast lane or oracle)
-        # can see the unknown affinity, so the fast lane is exactly as
-        # informed as the slow one.
+        """Legacy single-scheduler wire shape: error string, "" = bound."""
+        err, _kind, _retry = self.bind_verdict(pod_name, pod_namespace,
+                                               pod_uid, node)
+        return err
+
+    def bind_verdict(self, pod_name, pod_namespace, pod_uid, node,
+                     snapshot_gen: Optional[int] = None,
+                     idem_key: Optional[str] = None,
+                     deadline_s: Optional[float] = None,
+                     pod_spec: Optional[Pod] = None):
+        """The multi-frontend /bind commit (ISSUE 9). Returns
+        (error, kind, retry_after_s) with kind in:
+
+          ok       — committed (or a replayed success);
+          conflict — the fence refused; RETRYABLE: re-run scheduleOne
+                     against a fresh verdict after the jittered backoff;
+          pending  — a twin with the same idempotency key is in flight;
+                     retryable exactly like a conflict;
+          shed     — the request outlived its own deadline; nothing
+                     happened (a same-key retry starts fresh);
+          error    — the downstream apiserver write failed; AMBIGUOUS
+                     (may have landed) — retry with the SAME key and the
+                     ledger replays it to exactly-once.
+
+        NOTE on affinity: the /bind wire carries identifiers only
+        (ExtenderBindingArgs), so without a shipped "Pod" spec a freshly
+        bound pod's affinity stays unknown until the bulk cache sync —
+        cluster_aff_free changes only at sync boundaries, so no evaluation
+        path can see the unknown affinity (fast lane == oracle)."""
         import dataclasses
+        import time as _time
+        t0 = _time.monotonic()
         key = f"{pod_namespace}/{pod_name}"
+        replaying = False
+        replay_err = ""
+        if idem_key:
+            verdict, lnode, lerr = self.ledger.begin(idem_key, node)
+            if verdict == "done":
+                # completed attempt: answer from the record — no second
+                # assume, no second apiserver write (exactly-once)
+                self._count("bind_replays")
+                kind = "conflict" if lerr.startswith("CONFLICT") else \
+                    ("ok" if not lerr else "error")
+                return lerr, kind, self._retry_jitter()
+            if verdict == "pending":
+                self._count("bind_replays")
+                return ("CONFLICT: bind attempt in flight", "pending",
+                        self._retry_jitter())
+            if verdict == "replay":
+                # ambiguous prior attempt: converge on ITS node choice
+                # (BindLedger docstring), never a fresh one
+                self._count("bind_replays")
+                node = lnode
+                replaying = True
+                replay_err = lerr
+        try:
+            return self._bind_attempt(key, pod_name, pod_namespace,
+                                      pod_uid, node, snapshot_gen,
+                                      idem_key, deadline_s, pod_spec, t0,
+                                      replaying, replay_err)
+        except BaseException:
+            # an unexpected escape (device error in the fence's re-eval,
+            # cache invariant trip) must not pin a PENDING ledger entry —
+            # that would answer every same-key retry "in flight" forever
+            if idem_key:
+                if replaying:
+                    self.ledger.finish(idem_key, "uncertain", replay_err)
+                else:
+                    self.ledger.abandon(idem_key)
+            raise
+
+    def _bind_attempt(self, key, pod_name, pod_namespace, pod_uid, node,
+                      snapshot_gen, idem_key, deadline_s, pod_spec, t0,
+                      replaying, replay_err):
+        """The fence + assume + downstream-write body of bind_verdict,
+        after the ledger prologue resolved what to attempt."""
+        import dataclasses
+        import time as _time
         assumed_now = False
         with self._lock:
-            pod = self._known_pods.get(key)
-            if pod is None:
-                pod = Pod(name=pod_name, namespace=pod_namespace, uid=pod_uid)
-            pod = dataclasses.replace(pod, node_name=node)
+            if deadline_s is not None \
+                    and _time.monotonic() - t0 > deadline_s:
+                self._count("deadline_shed")
+                if idem_key:
+                    if replaying:  # restore the ambiguity record
+                        self.ledger.finish(idem_key, "uncertain", replay_err)
+                    else:
+                        self.ledger.abandon(idem_key)
+                return "DEADLINE_EXCEEDED", "shed", 0.0
+            base = self._known_pods.get(key)
+            if base is None and pod_spec is not None:
+                base = pod_spec  # wire-shipped spec: exact fence math +
+                # resource-true assume instead of the zero-resource bare
+            if base is None:
+                base = Pod(name=pod_name, namespace=pod_namespace,
+                           uid=pod_uid)
+            # FENCE (optimistic concurrency): skip only when the verdict's
+            # generation is provably current — nothing was committed since
+            # the snapshot it read, so its own /filter pass IS the fence
+            if snapshot_gen is None or snapshot_gen != self.commit_gen:
+                self._refresh_warm()  # liveness truth for _infos
+                reason = self._bind_fence(base, node)
+                if reason is not None:
+                    self._count("bind_conflicts")
+                    err = f"CONFLICT: {reason}"
+                    if idem_key:
+                        self.ledger.finish(idem_key, "conflict", err)
+                    return err, "conflict", self._retry_jitter()
+            else:
+                self._count("bind_fence_skipped")
+            pod = dataclasses.replace(base, node_name=node)
             try:
                 self.cache.assume_pod(pod)
                 self.cache.finish_binding(pod)
@@ -494,6 +970,7 @@ class TPUExtenderBackend:
                 # the warm lane's staleness ledger: exactly one node's
                 # dynamic row moved
                 self._bind_hint.add(node)
+                self.commit_gen += 1
             except KeyError:
                 pass  # already known (e.g. a client retry of a bind that
                 # succeeded) — do NOT treat the existing assumption as ours
@@ -516,8 +993,39 @@ class TPUExtenderBackend:
                         self.cache.forget_pod(pod)
                         self._assumed_bare.pop(key, None)
                         self._bind_hint.add(node)
-                return str(e)
-        return ""
+                        self.commit_gen += 1
+                self._count("bind_errors")
+                if idem_key:
+                    # AMBIGUOUS: the write may have landed (bind-API
+                    # timeout shape) — record it so a same-key retry
+                    # replays to the same node instead of double-booking
+                    self.ledger.finish(idem_key, "uncertain", str(e))
+                return str(e), "error", 0.0
+        if idem_key:
+            self.ledger.finish(idem_key, "ok", "")
+        return "", "ok", 0.0
+
+    def _retry_jitter(self) -> float:
+        """Server-suggested conflict backoff: jittered so a fleet that
+        conflicted together doesn't retry in lockstep."""
+        with self._counters_lock:
+            return 0.002 + self._rng.random() * 0.01
 
     def metrics_text(self) -> str:
-        return self.metrics.render()
+        base = self.metrics.render()
+        # counters snapshot under THEIR lock, generations under the state
+        # lock — taken in sequence (never while holding the other), so a
+        # scrape can't tear either set (ISSUE 9 satellite audit)
+        with self._counters_lock:
+            snap = dict(self._counters)
+        with self._lock:
+            gens = (self.commit_gen, self._snap_gen)
+        lines = [base]
+        for k in sorted(snap):
+            name = f"tpu_extender_{k}_total"
+            lines.append(f"# TYPE {name} counter\n{name} {snap[k]}")
+        lines.append(f"# TYPE tpu_extender_commit_gen gauge\n"
+                     f"tpu_extender_commit_gen {gens[0]}")
+        lines.append(f"# TYPE tpu_extender_snapshot_gen gauge\n"
+                     f"tpu_extender_snapshot_gen {gens[1]}")
+        return "\n".join(lines)

@@ -413,3 +413,106 @@ class SchedulerCache:
         if info is not None:
             info.remove_pod(pod)
             self._aff_event_locked(pod, pod.node_name, -1)
+
+
+class BindLedger:
+    """Idempotency ledger for /bind over the wire (ISSUE 9): exactly-once
+    replay protection for the at-most-once ambiguity PR 8 solved in-process.
+
+    A frontend whose /bind timed out cannot know whether the bind LANDED
+    (response lost) or never ran (request lost). It retries with the SAME
+    idempotency key; the ledger makes that retry converge instead of
+    double-booking:
+
+      - ``ok``        -> the bind completed; the retry is answered from the
+        record with no second assume and no second apiserver write;
+      - ``uncertain`` -> the server's own downstream write errored (which
+        is itself ambiguous — a bind API timeout may have landed). The
+        retry REPLAYS against the RECORDED node, never a fresh choice:
+        re-binding the recorded node is idempotent at the store ("already
+        assigned to <same node>" heals to success), while a fresh choice
+        after a landed write would be the duplicate bind this ledger
+        exists to prevent;
+      - ``pending``   -> a concurrent duplicate (client retried while the
+        original is still in flight): answered retryable-busy, the client
+        backs off and re-asks;
+      - ``conflict``  -> the fence refused the attempt; nothing landed, so
+        a replayed duplicate of THAT attempt gets the same typed answer
+        (the client's next attempt uses a fresh key for its fresh choice).
+
+    Bounded LRU over COMPLETED entries (pending/uncertain entries are
+    pinned — trimming an uncertain record would reopen the ambiguity
+    window); own lock, so ledger reads never contend with the backend's
+    evaluation lock."""
+
+    def __init__(self, cap: int = 65536):
+        from collections import OrderedDict
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, list]" = OrderedDict()
+        # entry: [status, node, error] with status in
+        # {"pending", "ok", "conflict", "uncertain"}
+
+    def begin(self, key: str, node: str):
+        """Open (or re-open) an attempt. Returns (verdict, node, error):
+        verdict "fresh" -> proceed with the caller's node; "replay" ->
+        proceed with the RETURNED node (a prior uncertain attempt owns the
+        choice); "done" -> answer (node, error) without doing anything;
+        "pending" -> a twin is in flight, answer retryable-busy."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self._entries[key] = ["pending", node, ""]
+                self._trim_locked()
+                return "fresh", node, ""
+            status = e[0]
+            if status == "pending":
+                return "pending", e[1], ""
+            if status in ("ok", "conflict"):
+                self._entries.move_to_end(key)
+                return "done", e[1], e[2]
+            # uncertain: the retry re-runs the attempt against the
+            # recorded node (see class docstring)
+            e[0] = "pending"
+            return "replay", e[1], e[2]
+
+    def finish(self, key: str, status: str, error: str = "") -> None:
+        """Record an attempt's outcome: "ok" | "conflict" | "uncertain"."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self._entries[key] = [status, "", error]
+            else:
+                e[0] = status
+                e[2] = error
+            self._trim_locked()
+
+    def abandon(self, key: str) -> None:
+        """Drop a PENDING entry whose attempt did nothing (shed before any
+        side effect), so a same-key retry starts fresh instead of replaying
+        a non-attempt. No-op for completed or uncertain records."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e[0] == "pending":
+                del self._entries[key]
+
+    def _trim_locked(self) -> None:
+        # evict oldest COMPLETED entries only (docstring: pending and
+        # uncertain records are pinned). Incremental oldest-first scan —
+        # at capacity this runs per bind, and materializing a 65k-key
+        # list per commit would put an O(cap) copy on the bind hot path
+        while len(self._entries) > self._cap:
+            for k in self._entries:
+                if self._entries[k][0] in ("ok", "conflict"):
+                    del self._entries[k]
+                    break
+            else:
+                return  # everything live is pinned
+
+    def stats(self):
+        with self._lock:
+            out = {"entries": len(self._entries)}
+            for st in ("pending", "ok", "conflict", "uncertain"):
+                out[st] = sum(1 for e in self._entries.values()
+                              if e[0] == st)
+            return out

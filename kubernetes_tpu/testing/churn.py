@@ -106,6 +106,35 @@ class FaultyBindApi:
         return self._bind_with_faults(bindings, self._api.bind_many)
 
 
+def extender_store_binder(api):
+    """Adapt an ApiServerLite (or a FaultyBindApi proxy around one) into
+    the extender backend's ``binder`` callable (ISSUE 9): the multi-
+    frontend bench/tests bind through the REAL store so exactly-once is
+    audited against store truth, with FaultyBindApi injecting the same
+    failure/timeout shapes the streaming loop is hardened against.
+
+    Store-level idempotence: a bind refused with "already assigned to
+    node <same node>" heals to SUCCESS — that is precisely the landed-
+    timeout replay (the write survived, only the response was lost), and
+    treating it as an error would make the BindLedger's convergent replay
+    impossible. "already assigned" to a DIFFERENT node stays an error
+    (the caller is trying to double-book; the store's refusal IS the
+    exactly-once guarantee)."""
+    from kubernetes_tpu.api.types import Pod
+
+    def _bind(pod_name: str, pod_namespace: str, pod_uid: str,
+              node: str) -> None:
+        stub = Pod(name=pod_name, namespace=pod_namespace, uid=pod_uid)
+        stub.node_name = node
+        err = api.bind_pods_bulk([stub])[0]
+        if err and f"already assigned to node {node}" in err:
+            return  # landed-timeout replay: idempotent success
+        if err:
+            raise RuntimeError(err)
+
+    return _bind
+
+
 # ------------------------------------------------------------------ schedule
 
 
@@ -309,4 +338,4 @@ class ChurnInjector:
 
 
 __all__ = ["ChurnConfig", "ChurnInjector", "ChurnOp", "FaultyBindApi",
-           "make_churn_schedule", "ZONES"]
+           "extender_store_binder", "make_churn_schedule", "ZONES"]

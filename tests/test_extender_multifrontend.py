@@ -1,0 +1,548 @@
+"""Multi-frontend extender service (ISSUE 9): coalesced dispatch,
+optimistic concurrency, exactly-once binds, backpressure.
+
+The seam a real kube-scheduler hits (server/extender.py) serving a FLEET:
+
+  - coalesced dispatch: concurrent /filter+/prioritize evaluations batch
+    into ONE fused [C, N] kernel call (engine.evaluate_pods_batch) against
+    the shared device-resident snapshot — pinned via span counters and an
+    exact parity check against the per-request path;
+  - optimistic concurrency: verdicts carry a snapshot generation; /bind
+    commits through a fence re-validating capacity/liveness/topology
+    against CURRENT cache truth, answering a typed retryable CONFLICT;
+  - exactly-once: bind idempotency keys make a timed-out-but-landed bind
+    replay safely (BindLedger), audited against STORE truth with
+    testing/churn.FaultyBindApi injecting the at-most-once ambiguity;
+  - backpressure: bounded coalescer queue -> Overloaded (HTTP 429 +
+    Retry-After), per-request deadlines shed dead work, a faulting
+    coalescer degrades to per-request evaluation instead of an outage.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.models.hollow import hollow_nodes
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.server.coalescer import DeadlineExceeded, Overloaded
+from kubernetes_tpu.server.extender import (
+    ExtenderHTTPServer,
+    TPUExtenderBackend,
+)
+from kubernetes_tpu.testing.churn import FaultyBindApi, extender_store_binder
+from kubernetes_tpu.utils.trace import COUNTERS
+
+N_NODES = 120
+
+
+def _pod(name: str, cpu: int = 100):
+    return make_pod(name, cpu=cpu, memory=256 << 20)
+
+
+def _backend(**kw) -> TPUExtenderBackend:
+    b = TPUExtenderBackend(**kw)
+    nodes = hollow_nodes(N_NODES)
+    for i, n in enumerate(nodes):
+        n.labels["zone"] = f"z{i % 4}"
+    b.sync_nodes(nodes)
+    b.filter(_pod("warm"), None, None)  # compile + first encode
+    return b
+
+
+# ------------------------------------------------------------- coalescing
+
+
+def test_batch_eval_matches_per_request_exactly():
+    """The fused [C, N] batch path and the single-pod warm lane must agree
+    on every verdict and every integer score — and a multi-class batch
+    must cost ONE batch dispatch, not C."""
+    b = _backend()
+    pods = [_pod(f"mc-{i}", cpu=100 * (1 + i % 3)) for i in range(9)]
+    d0 = COUNTERS.count("extender.fused_eval_batch")
+    outs = b._eval_many(pods)
+    assert COUNTERS.count("extender.fused_eval_batch") == d0 + 1
+    ref = TPUExtenderBackend()
+    ref.sync_nodes([i.node for i in b.cache.node_infos().values()])
+    for p, v in zip(pods, outs):
+        with ref._lock:
+            _snap, m, s = ref._eval(p, None)
+        assert (np.asarray(v.m) == np.asarray(m)).all()
+        assert (np.asarray(v.s) == np.asarray(s)).all()
+
+
+def test_concurrent_filters_coalesce_into_shared_dispatches():
+    """A storm of concurrent same-class /filter requests serves from a
+    shared evaluation: dispatch count stays far below request count, and
+    every thread sees the full verdict."""
+    b = _backend(coalesce_window_s=0.002)
+    b.filter(_pod("seed"), None, None)
+    n_threads = 12
+    start = threading.Barrier(n_threads)
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def drive(i):
+        try:
+            start.wait(timeout=10)
+            passed, failed, gen = b.filter_verdict(_pod(f"storm-{i}"))
+            with lock:
+                results.append((len(passed), len(failed), gen))
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            with lock:
+                errors.append(e)
+
+    f0 = COUNTERS.count("extender.fused_eval")
+    fb0 = COUNTERS.count("extender.fused_eval_batch")
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert len(results) == n_threads
+    assert all(r == (N_NODES, 0, results[0][2]) for r in results)
+    # all same class at one snapshot version: at most a couple of
+    # dispatches total (leader races), never one per request
+    dispatches = (COUNTERS.count("extender.fused_eval") - f0
+                  + COUNTERS.count("extender.fused_eval_batch") - fb0)
+    assert dispatches <= 2, dispatches
+    with b._counters_lock:
+        assert b._counters["coalesce_requests"] >= n_threads
+
+
+def test_coalescer_fault_degrades_to_per_request(monkeypatch):
+    """A faulting batch evaluation must not take the verb down: the leader
+    falls back to per-request eval and the fault is counted."""
+    b = _backend()
+    calls = {"n": 0}
+    real = b._eval_many
+
+    def boom(pods):
+        calls["n"] += 1
+        raise RuntimeError("injected coalescer fault")
+
+    monkeypatch.setattr(b, "_eval_many", boom)
+    passed, failed, gen = b.filter_verdict(_pod("degraded"))
+    assert len(passed) == N_NODES and not failed
+    with b._counters_lock:
+        assert b._counters["coalesce_faults"] == 1
+    monkeypatch.setattr(b, "_eval_many", real)
+    passed, _f, _g = b.filter_verdict(_pod("recovered"))
+    assert len(passed) == N_NODES
+
+
+# --------------------------------------------------- fence + concurrency
+
+
+def test_bind_fence_conflict_is_typed_and_retryable():
+    """Omega at the wire: two frontends verdict at the same generation;
+    the second's commit must fence out with a typed CONFLICT, and its
+    retry against a fresh verdict must succeed elsewhere."""
+    b = TPUExtenderBackend()
+    # two nodes, each with room for exactly one of these pods
+    b.sync_nodes([make_node(f"tiny-{i}", cpu=1000, memory=4 << 30, pods=110)
+                  for i in range(2)])
+    spec = make_pod("a", cpu=900, memory=256 << 20)
+    passed, _f, gen = b.filter_verdict(spec)
+    assert sorted(passed) == ["tiny-0", "tiny-1"]
+    err, kind, _ = b.bind_verdict("a", "default", "u-a", "tiny-0",
+                                  snapshot_gen=gen, idem_key="a:1",
+                                  pod_spec=spec)
+    assert (err, kind) == ("", "ok")
+    # frontend B read the SAME generation, races to the same node
+    spec_b = make_pod("b", cpu=900, memory=256 << 20)
+    err, kind, retry_s = b.bind_verdict("b", "default", "u-b", "tiny-0",
+                                        snapshot_gen=gen, idem_key="b:1",
+                                        pod_spec=spec_b)
+    assert kind == "conflict" and err.startswith("CONFLICT")
+    assert retry_s > 0
+    with b._counters_lock:
+        assert b._counters["bind_conflicts"] == 1
+    # the contract: re-run scheduleOne against a fresh verdict
+    passed, _f, gen2 = b.filter_verdict(spec_b)
+    assert passed == ["tiny-1"]
+    err, kind, _ = b.bind_verdict("b", "default", "u-b", "tiny-1",
+                                  snapshot_gen=gen2, idem_key="b:2",
+                                  pod_spec=spec_b)
+    assert (err, kind) == ("", "ok")
+
+
+def test_bind_skips_fence_when_generation_current():
+    """A verdict at the CURRENT commit generation provably re-validated
+    nothing away — its own /filter pass is the fence."""
+    b = _backend()
+    spec = _pod("cur")
+    passed, _f, gen = b.filter_verdict(spec)
+    s0 = COUNTERS.count("extender.fence_skipped")  # structural: via _count
+    err, kind, _ = b.bind_verdict("cur", "default", "u-c", passed[0],
+                                  snapshot_gen=gen, pod_spec=spec)
+    assert (err, kind) == ("", "ok")
+    with b._counters_lock:
+        assert b._counters.get("bind_fence_skipped", 0) == 1
+    # stale generation (a commit happened): the fence must run
+    err, kind, _ = b.bind_verdict("cur2", "default", "u-c2", passed[1],
+                                  snapshot_gen=gen, pod_spec=_pod("cur2"))
+    assert (err, kind) == ("", "ok")
+    with b._counters_lock:
+        assert b._counters.get("bind_fence_skipped", 0) == 1  # unchanged
+    del s0
+
+
+def test_stale_window_serves_memo_and_fence_guards():
+    """Bounded staleness: inside stale_window_s a bind does NOT invalidate
+    verdicts (memo keeps serving, zero device work), and commits stay
+    guarded by the fence against live cache truth."""
+    b = _backend(stale_window_s=30.0)
+    spec = _pod("sw-0")
+    passed, _f, gen = b.filter_verdict(spec)
+    evals0 = (COUNTERS.count("extender.fused_eval")
+              + COUNTERS.count("extender.fused_eval_batch"))
+    stale0 = COUNTERS.count("extender.stale_served")
+    for i in range(5):
+        err, kind, _ = b.bind_verdict(f"sw-{i}", "default", f"u-{i}",
+                                      passed[i], snapshot_gen=gen,
+                                      pod_spec=_pod(f"sw-{i}"))
+        assert (err, kind) == ("", "ok"), (i, err)
+        p2, _f2, g2 = b.filter_verdict(_pod(f"sw-chk-{i}"))
+        assert len(p2) == N_NODES
+        assert g2 == gen  # generation frozen inside the window
+    assert (COUNTERS.count("extender.fused_eval")
+            + COUNTERS.count("extender.fused_eval_batch")) == evals0
+    assert COUNTERS.count("extender.stale_served") > stale0
+    # capacity really accrued in the CACHE even though the snapshot lags
+    infos = b.cache.node_infos()
+    assert sum(len(i.pods) for i in infos.values()) == 5
+
+
+# ------------------------------------------------------- exactly-once
+
+
+def test_idempotent_replay_returns_recorded_outcome():
+    b = _backend()
+    spec = _pod("idem")
+    passed, _f, gen = b.filter_verdict(spec)
+    node = passed[0]
+    assert b.bind_verdict("idem", "default", "u-i", node, snapshot_gen=gen,
+                          idem_key="idem:1", pod_spec=spec)[1] == "ok"
+    pods0 = b.cache.pod_count()
+    err, kind, _ = b.bind_verdict("idem", "default", "u-i", node,
+                                  snapshot_gen=gen, idem_key="idem:1",
+                                  pod_spec=spec)
+    assert (err, kind) == ("", "ok")
+    assert b.cache.pod_count() == pods0  # no second assume
+    with b._counters_lock:
+        assert b._counters["bind_replays"] == 1
+
+
+def test_timeout_bind_replays_to_exactly_once_at_store():
+    """The at-most-once ambiguity over the wire: the bind API times out
+    but the write LANDED. The client retries with the SAME idempotency
+    key; the ledger replays against the recorded node and the store's
+    same-node refusal heals to success — exactly-once, store-audited."""
+    api = ApiServerLite()
+    for n in hollow_nodes(8):
+        api.create("Node", n)
+    pod = _pod("ghost")
+    api.create("Pod", pod)
+    faulty = FaultyBindApi(api, timeout_rate=1.0, seed=7)
+    b = TPUExtenderBackend(binder=extender_store_binder(faulty))
+    b.sync_nodes([api.get("Node", "", f"hollow-node-{i}") for i in range(8)])
+    passed, _f, gen = b.filter_verdict(pod)
+    node = passed[0]
+    err, kind, _ = b.bind_verdict("ghost", "default", pod.uid, node,
+                                  snapshot_gen=gen, idem_key="ghost:1",
+                                  pod_spec=pod)
+    assert kind == "error" and "timeout" in err
+    assert faulty.injected_timeouts == 1
+    # the write landed at the store despite the error
+    assert api.get("Pod", "default", "ghost").node_name == node
+    # retry, same key: replays to the SAME node, heals to success
+    faulty.timeout_rate = 0.0
+    err, kind, _ = b.bind_verdict("ghost", "default", pod.uid, "ignored",
+                                  snapshot_gen=None, idem_key="ghost:1",
+                                  pod_spec=pod)
+    assert (err, kind) == ("", "ok")
+    assert api.get("Pod", "default", "ghost").node_name == node
+    # exactly one bind ever landed: one MODIFIED event with a node set
+    events, _rv = api.list("Pod"), None
+    binds = [e for e in api._log
+             if e.kind == "Pod" and e.type == "MODIFIED"
+             and e.obj.name == "ghost" and e.obj.node_name]
+    assert len(binds) == 1
+
+
+def test_concurrent_client_storm_exactly_once_under_faults():
+    """The headline robustness audit: N frontends hammer filter/
+    prioritize/bind on ONE backend with injected bind failures AND
+    timeouts, retrying CONFLICTs with jittered backoff. Afterwards: every
+    pod is bound to EXACTLY ONE node at the store (truth reconciled), and
+    every CONFLICT retried to success."""
+    api = ApiServerLite(max_log=100_000)
+    nodes = hollow_nodes(N_NODES)
+    for n in nodes:
+        api.create("Node", n)
+    faulty = FaultyBindApi(api, fail_rate=0.10, timeout_rate=0.10, seed=11)
+    b = TPUExtenderBackend(binder=extender_store_binder(faulty),
+                           stale_window_s=0.02, coalesce_window_s=0.001)
+    b.sync_nodes(nodes)
+    b.filter(_pod("warm"), None, None)
+    n_clients, per = 8, 10
+    for c in range(n_clients):
+        for i in range(per):
+            api.create("Pod", _pod(f"storm-{c}-{i}"))
+    errors, lock = [], threading.Lock()
+    conflicts_seen = [0]
+    start = threading.Barrier(n_clients)
+
+    def drive(c):
+        rng = random.Random(1000 + c)
+        try:
+            start.wait(timeout=20)
+            for i in range(per):
+                name = f"storm-{c}-{i}"
+                spec = _pod(name)
+                bound = False
+                for attempt in range(25):
+                    passed, _f, gen = b.filter_verdict(spec)
+                    scores, _g = b.prioritize_verdict(spec, passed)
+                    best = max(s for _n, s in scores)
+                    top = [n for n, s in scores if s == best]
+                    node = top[rng.randrange(len(top))]
+                    err, kind, retry_s = b.bind_verdict(
+                        name, "default", spec.uid, node, snapshot_gen=gen,
+                        idem_key=f"{name}:{attempt}", pod_spec=spec)
+                    if kind == "ok":
+                        bound = True
+                        break
+                    if kind in ("conflict", "pending"):
+                        with lock:
+                            conflicts_seen[0] += 1
+                        __import__("time").sleep(
+                            retry_s * rng.uniform(0.5, 1.5))
+                        continue
+                    if kind == "error":
+                        if "already assigned" in err:
+                            bound = True  # landed earlier; store is truth
+                            break
+                        # ambiguous: same key converges via the ledger
+                        err2, kind2, _ = b.bind_verdict(
+                            name, "default", spec.uid, node,
+                            snapshot_gen=None,
+                            idem_key=f"{name}:{attempt}", pod_spec=spec)
+                        if kind2 == "ok" or "already assigned" in err2:
+                            bound = True
+                            break
+                        continue  # clean failure: next attempt, fresh key
+                if not bound:
+                    raise AssertionError(f"{name} never bound")
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=drive, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    # STORE-TRUTH exactly-once audit: every pod bound, and the event log
+    # shows exactly one landed bind per pod (a second would have been
+    # refused by the store)
+    pods, _rv = api.list("Pod")
+    storm = [p for p in pods if p.name.startswith("storm-")]
+    assert len(storm) == n_clients * per
+    assert all(p.node_name for p in storm)
+    first_node = {}
+    for e in api._log:
+        if e.kind == "Pod" and e.type == "MODIFIED" and e.obj.node_name \
+                and e.obj.name.startswith("storm-"):
+            prev = first_node.setdefault(e.obj.name, e.obj.node_name)
+            assert prev == e.obj.node_name, \
+                f"duplicate bind: {e.obj.name} -> {prev} AND {e.obj.node_name}"
+    assert faulty.injected_failures + faulty.injected_timeouts > 0
+    with b._counters_lock:
+        snap = dict(b._counters)
+    assert snap.get("bind_errors", 0) > 0  # faults really exercised
+
+
+# ------------------------------------------------------- backpressure
+
+
+def test_admission_control_sheds_past_queue_depth():
+    b = _backend(coalesce_max_depth=2)
+    entered = threading.Event()
+    release = threading.Event()
+    real = b._eval_many
+
+    def slow(pods):
+        entered.set()
+        release.wait(timeout=10)
+        return real(pods)
+
+    b._eval_many = slow
+    outs, overloads, lock = [], [], threading.Lock()
+
+    def drive(i):
+        try:
+            out = b.coalescer.submit(_pod(f"adm-{i}"))
+            with lock:
+                outs.append(out)
+        except Overloaded as e:
+            assert e.retry_after_s > 0
+            with lock:
+                overloads.append(e)
+
+    # one leader, parked inside the (stalled) evaluation...
+    leader = threading.Thread(target=drive, args=(0,))
+    leader.start()
+    assert entered.wait(timeout=10)
+    # ...two followers fill the bounded queue...
+    followers = [threading.Thread(target=drive, args=(i,)) for i in (1, 2)]
+    for t in followers:
+        t.start()
+    deadline = __import__("time").monotonic() + 10
+    while len(b.coalescer._queue) < 2:
+        assert __import__("time").monotonic() < deadline, "queue never filled"
+        __import__("time").sleep(0.001)
+    # ...and everything past max_depth sheds SYNCHRONOUSLY with a hint
+    for i in range(3, 8):
+        drive(i)
+    release.set()
+    leader.join(timeout=30)
+    for t in followers:
+        t.join(timeout=30)
+    b._eval_many = real
+    assert len(overloads) == 5, overloads  # all past-depth submits shed
+    assert len(outs) == 3  # leader + the two queued followers served
+    with b._counters_lock:
+        assert b._counters["admission_shed"] == len(overloads)
+
+
+def test_expired_deadline_is_shed_not_evaluated():
+    b = _backend()
+    with pytest.raises(DeadlineExceeded):
+        # deadline already elapsed relative to arrival: the leader sheds
+        # at batch formation (deadline_s measured from submit)
+        b.coalescer.submit(_pod("dead"), deadline_s=-0.001)
+    with b._counters_lock:
+        assert b._counters["deadline_shed"] >= 1
+    # bind-side shed: nothing happened, and the same key retries fresh
+    spec = _pod("dead-bind")
+    passed, _f, gen = b.filter_verdict(spec)
+    err, kind, _ = b.bind_verdict("dead-bind", "default", "u-d", passed[0],
+                                  snapshot_gen=gen, idem_key="db:1",
+                                  deadline_s=-0.001, pod_spec=spec)
+    assert (err, kind) == ("DEADLINE_EXCEEDED", "shed")
+    err, kind, _ = b.bind_verdict("dead-bind", "default", "u-d", passed[0],
+                                  snapshot_gen=gen, idem_key="db:1",
+                                  pod_spec=spec)
+    assert (err, kind) == ("", "ok")
+
+
+# ------------------------------------------------------------- HTTP wire
+
+
+def test_http_wire_conflict_429_compact_and_keepalive():
+    """The wire contract end to end on ONE keep-alive connection: compact
+    filter (SnapshotGen + AllPassed), TopK prioritize, 409 CONFLICT with
+    RetryAfterMs, 429 + Retry-After past the in-flight cap, new counters
+    on /metrics."""
+    import http.client
+
+    from kubernetes_tpu.api import serde
+
+    b = TPUExtenderBackend()
+    b.sync_nodes([make_node(f"tiny-{i}", cpu=1000, memory=4 << 30, pods=110)
+                  for i in range(2)])
+    srv = ExtenderHTTPServer(b, prefix="/scheduler")
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+
+        def post(path, obj):
+            conn.request("POST", f"/scheduler{path}",
+                         json.dumps(obj), {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.getheader("Retry-After"), \
+                json.loads(resp.read())
+
+        spec = make_pod("w1", cpu=900, memory=256 << 20)
+        enc = serde.encode_pod(spec)
+        status, _ra, out = post("/filter", {"Pod": enc, "NodeNames": None,
+                                            "Compact": True, "TopK": 8})
+        assert status == 200
+        assert out["AllPassed"] and out["NodeNames"] is None
+        assert out["PassedCount"] == 2 and "SnapshotGen" in out
+        # fused verbs: the same verdict's top scores ride the filter
+        # response, one round trip (and only FITTING nodes appear)
+        assert len(out["TopScores"]) == 2
+        assert {e["Host"] for e in out["TopScores"]} == {"tiny-0", "tiny-1"}
+        gen = out["SnapshotGen"]
+        status, _ra, scores = post("/prioritize",
+                                   {"Pod": enc, "NodeNames": None, "TopK": 1})
+        assert status == 200 and len(scores) == 1
+        # same connection still live (keep-alive): bind via the wire
+        status, _ra, out = post("/bind", {
+            "PodName": "w1", "PodNamespace": "default", "PodUID": "u1",
+            "Node": "tiny-0", "SnapshotGen": gen, "IdempotencyKey": "w1:1",
+            "Pod": enc})
+        assert status == 200 and out["Error"] == ""
+        # racing twin at the same gen -> typed 409 with a retry hint
+        spec2 = make_pod("w2", cpu=900, memory=256 << 20)
+        status, _ra, out = post("/bind", {
+            "PodName": "w2", "PodNamespace": "default", "PodUID": "u2",
+            "Node": "tiny-0", "SnapshotGen": gen, "IdempotencyKey": "w2:1",
+            "Pod": serde.encode_pod(spec2)})
+        assert status == 409
+        assert out["Conflict"] and out["RetryAfterMs"] >= 1
+        assert out["Error"].startswith("CONFLICT")
+        # in-flight cap: 0 -> every verb answers 429 + Retry-After
+        srv.max_inflight = 0
+        status, ra, out = post("/filter", {"Pod": enc, "NodeNames": None})
+        assert status == 429 and ra is not None
+        assert out["RetryAfterMs"] > 0
+        srv.max_inflight = 256
+        # metrics carry the new counters, scraped consistently
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read().decode()
+        for needle in ("tpu_extender_bind_conflicts_total 1",
+                       "tpu_extender_admission_shed_total",
+                       "tpu_extender_coalesce_requests_total",
+                       "tpu_extender_commit_gen"):
+            assert needle in body, needle
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_http_unknown_path_keeps_connection_alive():
+    """The keep-alive desync audit (ISSUE 9 satellite): a POST to an
+    unknown path must drain its body so the NEXT request on the same
+    connection still parses."""
+    import http.client
+
+    b = _backend()
+    srv = ExtenderHTTPServer(b, prefix="/scheduler")
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("POST", "/scheduler/nope",
+                     json.dumps({"junk": "x" * 4096}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200 and resp.read() == b"ok"
+        conn.close()
+    finally:
+        srv.stop()

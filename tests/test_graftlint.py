@@ -256,6 +256,109 @@ def test_gl002_registry_covers_streaming_pop_seam(tmp_path):
         findings
 
 
+def test_gl002_registry_covers_batched_extender_eval(tmp_path):
+    """ISSUE 9: the coalesced multi-frontend eval adds a jitted entry
+    point (scheduler_engine._fused_eval_batch_jit, the [C, N] sibling of
+    the extender's fused single-pod dispatch) — the project-wide registry
+    must pick it up from the REAL source so GL002 taint extends to
+    consumers: an unblessed fetch of the batch result would stall the
+    coalescing window once per micro-batch, exactly the hidden-sync
+    hazard the fleet throughput story rests on."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    eng_py = os.path.join(PKG_DIR, "engine", "scheduler_engine.py")
+    with open(eng_py, "r", encoding="utf-8") as fh:
+        index = ProjectIndex()
+        index.scan(ast.parse(fh.read()))
+    for entry in ("_fused_eval_jit", "_fused_eval_batch_jit"):
+        assert entry in index.jitted_names, entry
+    fixture = tmp_path / "coalesced_eval.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.scheduler_engine import (
+            _fused_eval_batch_jit,
+        )
+
+        def serve_window(parr, narr, plain, weights, mode):
+            m, s = _fused_eval_batch_jit(parr, narr, None, plain,
+                                         weights, mode)
+            return np.asarray(m), np.asarray(s)
+    """))
+    findings, _sup, errors = run_paths([eng_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "serve_window" in f.context
+               for f in findings), findings
+    # the blessed form (the batch's one documented result fetch) is silent
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.scheduler_engine import (
+            _fused_eval_batch_jit,
+        )
+
+        def serve_window(parr, narr, plain, weights, mode):
+            m, s = _fused_eval_batch_jit(parr, narr, None, plain,
+                                         weights, mode)
+            m = np.asarray(m)  # graftlint: sync-ok
+            s = np.asarray(s)  # graftlint: sync-ok
+            return m, s
+    """))
+    findings, _sup, errors = run_paths([eng_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "serve_window" in f.context], findings
+
+
+def test_gl003_fires_on_ragged_coalesced_batch(tmp_path):
+    """ISSUE 9: the coalescing window's batch axis is where a ragged-
+    shape recompile storm would creep back in — slicing the class arrays
+    to the data-dependent batch size in the serve loop must fire GL003;
+    the shipped pad-to-bucket idiom (pod_arrays_bucketed rows=bucket(C))
+    stays silent."""
+    eng_py = os.path.join(PKG_DIR, "engine", "scheduler_engine.py")
+    bad = tmp_path / "ragged_window.py"
+    bad.write_text(textwrap.dedent("""
+        from kubernetes_tpu.engine.scheduler_engine import (
+            _fused_eval_batch_jit,
+        )
+
+        def serve(windows, parr, narr, plain, weights, mode):
+            out = []
+            while windows:
+                n = windows.pop()
+                out.append(_fused_eval_batch_jit(parr[:n], narr, None,
+                                                 plain, weights, mode))
+            return out
+    """))
+    findings, _sup, errors = run_paths([eng_py, str(bad)], rules=["GL003"])
+    assert not errors, errors
+    assert any(f.rule == "GL003" and "serve" in f.context
+               for f in findings), findings
+    good = tmp_path / "bucketed_window.py"
+    good.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.scheduler_engine import (
+            _fused_eval_batch_jit,
+        )
+
+        def serve(windows, parr, narr, plain, weights, mode, pad):
+            out = []
+            while windows:
+                n = windows.pop()
+                rows = np.zeros(pad, dtype=np.int32)
+                rows[:n] = parr[:n]
+                out.append(_fused_eval_batch_jit(rows, narr, None,
+                                                 plain, weights, mode))
+            return out
+    """))
+    findings, _sup, errors = run_paths([eng_py, str(good)], rules=["GL003"])
+    assert not errors, errors
+    assert not [f for f in findings if f.rule == "GL003"
+                and "bucketed_window" in f.path], findings
+
+
 def test_gl003_fires_on_ragged_micro_wave_pop(tmp_path):
     """ISSUE 7: the micro-wave pop is where the ragged-shape recompile
     storm would creep back in — an arrival loop slicing its pod arrays
