@@ -24,7 +24,14 @@ the saturation search. Churn scenario (ISSUE 8): BENCH_CHURN=0 to skip,
 BENCH_CHURN_RATE (offered rate; default the arrival rate),
 BENCH_CHURN_SEED, BENCH_CHURN_NODE_PCT_MIN (node churn fraction/min,
 default 0.10), BENCH_CHURN_BIND_FAIL / BENCH_CHURN_BIND_TIMEOUT
-(injected bind-fault rates).
+(injected bind-fault rates). Multi-frontend fleets (ISSUE 9/11):
+BENCH_MULTIFRONTEND=0 to skip, BENCH_MF_CLIENTS/BENCH_MF_NODES/
+BENCH_MF_STALE_MS/BENCH_MF_PODS_PER_CLIENT; every client count runs
+BOTH transports (threaded HTTP `clients_*` and async binary wire
+`binwire_*`) plus the in-process `inproc` and library-linked `embedded`
+fleets. Wire-wall calibration: BENCH_WIRE_FLOOR=0 to skip,
+BENCH_WIRE_FLOOR_CLIENTS (no-op threaded-HTTP vs async-binary floors in
+`wire_floor`).
 """
 
 from __future__ import annotations
@@ -273,6 +280,147 @@ def measure_compat_scheduleone(n_nodes: int, n_pods: int = 2000,
             bound[0], unsched[0])
 
 
+def measure_wire_floor(n_clients: int = 100, per_client: int = 10,
+                       bin_per_client: int = 50):
+    """The ISSUE 11 wire-wall calibration, extracted from PROFILE_r12
+    into a reproducible micro-scenario: measure the NO-OP transport on
+    the CURRENT box — a ThreadingHTTPServer with an empty handler vs the
+    async binary event loop answering PING — under ``n_clients``
+    concurrent in-process client threads (the exact harness shape of the
+    fleet benches). Both floors travel in the bench JSON so every fleet
+    number ships with its platform wall attribution: an HTTP fleet
+    reading at ~its floor is transport-saturated, not engine-saturated.
+
+    Returns {"clients", "threaded_http_rps", "threaded_http_p50_ms",
+    "threaded_http_p99_ms", "async_binary_rps", "async_binary_p50_ms",
+    "async_binary_p99_ms", "binary_vs_http_floor"}."""
+    import http.client
+    import threading
+    import time as _time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubernetes_tpu.client.binarywire import BinaryWireClient
+    from kubernetes_tpu.server.asyncwire import AsyncBinaryServer
+
+    def run_clients(n, per, step):
+        lat, errors = [], []
+        lock = threading.Lock()
+        start = threading.Barrier(n)
+
+        def drive(c):
+            try:
+                start.wait(timeout=30)
+                mine = []
+                for _ in range(per):
+                    t0 = _time.perf_counter()
+                    step(c)
+                    mine.append(_time.perf_counter() - t0)
+                with lock:
+                    lat.extend(mine)
+            except Exception as e:  # a dead client shrinks the floor —
+                # surface it instead of under-reporting the wall
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=drive, args=(c,))
+                   for c in range(n)]
+        t0 = _time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        elapsed = _time.monotonic() - t0
+        if errors:
+            raise RuntimeError("; ".join(errors[:3]))
+        lat.sort()
+        return (len(lat) / elapsed if elapsed > 0 else 0.0,
+                lat[len(lat) // 2] * 1e3 if lat else None,
+                lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3
+                if lat else None)
+
+    # ---- threaded HTTP no-op (the r12 harness, verbatim shape) ----------
+    class _NoopHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            if length:
+                self.rfile.read(length)
+            body = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    class _NoopThreaded(ThreadingHTTPServer):
+        request_queue_size = 256
+        daemon_threads = True
+
+    httpd = _NoopThreaded(("127.0.0.1", 0), _NoopHandler)
+    http_port = httpd.server_address[1]
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    http_thread.start()
+    conns = {}
+
+    def http_step(c):
+        conn = conns.get(c)
+        if conn is None:
+            conn = conns[c] = http.client.HTTPConnection(
+                "127.0.0.1", http_port, timeout=120)
+        conn.request("POST", "/noop", b"{}",
+                     {"Content-Type": "application/json"})
+        conn.getresponse().read()
+
+    try:
+        http_rps, http_p50, http_p99 = run_clients(
+            n_clients, per_client, http_step)
+    finally:
+        for conn in conns.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        httpd.shutdown()
+        http_thread.join(timeout=10)
+
+    # ---- async binary no-op (PING never touches the service) ------------
+    class _NoService:
+        backend = None
+
+    srv = AsyncBinaryServer(_NoService())
+    srv.start()
+    clients = {}
+
+    def bin_step(c):
+        cli = clients.get(c)
+        if cli is None:
+            cli = clients[c] = BinaryWireClient(
+                "127.0.0.1", srv.port, timeout=120).connect()
+        cli.ping()
+
+    try:
+        bin_rps, bin_p50, bin_p99 = run_clients(
+            n_clients, bin_per_client, bin_step)
+    finally:
+        for cli in clients.values():
+            cli.close()
+        srv.stop()
+    return {
+        "clients": n_clients,
+        "threaded_http_rps": round(http_rps, 1),
+        "threaded_http_p50_ms": round(http_p50, 3) if http_p50 else None,
+        "threaded_http_p99_ms": round(http_p99, 3) if http_p99 else None,
+        "async_binary_rps": round(bin_rps, 1),
+        "async_binary_p50_ms": round(bin_p50, 3) if bin_p50 else None,
+        "async_binary_p99_ms": round(bin_p99, 3) if bin_p99 else None,
+        "binary_vs_http_floor": round(bin_rps / http_rps, 2)
+        if http_rps else None,
+    }
+
+
 def measure_multi_frontend(n_nodes: int, clients_list=(1, 10, 100),
                            pods_per_client: int = 0,
                            stale_window_ms: float = 25.0,
@@ -466,6 +614,13 @@ def measure_multi_frontend(n_nodes: int, clients_list=(1, 10, 100),
                             "Pod": enc, "NodeNames": None, "Nodes": None,
                             "Compact": True, "TopK": 32,
                             "DeadlineMs": 10_000})
+                        if st == 504:
+                            # deadline shed: by contract NOTHING happened
+                            # — a fresh attempt is the retry, not a fleet
+                            # failure (a loaded box queues past 10s)
+                            n_shed += 1
+                            done.wait(0.02 * rng.uniform(0.5, 1.5))
+                            continue
                         if st != 200:
                             raise RuntimeError(f"filter HTTP {st}: {out}")
                         gen = out.get("SnapshotGen")
@@ -620,6 +775,345 @@ def measure_multi_frontend(n_nodes: int, clients_list=(1, 10, 100),
             raise RuntimeError(
                 f"multi-frontend audit FAILED: {dups} duplicate binds")
         return out
+
+    def run_fleet_binary(n_clients: int, nn: int, per: int, label: str):
+        """The same fleet protocol over the ASYNC BINARY wire (ISSUE 11):
+        one event loop owns every socket (server/asyncwire.py), frames
+        are the length-prefixed binary codec (server/framing.py), and a
+        fleet scheduleOne is TWO round trips — fused FILTER(+TopK) and a
+        spec-carrying BIND with SnapshotGen + IdempotencyKey in the
+        frame. Same store, same injected faults, same exactly-once
+        audit: the transport A/B against run_fleet isolates the wire."""
+        from kubernetes_tpu.client.binarywire import (
+            BinaryWireClient,
+            WireDeadline,
+            WireOverloaded,
+        )
+        from kubernetes_tpu.server.asyncwire import AsyncBinaryServer
+        from kubernetes_tpu.server.embedded import VerdictService
+
+        api = ApiServerLite(max_log=max(200_000, 4 * (nn + n_clients * per)))
+        nodes = hollow_nodes(nn)
+        for i, n in enumerate(nodes):
+            n.labels["zone"] = f"z{i % 16}"
+        for n in nodes:
+            api.create("Node", n)
+        faulty = FaultyBindApi(api, fail_rate=bind_fail_rate,
+                               timeout_rate=bind_timeout_rate, seed=nn + 2)
+        backend = TPUExtenderBackend(
+            binder=extender_store_binder(faulty),
+            stale_window_s=stale_window_ms / 1e3,
+            coalesce_window_s=0.0005)
+        backend.sync_nodes(nodes)
+        backend.filter(make_pod("warm", cpu=100, memory=256 << 20),
+                       None, None)
+        srv = AsyncBinaryServer(
+            VerdictService(backend),
+            max_batch=128,
+            max_pending=min(max(n_clients, 16), 256),
+            max_inflight=min(max(n_clients, 16), 128),
+            workers=2)
+        srv.start()
+        from kubernetes_tpu.server import framing as _framing
+        specs = {}
+        blobs = {}
+        for c in range(n_clients):
+            for i in range(per):
+                p = make_pod(f"mb-{label}-{c}-{i}", cpu=100,
+                             memory=256 << 20)
+                api.create("Pod", p)
+                specs[(c, i)] = p
+                # spec blob encoded ONCE per pod, reused across attempts
+                # and both verbs (the binary twin of the HTTP drivers'
+                # serialize-the-candidate-list-once discipline)
+                blobs[(c, i)] = _framing.encode_pod_blob(p)
+        lat_all, errors = [], []
+        conflicts = [0]
+        retries = [0]
+        shed_ct = [0]
+        bound_ct = [0]
+        lock = threading.Lock()
+        done = threading.Event()
+        bound_specs = {}
+
+        def syncer():
+            # the nodeCacheCapable confirm loop over the binary SYNC verb
+            # (capacity feedback + re-sync invalidation cost, as in the
+            # HTTP fleet)
+            cli = BinaryWireClient("127.0.0.1", srv.port, timeout=120)
+            while not done.wait(2.0):
+                with lock:
+                    items = list(bound_specs.values())
+                if not items:
+                    continue
+                try:
+                    cli.sync_pods(items)
+                except Exception:
+                    cli.close()
+            cli.close()
+
+        def drive(c: int):
+            rng = _random.Random(66_000 + c)
+            cli = BinaryWireClient("127.0.0.1", srv.port, timeout=60)
+            lat = []
+            n_conf = n_retry = n_shed = n_bound = 0
+
+            def timed(fn):
+                # reconnect-and-retry on socket faults: SAFE BY DESIGN —
+                # filter is an idempotent read, bind is ledger-keyed, so
+                # a re-send of the same frame is exactly the replay path
+                # (the HTTP clients' discipline, on the binary wire)
+                last = None
+                for _try in range(3):
+                    t0 = _time.perf_counter()
+                    try:
+                        out = fn()
+                        lat.append(_time.perf_counter() - t0)
+                        return out
+                    except (WireOverloaded, WireDeadline):
+                        lat.append(_time.perf_counter() - t0)
+                        raise
+                    except (TimeoutError, ConnectionError, OSError) as e:
+                        last = e
+                        cli.close()
+                raise RuntimeError(f"{type(last).__name__}: {last}")
+
+            try:
+                for i in range(per):
+                    spec = specs[(c, i)]
+                    blob = blobs[(c, i)]
+                    bound = False
+                    for attempt in range(80):
+                        try:
+                            v = timed(lambda: cli.filter_fused(
+                                spec, top_k=32, deadline_ms=10_000,
+                                pod_blob=blob))
+                        except WireOverloaded as e:
+                            n_shed += 1
+                            done.wait(e.retry_after_s
+                                      * rng.uniform(0.5, 1.5))
+                            continue
+                        except WireDeadline:
+                            n_shed += 1
+                            done.wait(0.005 * rng.uniform(0.5, 1.5))
+                            continue
+                        scores = v.top_scores or []
+                        if not scores:
+                            n_retry += 1
+                            done.wait(0.01 * rng.uniform(0.5, 1.5))
+                            continue
+                        best = scores[0][1]
+                        top = [h for h, s in scores if s == best]
+                        node = top[rng.randrange(len(top))]
+                        try:
+                            r = timed(lambda: cli.bind(
+                                spec.name, spec.namespace, spec.uid, node,
+                                snapshot_gen=v.snapshot_gen,
+                                idem_key=f"{spec.name}:{attempt}",
+                                deadline_ms=10_000, pod_blob=blob))
+                        except WireOverloaded as e:
+                            n_shed += 1
+                            done.wait(e.retry_after_s
+                                      * rng.uniform(0.5, 1.5))
+                            continue
+                        except WireDeadline:
+                            n_shed += 1
+                            continue
+                        if r.ok:
+                            bound = True
+                        elif r.retryable:
+                            n_conf += 1
+                            n_retry += 1
+                            done.wait(r.retry_after_s
+                                      * rng.uniform(0.5, 1.5))
+                            continue
+                        elif "already assigned" in r.error:
+                            bound = True  # landed earlier; store is truth
+                            m = _re.search(
+                                r"already assigned to node (\S+)", r.error)
+                            if m:
+                                node = m.group(1)
+                        elif r.kind == "error":
+                            # ambiguous: replay the SAME key — the ledger
+                            # converges it to exactly-once
+                            n_retry += 1
+                            try:
+                                r2 = timed(lambda: cli.bind(
+                                    spec.name, spec.namespace, spec.uid,
+                                    node,
+                                    idem_key=f"{spec.name}:{attempt}",
+                                    pod_blob=blob))
+                            except (WireOverloaded, WireDeadline):
+                                continue
+                            if r2.ok or "already assigned" in r2.error:
+                                bound = True
+                                m = _re.search(
+                                    r"already assigned to node (\S+)",
+                                    r2.error)
+                                if m:
+                                    node = m.group(1)
+                            else:
+                                continue
+                        else:
+                            continue  # shed: fresh attempt, fresh key
+                        if bound:
+                            n_bound += 1
+                            with lock:
+                                bound_specs[spec.key()] = \
+                                    dataclasses.replace(spec,
+                                                        node_name=node)
+                            break
+                    if not bound:
+                        raise RuntimeError(f"{spec.name}: never bound")
+            except Exception as e:
+                with lock:
+                    errors.append(f"client {c}: {type(e).__name__}: {e}")
+            finally:
+                cli.close()
+                with lock:
+                    lat_all.extend(lat)
+                    conflicts[0] += n_conf
+                    retries[0] += n_retry
+                    shed_ct[0] += n_shed
+                    bound_ct[0] += n_bound
+
+        sync_th = threading.Thread(target=syncer, daemon=True)
+        sync_th.start()
+        threads = [threading.Thread(target=drive, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        done.set()
+        sync_th.join(timeout=30)
+        srv.stop()
+        if errors:
+            raise RuntimeError("; ".join(errors[:5]))
+        dups = audit_duplicate_binds(api, "mb-")
+        pods_now, _rv = api.list("Pod")
+        store_bound = sum(1 for p in pods_now
+                          if p.name.startswith("mb-") and p.node_name)
+        lat_all.sort()
+        with backend._counters_lock:
+            srv_counters = dict(backend._counters)
+        attempts = bound_ct[0] + conflicts[0]
+        out = {
+            "clients": n_clients,
+            "nodes": nn,
+            "transport": "async-binary",
+            "pods_s": round(bound_ct[0] / elapsed, 1) if elapsed else 0.0,
+            "bound": bound_ct[0],
+            "store_bound": store_bound,
+            "duplicate_binds": dups,
+            "conflicts": conflicts[0],
+            "conflict_rate": round(conflicts[0] / attempts, 4)
+            if attempts else 0.0,
+            "retries": retries[0],
+            "shed_overload": shed_ct[0],
+            "shed_rate": round(shed_ct[0] / max(len(lat_all), 1), 4),
+            "p50_request_ms": round(
+                lat_all[len(lat_all) // 2] * 1e3, 3) if lat_all else None,
+            "p99_request_ms": round(
+                lat_all[min(int(len(lat_all) * 0.99),
+                            len(lat_all) - 1)] * 1e3, 3)
+            if lat_all else None,
+            "injected_bind_failures": faulty.injected_failures,
+            "injected_bind_timeouts": faulty.injected_timeouts,
+            "srv_wire_batches": srv_counters.get("wire_batches", 0),
+            "srv_wire_requests": srv_counters.get("wire_requests", 0),
+            "srv_bind_conflicts": srv_counters.get("bind_conflicts", 0),
+            "srv_bind_replays": srv_counters.get("bind_replays", 0),
+            "srv_admission_shed": srv_counters.get("admission_shed", 0),
+            "srv_deadline_shed": srv_counters.get("deadline_shed", 0),
+        }
+        if dups:
+            raise RuntimeError(
+                f"binary-wire fleet audit FAILED: {dups} duplicate binds")
+        return out
+
+    def run_fleet_embedded(n_clients: int, nn: int, per: int, label: str):
+        """The TRUE in-process embedding mode (server/embedded.py): N
+        frontend threads link the verdict API as a library and drive
+        EmbeddedVerdictAPI.schedule_one — coalescer/stale-window/fence/
+        ledger intact, zero wire. Store-audited like every fleet."""
+        from kubernetes_tpu.server.embedded import EmbeddedVerdictAPI
+
+        api = ApiServerLite(max_log=max(200_000, 4 * (nn + n_clients * per)))
+        nodes = hollow_nodes(nn)
+        for i, n in enumerate(nodes):
+            n.labels["zone"] = f"z{i % 16}"
+        for n in nodes:
+            api.create("Node", n)
+        faulty = FaultyBindApi(api, fail_rate=bind_fail_rate,
+                               timeout_rate=bind_timeout_rate, seed=nn + 3)
+        emb = EmbeddedVerdictAPI(
+            binder=extender_store_binder(faulty),
+            stale_window_s=stale_window_ms / 1e3,
+            coalesce_window_s=0.0005)
+        emb.sync_nodes(nodes)
+        emb.filter(make_pod("warm", cpu=100, memory=256 << 20))
+        specs = {}
+        for c in range(n_clients):
+            for i in range(per):
+                p = make_pod(f"me-{label}-{c}-{i}", cpu=100,
+                             memory=256 << 20)
+                api.create("Pod", p)
+                specs[(c, i)] = p
+        errors, lock = [], threading.Lock()
+        bound_ct = [0]
+        attempts_ct = [0]
+
+        def drive(c: int):
+            rng = _random.Random(99_000 + c)
+            n_bound = n_att = 0
+            try:
+                for i in range(per):
+                    _node, att = emb.schedule_one(specs[(c, i)], top_k=32,
+                                                  rng=rng)
+                    n_bound += 1
+                    n_att += att
+            except Exception as e:
+                with lock:
+                    errors.append(f"client {c}: {type(e).__name__}: {e}")
+            finally:
+                with lock:
+                    bound_ct[0] += n_bound
+                    attempts_ct[0] += n_att
+
+        threads = [threading.Thread(target=drive, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        if errors:
+            raise RuntimeError("; ".join(errors[:5]))
+        dups = audit_duplicate_binds(api, "me-")
+        if dups:
+            raise RuntimeError(
+                f"embedded fleet audit FAILED: {dups} duplicate binds")
+        with emb.backend._counters_lock:
+            srv_counters = dict(emb.backend._counters)
+        return {
+            "clients": n_clients,
+            "nodes": nn,
+            "transport": "embedded",
+            "pods_s": round(bound_ct[0] / elapsed, 1) if elapsed else 0.0,
+            "bound": bound_ct[0],
+            "duplicate_binds": dups,
+            "attempts_per_bind": round(attempts_ct[0]
+                                       / max(bound_ct[0], 1), 3),
+            "injected_bind_failures": faulty.injected_failures,
+            "injected_bind_timeouts": faulty.injected_timeouts,
+            "srv_coalesce_batches": srv_counters.get("coalesce_batches", 0),
+            "srv_bind_conflicts": srv_counters.get("bind_conflicts", 0),
+            "srv_bind_replays": srv_counters.get("bind_replays", 0),
+        }
 
     def run_fleet_inproc(n_clients: int, nn: int, per: int, label: str):
         """The same fleet protocol WITHOUT the HTTP socket layer: 100
@@ -778,28 +1272,67 @@ def measure_multi_frontend(n_nodes: int, clients_list=(1, 10, 100),
             "srv_bind_replays": srv_counters.get("bind_replays", 0),
         }
 
+    def run_quiesced(fn, *a):
+        """Collector quiescence for one fleet measurement (the same
+        CPython service tuning the headline drain applies): a gen-2 GC
+        pass over a heap holding several prior fleets' clusters reads as
+        hundreds of ms of request latency charged to whichever transport
+        happened to be under test — quiesce uniformly so the A/B
+        compares transports, not collection timing."""
+        import gc
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            return fn(*a)
+        finally:
+            gc.enable()
+            gc.unfreeze()
+
     if not pods_per_client:
         pods_per_client = int(os.environ.get("BENCH_MF_PODS_PER_CLIENT", 0))
     results = {}
     for n_clients in clients_list:
         per = pods_per_client or max(20, min(200, 2000 // n_clients))
         try:
-            results[f"clients_{n_clients}"] = run_fleet(
-                n_clients, n_nodes, per, str(n_clients))
+            results[f"clients_{n_clients}"] = run_quiesced(
+                run_fleet, n_clients, n_nodes, per, str(n_clients))
         except Exception as e:  # one fleet's failure must not hide the
             # others' numbers; the error travels in the artifact
             results[f"clients_{n_clients}"] = {
+                "clients": n_clients, "error": f"{type(e).__name__}: {e}"}
+    # transport A/B (ISSUE 11): the SAME fleets over the async binary
+    # wire — one event loop, binary frames, two round trips per
+    # scheduleOne — against the same store with the same injected faults
+    # and the same hard-zero duplicate audit
+    for n_clients in clients_list:
+        per = pods_per_client or max(20, min(200, 2000 // n_clients))
+        try:
+            results[f"binwire_{n_clients}"] = run_quiesced(
+                run_fleet_binary, n_clients, n_nodes, per,
+                str(n_clients))
+        except Exception as e:
+            results[f"binwire_{n_clients}"] = {
                 "clients": n_clients, "error": f"{type(e).__name__}: {e}"}
     # service-capacity fleet: the same 100-frontend protocol without the
     # Python http.server platform in the measurement loop
     big = max(clients_list)
     try:
-        results["inproc"] = run_fleet_inproc(
-            big, n_nodes,
+        results["inproc"] = run_quiesced(
+            run_fleet_inproc, big, n_nodes,
             pods_per_client or max(20, min(200, 20_000 // big)), "ip")
     except Exception as e:
         results["inproc"] = {"clients": big,
                              "error": f"{type(e).__name__}: {e}"}
+    # the TRUE embedding mode (ISSUE 11): frontends LINK the verdict API
+    # (EmbeddedVerdictAPI.schedule_one), coalescer/fence/ledger intact
+    try:
+        results["embedded"] = run_quiesced(
+            run_fleet_embedded, big, n_nodes,
+            pods_per_client or max(20, min(200, 20_000 // big)), "emb")
+    except Exception as e:
+        results["embedded"] = {"clients": big,
+                               "error": f"{type(e).__name__}: {e}"}
     # capacity-tight fleet: few nodes filled to ~98% (hollow nodes take 40
     # of these 100m pods by CPU), so the endgame races the last slots
     # through stale verdicts and the fence genuinely refuses — the
@@ -807,13 +1340,33 @@ def measure_multi_frontend(n_nodes: int, clients_list=(1, 10, 100),
     # available
     tight_clients = min(max(clients_list), 32)
     try:
-        results["tight"] = run_fleet(
-            tight_clients, tight_nodes,
+        results["tight"] = run_quiesced(
+            run_fleet, tight_clients, tight_nodes,
             max(8, int(tight_nodes * 40 * 0.98) // tight_clients), "tight")
     except Exception as e:
         results["tight"] = {"clients": tight_clients,
                             "error": f"{type(e).__name__}: {e}"}
+    # ...and the tight endgame over the binary wire: the fence must
+    # refuse (and heal) identically when the transport swaps
+    try:
+        results["binwire_tight"] = run_quiesced(
+            run_fleet_binary, tight_clients, tight_nodes,
+            max(8, int(tight_nodes * 40 * 0.98) // tight_clients),
+            "tight")
+    except Exception as e:
+        results["binwire_tight"] = {"clients": tight_clients,
+                                    "error": f"{type(e).__name__}: {e}"}
     return results
+
+
+def _ratio(results, a: str, b: str):
+    """pods_s ratio between two fleet results, None when either is
+    missing/errored (the A/B must never invent a number)."""
+    ra = (results.get(a) or {}).get("pods_s")
+    rb = (results.get(b) or {}).get("pods_s")
+    if not ra or not rb:
+        return None
+    return round(ra / rb, 2)
 
 
 _STREAM_WARMED: set = set()
@@ -1694,6 +2247,21 @@ def main():
             print(f"bench: multi-frontend measurement failed: {e}",
                   file=sys.stderr)
 
+    # wire-wall calibration (ISSUE 11 satellite): the NO-OP transport
+    # floors on THIS box — threaded HTTP vs async binary — so every
+    # fleet number above ships with its platform wall attribution
+    # (BENCH_WIRE_FLOOR=0 to skip; BENCH_WIRE_FLOOR_CLIENTS knob)
+    wire_floor = None
+    if os.environ.get("BENCH_WIRE_FLOOR", "1") != "0":
+        try:
+            wire_floor = measure_wire_floor(
+                n_clients=int(os.environ.get("BENCH_WIRE_FLOOR_CLIENTS",
+                                             100)))
+        except Exception as e:
+            import sys
+            print(f"bench: wire-floor measurement failed: {e}",
+                  file=sys.stderr)
+
     # mixed-affinity drain (ISSUE 3 headline): same box, same protocol,
     # >=15% required (anti-)affinity pods (BENCH_MIXED=0 to skip)
     mixed = None
@@ -1830,14 +2398,33 @@ def main():
             (r.get("duplicate_binds", 0)
              for r in multi_frontend.values()), default=0)
         if multi_frontend else None,
+        # transport A/B (ISSUE 11): the same 100-frontend fleet over the
+        # async binary wire vs threaded HTTP vs in-process, with the
+        # no-op platform floors alongside — the acceptance ratios travel
+        # in the artifact
+        "wire_floor": wire_floor,
+        "multi_frontend_binwire_pods_s": multi_frontend.get(
+            "binwire_100", multi_frontend.get(
+                f"binwire_{max(int(c) for c in mf_clients)}", {})).get(
+                    "pods_s") if multi_frontend else None,
+        "multi_frontend_embedded_pods_s": multi_frontend.get(
+            "embedded", {}).get("pods_s") if multi_frontend else None,
+        "binwire_vs_http_wire": _ratio(
+            multi_frontend, "binwire_100", "clients_100")
+        if multi_frontend else None,
+        "binwire_vs_inproc": _ratio(multi_frontend, "binwire_100",
+                                    "inproc")
+        if multi_frontend else None,
     }, **(churn or {}), **(mixed or {}), **(gangmix or {}))
     print(json.dumps(out))
 
     # resume the bench trajectory: persist this round's numbers as the
-    # BENCH_r10 artifact — same {cmd, rc, parsed} shape as the
+    # CURRENT round's artifact — same {cmd, rc, parsed} shape as the
     # driver-written BENCH_r01..r05 files, so trajectory readers keep
-    # working. BENCH_ARTIFACT= (empty) disables, or names another round.
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r12.json")
+    # working. BENCH_ARTIFACT= (empty) disables, or names another round;
+    # the default is pinned to THIS round so a bench run can never
+    # rewrite a prior round's file as commit noise (ISSUE 11 satellite).
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r13.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

@@ -234,6 +234,16 @@ class ExtenderHTTPServer:
 
         self.httpd = _FleetHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+        # transport-agnostic service core (ISSUE 11): the verdict-capable
+        # paths below delegate here, the SAME core the async binary wire
+        # (server/asyncwire.py) and the embedded mode (server/embedded.py)
+        # serve — no transport owns a semantic. Local import: embedded.py
+        # imports this module for TPUExtenderBackend.
+        self.service = None
+        if getattr(backend, "fused_verdict", None) is not None \
+                and getattr(backend, "filter_verdict", None) is not None:
+            from kubernetes_tpu.server.embedded import VerdictService
+            self.service = VerdictService(backend)
 
     # ------------------------------------------------------- admission gate
 
@@ -280,44 +290,38 @@ class ExtenderHTTPServer:
 
     def handle_filter(self, payload: Dict) -> Dict:
         pod, nodes, names = self._parse_args(payload)
-        fv = getattr(self.backend, "filter_verdict", None)
-        fused = getattr(self.backend, "fused_verdict", None)
         top_k = int(payload.get("TopK") or 0)
-        gen = None
-        top = None
-        if fv is None or nodes is not None:
+        if self.service is None or nodes is not None:
             passed, failed = self.backend.filter(pod, nodes, names)
-        elif top_k and fused is not None:
-            # fused verbs on ONE window ticket: the response carries the
-            # top-k scores of the same verdict, so a fleet scheduleOne
-            # skips the /prioritize round trip entirely
-            passed, failed, top, gen = fused(
-                pod, names, deadline_s=self._deadline_of(payload),
-                top_k=top_k)
-        else:
-            passed, failed, gen = fv(
-                pod, names, deadline_s=self._deadline_of(payload))
-        if nodes is not None:
-            by_name = {n.name: n for n in nodes}
-            return {
-                "Nodes": {"Items": [serde.encode_node(by_name[nm])
-                                    for nm in passed if nm in by_name]},
-                "FailedNodes": failed,
-                "Error": "",
-            }
-        out = {"NodeNames": passed, "FailedNodes": failed, "Error": ""}
-        if gen is not None:
-            out["SnapshotGen"] = gen
-        if top is not None:
+            if nodes is not None:
+                by_name = {n.name: n for n in nodes}
+                return {
+                    "Nodes": {"Items": [serde.encode_node(by_name[nm])
+                                        for nm in passed if nm in by_name]},
+                    "FailedNodes": failed,
+                    "Error": "",
+                }
+            return {"NodeNames": passed, "FailedNodes": failed, "Error": ""}
+        # verdict-capable cache mode: ONE service-core call answers the
+        # verb (and, with TopK, the fused top scores of the same window
+        # ticket — a fleet scheduleOne skips /prioritize entirely); this
+        # JSON shaping is all that stays transport-specific
+        v = self.service.filter(
+            pod, node_names=names, top_k=top_k,
+            deadline_s=self._deadline_of(payload),
+            compact=bool(payload.get("Compact")))
+        out = {"NodeNames": v.passed, "FailedNodes": v.failed, "Error": ""}
+        if v.snapshot_gen is not None:
+            out["SnapshotGen"] = v.snapshot_gen
+        if v.top_scores is not None:
             out["TopScores"] = [{"Host": h, "Score": int(s)}
-                                for h, s in top]
-        if payload.get("Compact") and not failed and names is None:
+                                for h, s in v.top_scores]
+        if v.passed is None:
             # multi-frontend compact mode: the echo of an all-passed 5k-
             # name candidate list costs more wire time than the verdict —
             # "everything passed" is one bit + a count
-            out["NodeNames"] = None
             out["AllPassed"] = True
-            out["PassedCount"] = len(passed)
+            out["PassedCount"] = v.passed_count
         return out
 
     def handle_prioritize(self, payload: Dict) -> List[Dict]:
@@ -344,24 +348,24 @@ class ExtenderHTTPServer:
         pod_ns = self._get(payload, "PodNamespace", "podNamespace") or ""
         pod_uid = str(self._get(payload, "PodUID", "podUID") or "")
         node = self._get(payload, "Node", "node") or ""
-        bv = getattr(self.backend, "bind_verdict", None)
-        if bv is None:
+        if self.service is None \
+                or getattr(self.backend, "bind_verdict", None) is None:
             return {"Error": self.backend.bind(
                 pod_name, pod_ns, pod_uid, node)}, 200
         spec_obj = self._get(payload, "Pod", "pod")
         spec = serde.decode_pod(spec_obj) if spec_obj else None
         gen = payload.get("SnapshotGen")
-        err, kind, retry_after_s = bv(
+        res = self.service.bind(
             pod_name, pod_ns, pod_uid, node,
             snapshot_gen=int(gen) if gen is not None else None,
             idem_key=payload.get("IdempotencyKey") or None,
-            deadline_s=self._deadline_of(payload), pod_spec=spec)
-        out: Dict = {"Error": err}
-        if kind in ("conflict", "pending"):
+            deadline_s=self._deadline_of(payload), pod=spec)
+        out: Dict = {"Error": res.error}
+        if res.retryable:
             out["Conflict"] = True
-            out["RetryAfterMs"] = max(int(retry_after_s * 1e3), 1)
+            out["RetryAfterMs"] = max(int(res.retry_after_s * 1e3), 1)
             return out, 409
-        if kind == "shed":
+        if res.kind == "shed":
             return out, 504
         return out, 200
 
@@ -732,8 +736,13 @@ class TPUExtenderBackend:
         n = len(v.names)
         if not (top_k and n):
             return []
-        s = np.where(np.asarray(v.m[:n]), np.asarray(v.s[:n]),
-                     np.iinfo(np.int64).min)
+        # widen BEFORE masking: the verdict's scores are int32 on the
+        # production config, and np.where(int32, int64-min) wraps the
+        # sentinel to 0 — a non-fitting node would ride TopScores with
+        # score 0 whenever fewer than k nodes fit, steering the frontend
+        # into a guaranteed fence conflict
+        s = np.asarray(v.s[:n]).astype(np.int64, copy=True)
+        s[~np.asarray(v.m[:n])] = np.iinfo(np.int64).min
         k = min(int(top_k), n)
         part = np.argpartition(s, n - k)[n - k:]
         order = part[np.argsort(-s[part], kind="stable")]

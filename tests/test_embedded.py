@@ -1,0 +1,161 @@
+"""Embedded verdict API (ISSUE 11): the in-process deployment mode.
+
+server/embedded.py is both the transport-agnostic service core every
+wire adapts (VerdictService — the HTTP extender and the async binary
+wire delegate here) and the zero-wire embedding a co-located frontend
+links directly (EmbeddedVerdictAPI). These tests pin the embedding
+contract: the coalescer, stale window, fence and ledger stay INTACT
+under concurrent embedded frontends — embedding removes the socket,
+never a semantic.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.models.hollow import hollow_nodes
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.server.embedded import (
+    BindResult,
+    EmbeddedVerdictAPI,
+    FilterVerdict,
+    VerdictService,
+)
+from kubernetes_tpu.testing.churn import FaultyBindApi, extender_store_binder
+
+N_NODES = 96
+
+
+def _pod(name: str, cpu: int = 100):
+    return make_pod(name, cpu=cpu, memory=256 << 20)
+
+
+def _embedded(binder=None, **kw) -> EmbeddedVerdictAPI:
+    api = EmbeddedVerdictAPI(binder=binder, **kw)
+    nodes = hollow_nodes(N_NODES)
+    api.sync_nodes(nodes)
+    api.filter(_pod("warm"))
+    return api
+
+
+def test_filter_verdict_contract_and_compact_elision():
+    api = _embedded()
+    v = api.filter(_pod("fv"), top_k=8, compact=True)
+    assert isinstance(v, FilterVerdict)
+    assert v.all_passed and v.passed_count == N_NODES
+    assert v.passed is None  # compact + all passed: elided
+    assert len(v.top_scores) == 8 and v.snapshot_gen is not None
+    # non-compact keeps the echo; top_k=0 keeps /prioritize separate
+    v = api.filter(_pod("fv2"))
+    assert v.passed is not None and len(v.passed) == N_NODES
+    assert v.top_scores is None
+    # restricted candidate set: never elided, split honors the names
+    v = api.filter(_pod("fv3"), node_names=[v.passed[0], "no-such-node"],
+                   top_k=4, compact=True)
+    assert v.passed_count == 1 and len(v.failed) == 1
+    assert [h for h, _s in v.top_scores] == v.passed
+
+
+def test_bind_result_typed_fence_conflict():
+    api = EmbeddedVerdictAPI(stale_window_s=0.0)
+    api.sync_nodes([make_node(f"tiny-{i}", cpu=1000, memory=4 << 30,
+                              pods=110) for i in range(2)])
+    spec = make_pod("a", cpu=900, memory=256 << 20)
+    v = api.filter(spec, top_k=4)
+    res = api.bind("a", "default", "u-a", "tiny-0",
+                   snapshot_gen=v.snapshot_gen, idem_key="a:1", pod=spec)
+    assert isinstance(res, BindResult) and res.ok
+    spec_b = make_pod("b", cpu=900, memory=256 << 20)
+    res = api.bind("b", "default", "u-b", "tiny-0",
+                   snapshot_gen=v.snapshot_gen, idem_key="b:1", pod=spec_b)
+    assert res.retryable and res.kind == "conflict"
+    assert res.error.startswith("CONFLICT") and res.retry_after_s > 0
+    # TopScores after the fix: a non-fitting node must NOT appear even
+    # when fewer than k nodes fit (the int32 sentinel-wrap regression)
+    v2 = api.filter(spec_b, top_k=4)
+    assert [h for h, _s in v2.top_scores] == ["tiny-1"]
+
+
+def test_schedule_one_embedded_frontends_store_audited():
+    """N embedded frontend threads drive schedule_one concurrently under
+    injected bind faults: every pod lands on exactly one node at the
+    store, evaluations coalesce, capacity accrues."""
+    store = ApiServerLite(max_log=100_000)
+    nodes = hollow_nodes(N_NODES)
+    for n in nodes:
+        store.create("Node", n)
+    faulty = FaultyBindApi(store, fail_rate=0.1, timeout_rate=0.1, seed=5)
+    api = EmbeddedVerdictAPI(binder=extender_store_binder(faulty),
+                             coalesce_window_s=0.001)
+    api.sync_nodes(nodes)
+    api.filter(_pod("warm"))
+    n_clients, per = 6, 8
+    for c in range(n_clients):
+        for i in range(per):
+            store.create("Pod", _pod(f"emb-{c}-{i}"))
+    errors, lock = [], threading.Lock()
+    start = threading.Barrier(n_clients)
+
+    def drive(c):
+        rng = random.Random(9000 + c)
+        try:
+            start.wait(timeout=20)
+            for i in range(per):
+                api.schedule_one(_pod(f"emb-{c}-{i}"), top_k=16, rng=rng)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            with lock:
+                errors.append(f"{c}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=drive, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    pods, _rv = store.list("Pod")
+    bound = [p for p in pods if p.name.startswith("emb-") and p.node_name]
+    assert len(bound) == n_clients * per
+    # store-truth exactly-once: one bound node per pod, ever
+    first = {}
+    for e in store._log:
+        if e.kind == "Pod" and e.type == "MODIFIED" and e.obj.node_name \
+                and e.obj.name.startswith("emb-"):
+            prev = first.setdefault(e.obj.name, e.obj.node_name)
+            assert prev == e.obj.node_name, e.obj.name
+    # embedding kept the coalescer in the loop (not one eval per call)
+    with api.backend._counters_lock:
+        snap = dict(api.backend._counters)
+    assert snap.get("coalesce_requests", 0) >= n_clients * per
+    assert faulty.injected_failures + faulty.injected_timeouts > 0
+    # capacity accrued in the embedded cache — allowing the landed-
+    # timeout ambiguity its contract: a bind that landed at the store
+    # but errored back may stay cache-unknown until the next bulk sync
+    # delivers the spec (the store, not the cache, is truth)
+    infos = api.backend.cache.node_infos()
+    accrued = sum(len(i.pods) for i in infos.values())
+    assert 0 < accrued <= n_clients * per
+    assert accrued >= n_clients * per - faulty.injected_timeouts \
+        - faulty.injected_failures
+
+
+def test_service_core_is_shared_with_http_transport():
+    """The HTTP extender serves THROUGH the same VerdictService class the
+    embedding exposes — the refactor's point: no transport owns a
+    semantic."""
+    from kubernetes_tpu.server.extender import (
+        ExtenderHTTPServer,
+        TPUExtenderBackend,
+    )
+    b = TPUExtenderBackend()
+    b.sync_nodes(hollow_nodes(4))
+    srv = ExtenderHTTPServer(b)
+    assert isinstance(srv.service, VerdictService)
+    assert srv.service.backend is b
+    out = srv.handle_filter({"Pod": {"metadata": {"name": "x"},
+                                     "spec": {"containers": []}},
+                             "Compact": True, "TopK": 2})
+    assert out["AllPassed"] and out["PassedCount"] == 4
+    assert len(out["TopScores"]) == 2

@@ -1,0 +1,207 @@
+"""Binary fleet framing (ISSUE 11): codec round-trips + the frame fuzzer.
+
+The length-prefixed framing in server/framing.py is the wire the async
+fleet transport speaks; a transport bug here is a fleet outage, so the
+robustness contract is pinned at the codec layer: every truncated,
+oversized, corrupt-length or garbage input raises the typed FrameError
+(never an IndexError/struct.error deep in parsing), and the incremental
+decoder reassembles arbitrarily fragmented streams byte for byte.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from kubernetes_tpu.api.types import make_pod
+from kubernetes_tpu.server import framing
+
+
+def _pod(name="fx", cpu=250):
+    p = make_pod(name, cpu=cpu, memory=512 << 20)
+    p.labels["app"] = "frame-test"
+    return p
+
+
+# ------------------------------------------------------------- round trips
+
+
+def test_filter_request_roundtrip():
+    pod = _pod()
+    payload = framing.encode_filter_request(pod, top_k=32,
+                                            deadline_ms=10_000)
+    dec_pod, top_k, deadline_ms = framing.decode_filter_request(payload)
+    assert (top_k, deadline_ms) == (32, 10_000)
+    assert dec_pod.name == pod.name and dec_pod.labels == pod.labels
+    assert dec_pod.containers[0].requests == pod.containers[0].requests
+
+
+def test_bind_request_roundtrip_with_and_without_spec():
+    pod = _pod("bx")
+    payload = framing.encode_bind_request(
+        "bx", "default", "u-1", "node-7", snapshot_gen=42,
+        idem_key="bx:3", deadline_ms=5000, pod=pod)
+    name, ns, uid, node, gen, key, dl, spec = \
+        framing.decode_bind_request(payload)
+    assert (name, ns, uid, node) == ("bx", "default", "u-1", "node-7")
+    assert (gen, key, dl) == (42, "bx:3", 5000)
+    assert spec is not None and spec.name == "bx"
+    # identifiers-only form: gen None rides as -1, empty key -> None
+    payload = framing.encode_bind_request("bx", "default", "u-1", "n")
+    out = framing.decode_bind_request(payload)
+    assert out[4] is None and out[5] is None and out[7] is None
+
+
+def test_verdict_and_bind_result_roundtrip():
+    p = framing.encode_verdict(9, False, 3, ["a", "b", "c"], ["d"],
+                               [("a", 100), ("b", -5)])
+    d = framing.decode_verdict(p)
+    assert d["gen"] == 9 and not d["all_passed"]
+    assert d["passed"] == ["a", "b", "c"] and d["failed"] == ["d"]
+    assert d["top"] == [("a", 100), ("b", -5)]
+    # compact all-passed: names elided, count carried
+    d = framing.decode_verdict(
+        framing.encode_verdict(None, True, 5000, None, [], []))
+    assert d["gen"] is None and d["all_passed"] and d["passed_count"] == 5000
+    assert d["passed"] == [] and d["top"] == []
+    for kind in framing.BIND_KINDS:
+        r = framing.decode_bind_result(
+            framing.encode_bind_result(kind, 17, "CONFLICT: x"))
+        assert r == {"kind": kind, "retry_after_ms": 17,
+                     "error": "CONFLICT: x"}
+
+
+def test_control_frames_roundtrip():
+    assert framing.decode_overloaded(framing.encode_overloaded(33)) == 33
+    assert framing.decode_error(framing.encode_error("boom")) == "boom"
+    assert framing.decode_synced(framing.encode_synced(7)) == 7
+    assert framing.decode_metrics_text(
+        framing.encode_metrics_text("a\nb")) == "a\nb"
+
+
+def test_items_blob_roundtrip_json_fallback():
+    from kubernetes_tpu.api.types import make_node
+    nodes = [make_node(f"n{i}", cpu=4000, memory=8 << 30) for i in range(3)]
+    blob = framing.encode_items_blob(nodes, "nodes")
+    out = framing.decode_items_blob(blob, "nodes")
+    assert [n.name for n in out] == ["n0", "n1", "n2"]
+    assert out[0].allocatable.milli_cpu == 4000
+    pods = [_pod(f"p{i}") for i in range(2)]
+    out = framing.decode_items_blob(framing.encode_items_blob(pods, "pods"),
+                                    "pods")
+    assert [p.name for p in out] == ["p0", "p1"]
+
+
+# ---------------------------------------------------------------- decoder
+
+
+def test_decoder_reassembles_byte_by_byte():
+    """Interleaved partial writes: three frames fed one byte at a time
+    must come out whole, in order, regardless of chunk boundaries."""
+    frames = [
+        framing.encode_frame(framing.PING, 1),
+        framing.encode_frame(framing.FILTER, 2,
+                             framing.encode_filter_request(_pod(), 8, 100),
+                             flags=framing.FLAG_COMPACT),
+        framing.encode_frame(framing.ERROR, 3,
+                             framing.encode_error("x" * 300)),
+    ]
+    stream = b"".join(frames)
+    dec = framing.FrameDecoder()
+    got = []
+    for i in range(len(stream)):
+        got.extend(dec.feed(stream[i:i + 1]))
+    assert [(v, r) for v, _f, r, _p in got] == [
+        (framing.PING, 1), (framing.FILTER, 2), (framing.ERROR, 3)]
+    assert got[1][1] == framing.FLAG_COMPACT
+    assert framing.decode_error(got[2][3]) == "x" * 300
+    assert dec.buffered == 0
+
+
+def test_decoder_mixed_chunk_sizes():
+    frames = [framing.encode_frame(framing.PING, i) for i in range(10)]
+    stream = b"".join(frames)
+    dec = framing.FrameDecoder()
+    got, pos = [], 0
+    for sz in (1, 3, 7, 11, 64, 1, 2, 1000):
+        got.extend(dec.feed(stream[pos:pos + sz]))
+        pos += sz
+    got.extend(dec.feed(stream[pos:]))
+    assert [r for _v, _f, r, _p in got] == list(range(10))
+
+
+def test_corrupt_length_prefix_raises_typed():
+    # length beyond max_frame: e.g. ASCII garbage read as a u32
+    with pytest.raises(framing.FrameError, match="corrupt frame length"):
+        framing.FrameDecoder().feed(b"GET / HTTP/1.1\r\n\r\n")
+    # length below the header remainder (cannot even hold verb+id)
+    bad = struct.pack("!IBBI", 2, framing.PING, 0, 1)
+    with pytest.raises(framing.FrameError, match="corrupt frame length"):
+        framing.FrameDecoder().feed(bad)
+
+
+def test_oversized_frame_rejected_before_buffering():
+    dec = framing.FrameDecoder(max_frame=64)
+    big = framing.encode_frame(framing.ERROR, 1,
+                               framing.encode_error("y" * 200))
+    with pytest.raises(framing.FrameError, match="corrupt frame length"):
+        dec.feed(big)
+
+
+def test_truncated_frame_waits_truncated_payload_raises():
+    # a SHORT feed is not an error — the decoder waits for the rest
+    frame = framing.encode_frame(
+        framing.BIND, 5, framing.encode_bind_request("a", "ns", "u", "n"))
+    dec = framing.FrameDecoder()
+    assert dec.feed(frame[:len(frame) - 3]) == []
+    assert dec.buffered == len(frame) - 3
+    # ...but a payload LYING about its contents is typed at parse time
+    lying = framing.encode_frame(framing.VERDICT, 6, b"\x00\x01")
+    (verb, _f, _r, payload), = framing.FrameDecoder().feed(lying)
+    with pytest.raises(framing.FrameError, match="truncated"):
+        framing.decode_verdict(payload)
+
+
+def test_corrupt_string_and_list_counts_raise_typed():
+    # string declaring more bytes than the payload holds
+    p = bytes(framing.Writer().u32(1 << 30).buf)
+    with pytest.raises(framing.FrameError, match="truncated string"):
+        framing.Reader(p).str_()
+    # absurd list count must be rejected before looping
+    p = bytes(framing.Writer().u32(1 << 31).buf)
+    with pytest.raises(framing.FrameError, match="corrupt list count"):
+        framing.Reader(p).strs()
+
+
+def test_pod_blob_typed_failures():
+    with pytest.raises(framing.FrameError, match="empty pod blob"):
+        framing.decode_pod_blob(b"")
+    with pytest.raises(framing.FrameError, match="unknown pod codec"):
+        framing.decode_pod_blob(b"\x77{}")
+    with pytest.raises(framing.FrameError, match="bad JSON pod blob"):
+        framing.decode_pod_blob(bytes([framing.CODEC_JSON]) + b"{nope")
+
+
+def test_random_garbage_never_escapes_frame_error():
+    """The fuzz core: random byte soup either yields frames, waits for
+    more input, or raises FrameError — nothing else, ever."""
+    import random as _random
+    rng = _random.Random(0xF022)
+    for trial in range(200):
+        dec = framing.FrameDecoder(max_frame=1 << 16)
+        blob = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(1, 400)))
+        try:
+            frames = dec.feed(blob)
+        except framing.FrameError:
+            continue
+        for verb, _f, _r, payload in frames:
+            # parsing any claimed payload stays typed too
+            for parse in (framing.decode_verdict,
+                          framing.decode_bind_request,
+                          framing.decode_filter_request):
+                try:
+                    parse(payload)
+                except framing.FrameError:
+                    pass

@@ -311,6 +311,79 @@ def test_gl002_registry_covers_batched_extender_eval(tmp_path):
     assert not [f for f in findings if "serve_window" in f.context], findings
 
 
+def test_gl002_registry_does_not_taint_async_wire(tmp_path):
+    """ISSUE 11: the async binary wire (server/asyncwire.py + framing +
+    the binary client) is pure HOST-side plumbing — it never calls a
+    jitted entry point and never fetches a device value; all device work
+    stays behind the service core's blessed seams. The registry built
+    over the REAL engine sources must therefore produce ZERO GL002
+    findings over the new wire modules: if taint ever reaches the event
+    loop's reads, either the wire started dispatching device work inline
+    (a loop-wedging hazard — one unblessed fetch per frame serializes
+    every connection) or the rule broke. Mirrors the r12 batched-eval
+    fixture from the opposite direction: that one proves the registry
+    EXTENDS to consumers; this one proves the wire is not one."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    eng_py = os.path.join(PKG_DIR, "engine", "scheduler_engine.py")
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    wire_files = [
+        os.path.join(PKG_DIR, "server", "asyncwire.py"),
+        os.path.join(PKG_DIR, "server", "framing.py"),
+        os.path.join(PKG_DIR, "server", "embedded.py"),
+        os.path.join(PKG_DIR, "client", "binarywire.py"),
+    ]
+    # the registry really carries the jitted entry points (scan sanity:
+    # an empty registry would make this test pass vacuously)
+    index = ProjectIndex()
+    for src in (eng_py, waves_py):
+        with open(src, "r", encoding="utf-8") as fh:
+            index.scan(ast.parse(fh.read()))
+    assert "_fused_eval_batch_jit" in index.jitted_names
+    assert "waves_loop" in index.jitted_names
+    findings, _sup, errors = run_paths([eng_py, waves_py] + wire_files,
+                                       rules=["GL002"])
+    assert not errors, errors
+    tainted = [f for f in findings
+               if any(os.path.basename(w) in f.path for w in wire_files)]
+    assert not tainted, tainted
+    # negative control, the r12 pattern inverted: a wire-shaped consumer
+    # that DOES fetch a jitted result from its serve path fires — the
+    # silence above is the wire's purity, not the rule going blind
+    fixture = tmp_path / "bad_wire.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.scheduler_engine import (
+            _fused_eval_batch_jit,
+        )
+
+        def serve_frame(parr, narr, plain, weights, mode):
+            m, s = _fused_eval_batch_jit(parr, narr, None, plain,
+                                         weights, mode)
+            return np.asarray(m)
+    """))
+    findings, _sup, errors = run_paths([eng_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "serve_frame" in f.context
+               for f in findings), findings
+
+
+def test_lint_gate_covers_new_wire_modules():
+    """ISSUE 11 satellite: `bench --lint-gate` discovers the new wire
+    modules (they are ordinary package files — but a collection
+    regression here would silently exempt the fleet transport from every
+    rule, so the coverage is pinned)."""
+    from kubernetes_tpu.analysis.lint import collect_files
+
+    files = collect_files([PKG_DIR])
+    for rel in (("server", "asyncwire.py"), ("server", "framing.py"),
+                ("server", "embedded.py"), ("client", "binarywire.py")):
+        assert os.path.join(PKG_DIR, *rel) in files, rel
+
+
 def test_gl003_fires_on_ragged_coalesced_batch(tmp_path):
     """ISSUE 9: the coalescing window's batch axis is where a ragged-
     shape recompile storm would creep back in — slicing the class arrays
