@@ -1372,7 +1372,17 @@ def _ratio(results, a: str, b: str):
 _STREAM_WARMED: set = set()
 
 
-def _warm_stream_shapes(n_nodes: int, sizes, profile: str = "density"):
+def _mesh_or_none(mesh_devices: int):
+    """make_mesh(mesh_devices) when >1 forced host devices are available;
+    the 1-device request is the unsharded engine by definition."""
+    if not mesh_devices or int(mesh_devices) <= 1:
+        return None
+    from kubernetes_tpu.parallel.mesh import make_mesh
+    return make_mesh(int(mesh_devices))
+
+
+def _warm_stream_shapes(n_nodes: int, sizes, profile: str = "density",
+                        mesh_devices: int = 0):
     """Compile the micro-wave shape ladder BEFORE a measured stream: one
     throwaway cluster, one fixed-chunk drain per ladder size, so the
     adaptive quantum's growth path never pays an XLA compile mid-offer
@@ -1385,20 +1395,22 @@ def _warm_stream_shapes(n_nodes: int, sizes, profile: str = "density"):
     from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes, load_cluster
     from kubernetes_tpu.server.apiserver_lite import ApiServerLite
 
-    todo = [s for s in sizes if (n_nodes, profile, s) not in _STREAM_WARMED]
+    todo = [s for s in sizes
+            if (n_nodes, profile, s, mesh_devices) not in _STREAM_WARMED]
     if not todo:
         return
     api = ApiServerLite(max_log=max(200_000,
                                     3 * (n_nodes + sum(todo) + 1000)))
     load_cluster(api, hollow_nodes(n_nodes), [])
-    sched = Scheduler(api, record_events=False)
+    sched = Scheduler(api, record_events=False,
+                      mesh=_mesh_or_none(mesh_devices))
     sched.start()
     for sz in todo:
         for p in PROFILES[profile](sz):
             p.name = f"warm{sz}-{p.name}"
             api.create("Pod", p)
         sched.run_until_drained(max_batch=sz)
-        _STREAM_WARMED.add((n_nodes, profile, sz))
+        _STREAM_WARMED.add((n_nodes, profile, sz, mesh_devices))
 
 
 def run_arrival(n_nodes: int, rate: float, duration_s: float,
@@ -1406,7 +1418,7 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
                 budget_ms: float = 250.0, max_burst: int = 0,
                 min_quantum: int = 256, max_quantum: int = 16384,
                 interval_s: float = 0.0, warm: bool = False,
-                churn_cfg=None):
+                churn_cfg=None, mesh_devices: int = 0):
     """THE headline scenario (ISSUE 7): pods are CREATED at a configured
     rate while the ALWAYS-ON loop runs — the reference's density suite
     semantics (test/integration/scheduler_perf/scheduler_test.go:34-39
@@ -1465,7 +1477,8 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
         while s <= max_quantum:
             sizes.append(s)
             s *= 2
-        _warm_stream_shapes(n_nodes, sizes, profile=profile)
+        _warm_stream_shapes(n_nodes, sizes, profile=profile,
+                            mesh_devices=mesh_devices)
     api = ApiServerLite(max_log=max(200_000, 3 * (n_nodes + total)))
     nodes = hollow_nodes(n_nodes)
     load_cluster(api, nodes, [])
@@ -1483,7 +1496,8 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
             [n.name for n in nodes], churn_cfg, duration_s))
     pods = PROFILES[profile](total)
     pod_index = {p.key(): i for i, p in enumerate(pods)}
-    sched = Scheduler(api, record_events=False)
+    sched = Scheduler(api, record_events=False,
+                      mesh=_mesh_or_none(mesh_devices))
     sched.start()
     import numpy as np
     import threading
@@ -1515,6 +1529,15 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
             while sched.schedule_round()["popped"] or \
                     sched.queue.ready_count() or sched.queue._deferred:
                 pass
+    # counter baseline at the OFFER-WINDOW boundary: warmup (shape-ladder
+    # drains + this scheduler's own prime/boot encoding build) is all
+    # behind this point, so consumers reading span-counter invariants
+    # ("zero encode rebuilds during the stream", delta rows shipped)
+    # diff against this instead of a pre-warm reset that can never show
+    # the delta-only invariant
+    from kubernetes_tpu.utils.trace import COUNTERS as _counters
+    counters_at_offer_start = {
+        k: v[0] for k, v in _counters.snapshot().items()}
     # quiesce the collector for the measured window (same tuning as the
     # drain headline): a gen-2 pass over the warm heap mid-offer is a
     # 200-400ms stop-the-world that reads as a scheduler latency spike
@@ -1739,6 +1762,7 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
         "liveness_requeued": int(agg["liveness_requeued"]),
         "degraded_steps": int(agg["degraded_steps"]),
         "duplicate_binds": int(duplicate_binds),
+        "counters_at_offer_start": counters_at_offer_start,
     }
     if injector is not None:
         out.update({
@@ -2078,6 +2102,272 @@ def measure_gang_mix(n_nodes: int, n_pods: int, warmup: bool = True):
     }
 
 
+# ------------------------------------------------------------ scale sweep
+# ISSUE 12: the node axis as a SCALING dimension — the same drain at
+# 5k/20k/50k nodes on 1 vs n forced host devices, placements asserted
+# bit-identical across device counts, with the per-wave span and
+# host-traffic counters proving the winner reduce moves O(n_devices)
+# candidates and the delta path writes one shard per touched node. Each
+# point runs in a SUBPROCESS because the forced-host device count must be
+# fixed before any JAX initialization (same discipline as
+# __graft_entry__.dryrun_multichip).
+
+
+def _scale_env(n_devices: int):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # persistent compile cache, same reason as dryrun_multichip's env
+    # builder: the sweep pays 6 drain + 2 stream subprocesses, and a warm
+    # cache turns each point's XLA compiles from minutes into seconds
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if "--xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags
+        + f" --xla_force_host_platform_device_count={max(n_devices, 1)}"
+    ).strip()
+    return env
+
+
+def _scale_sub(call: str, n_devices: int, timeout: float = 2400):
+    import subprocess
+    import sys
+    here = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, "-c", f"import bench; bench.{call}"],
+        cwd=here, env=_scale_env(n_devices), capture_output=True,
+        text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"scale-sweep subprocess failed rc={proc.returncode}:\n"
+            + proc.stderr[-4000:])
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    return json.loads(lines[-1])
+
+
+def _scale_drain_impl(n_nodes: int, n_pods: int, n_devices: int,
+                      chunk: int = 4096, profile: str = "density") -> None:
+    """One sweep point: an ENGINE-level pipelined drain (dispatch_waves /
+    harvest_waves two deep — the Scheduler's drain body without the
+    apiserver, so the measurement is the tensor pipeline, not 300k watch
+    events), printed as one JSON line. Runs a one-chunk warmup drain on a
+    throwaway cache first so XLA compiles are not charged to the wall."""
+    import hashlib
+    import resource
+    import sys
+
+    import numpy as np
+
+    from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+    from kubernetes_tpu.models.hollow import PROFILES, hollow_nodes
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    mesh = None
+    if n_devices > 1:
+        from kubernetes_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(n_devices)
+
+    def drain(nn, pods_n):
+        cache = SchedulerCache()
+        for nd in hollow_nodes(nn):
+            cache.add_node(nd)
+        engine = SchedulingEngine(cache, mesh=mesh)
+        engine.track_dirty = True  # sole cache owner: hinted refresh
+        engine.wave_pad_floor = chunk
+        pending = PROFILES[profile](pods_n)
+        bound = {}
+        unsched = 0
+        spans = []
+        prev = None
+        t0 = time.perf_counter()
+        while pending or prev is not None:
+            chunk_pods = pending[:chunk]
+            del pending[:chunk]
+            handle = engine.dispatch_waves(chunk_pods) if chunk_pods \
+                else None
+            if handle is None and chunk_pods:
+                raise RuntimeError("scale profile fell off the wave path")
+            if prev is not None:
+                h = engine.harvest_waves(prev)
+                for p in h.bound:
+                    bound[p.name] = p.node_name
+                unsched += len(h.unschedulable)
+                pending.extend(h.conflicts)
+                spans.append(h.t_block)
+            prev = handle
+        wall = time.perf_counter() - t0
+        return bound, unsched, spans, wall
+
+    t_setup0 = time.perf_counter()
+    # compile warmup at the SAME node count (the wave program specializes
+    # on N): a throwaway one-chunk drain pays every XLA compile so the
+    # measured wall below is steady-state engine time only
+    drain(n_nodes, chunk)  # warmup: compiles only, result discarded
+    t_warm = time.perf_counter() - t_setup0
+    COUNTERS.reset()
+    bound, unsched, spans, wall = drain(n_nodes, n_pods)
+    snap = COUNTERS.snapshot()
+
+    def cnt(name):
+        return int(snap.get(name, (0, 0.0))[0])
+
+    digest = hashlib.sha256()
+    for k in sorted(bound):
+        digest.update(f"{k}:{bound[k]}\n".encode())
+    spans_s = sorted(spans)
+    out = {
+        "n_nodes": n_nodes, "n_pods": n_pods, "n_devices": n_devices,
+        "chunk": chunk, "profile": profile,
+        "bound": len(bound), "unschedulable": unsched,
+        "wall_s": round(wall, 3),
+        "pods_per_s": round(len(bound) / wall, 1) if wall > 0 else 0.0,
+        "warm_compile_s": round(t_warm, 1),
+        "waves": len(spans),
+        "wave_block_p50_ms": round(
+            spans_s[len(spans_s) // 2] * 1e3, 2) if spans_s else None,
+        "wave_block_max_ms": round(spans_s[-1] * 1e3, 2)
+        if spans_s else None,
+        # traffic proofs: the harvest fetch is O(P) per wave whatever N
+        # is; the sharded winner reduce moves D*C candidate rows per
+        # INNER wave iteration (the counter scales by waves_used, so the
+        # per-dispatch figure = D * c_pad * inner waves — N never enters
+        # it); the delta path ships only touched rows' shards
+        "host_fetch_bytes": cnt("engine.host_fetch_bytes"),
+        "host_fetch_bytes_per_wave": round(
+            cnt("engine.host_fetch_bytes") / max(len(spans), 1)),
+        "reduce_candidate_rows": cnt("engine.reduce_candidate_rows"),
+        "reduce_candidate_rows_per_dispatch": round(
+            cnt("engine.reduce_candidate_rows")
+            / max(cnt("engine.wave_dispatch"), 1), 1),
+        "shard_delta_rows": cnt("engine.shard_delta_rows"),
+        "shard_upload_bytes": cnt("engine.shard_upload_bytes"),
+        "device_upload_arrays": cnt("engine.device_upload_arrays"),
+        "assume_delta_rows": cnt("snapshot.assume_delta_rows"),
+        "encode_builds": cnt("engine.wave_encode_build"),
+        "placements_sha256": digest.hexdigest(),
+        "peak_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024),
+    }
+    sys.stdout.write(json.dumps(out) + "\n")
+
+
+def _scale_stream_impl(n_nodes: int, n_devices: int, rate: float,
+                       duration_s: float, budget_ms: float) -> None:
+    """The streaming leg at scale: run_arrival on a mesh-resident
+    scheduler (n_devices > 1) or the unsharded engine, one JSON line.
+    The delta-only invariant counters travel with the latency numbers."""
+    import sys
+
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    COUNTERS.reset()
+    res = run_arrival(n_nodes, rate=rate, duration_s=duration_s,
+                      profile="density", budget_ms=budget_ms, warm=True,
+                      mesh_devices=n_devices)
+    snap = COUNTERS.snapshot()
+    # the invariant counters diff against run_arrival's offer-window
+    # baseline: warmup drains + the measured scheduler's one-time boot
+    # encoding all land BEFORE it, so "encode_builds_during_run" == 0 IS
+    # the delta-only acceptance read (a pre-warm reset could never show
+    # it — warmup's own builds would always pollute the number)
+    base = res.get("counters_at_offer_start", {})
+
+    def window(name):
+        return int(snap.get(name, (0, 0))[0]) - int(base.get(name, 0))
+
+    res = dict(res)
+    res["n_devices"] = n_devices
+    res["shard_delta_rows"] = window("engine.shard_delta_rows")
+    res["shard_upload_bytes"] = window("engine.shard_upload_bytes")
+    res["encode_builds_during_run"] = window("engine.wave_encode_build")
+    keep = ("offered_pods_s", "sustained_pods_s", "p50_ms", "p99_ms",
+            "bound", "unbound", "backlog_at_offer_end", "budget_ms",
+            "creator_jitter_ok", "n_devices", "shard_delta_rows",
+            "shard_upload_bytes", "encode_builds_during_run",
+            "quantum_peak")
+    sys.stdout.write(json.dumps({k: res.get(k) for k in keep}) + "\n")
+
+
+def measure_scale_sweep(shapes=((5_000, 30_000), (20_000, 120_000),
+                                (50_000, 300_000)),
+                        devices=(1, 8), chunk: int = 4096,
+                        stream_nodes: int = 50_000,
+                        stream_rate: float = 0.0,
+                        stream_budget_ms: float = 0.0):
+    """The ISSUE 12 acceptance scenario: the same hollow drain swept over
+    cluster size x device count, placements asserted BIT-IDENTICAL across
+    device counts at every shape (the sharded engine must be a pure
+    layout choice), multi-vs-single device wall clocks reported side by
+    side, plus the 50k-node streaming-arrival leg with a budget scaled to
+    the cluster (the 250 ms headline budget is a 5k-node contract; the
+    10x cluster gets a proportionally scaled bound, reported as its own
+    budget_ms).
+
+    Env knobs: BENCH_SCALE_SHAPES ("5000:30000,20000:120000,..."),
+    BENCH_SCALE_DEVICES ("1,8"), BENCH_SCALE_CHUNK, BENCH_SCALE_STREAM=0
+    to skip the arrival leg, BENCH_SCALE_STREAM_RATE/_BUDGET_MS."""
+    env_shapes = os.environ.get("BENCH_SCALE_SHAPES", "")
+    if env_shapes:
+        shapes = tuple(tuple(int(x) for x in s.split(":"))
+                       for s in env_shapes.split(",") if s)
+    env_dev = os.environ.get("BENCH_SCALE_DEVICES", "")
+    if env_dev:
+        devices = tuple(int(d) for d in env_dev.split(","))
+    chunk = int(os.environ.get("BENCH_SCALE_CHUNK", chunk))
+    out = {"shapes": [], "chunk": chunk}
+    ok_identical = True
+    for (nn, pods_n) in shapes:
+        row = {"n_nodes": nn, "n_pods": pods_n, "devices": {}}
+        hashes = {}
+        for d in devices:
+            res = _scale_sub(
+                f"_scale_drain_impl({nn}, {pods_n}, {d}, chunk={chunk})",
+                d)
+            row["devices"][str(d)] = res
+            hashes[d] = res["placements_sha256"]
+        if len(set(hashes.values())) > 1:
+            ok_identical = False
+            row["sharded_equals_unsharded"] = False
+        else:
+            row["sharded_equals_unsharded"] = True
+        base = row["devices"].get("1")
+        best = min((r for k, r in row["devices"].items() if k != "1"),
+                   key=lambda r: r["wall_s"], default=None)
+        if base and best:
+            row["multi_vs_single_speedup"] = round(
+                base["wall_s"] / best["wall_s"], 3)
+            row["multi_beats_single"] = best["wall_s"] < base["wall_s"]
+        out["shapes"].append(row)
+    out["sharded_equals_unsharded_all"] = ok_identical
+    if os.environ.get("BENCH_SCALE_STREAM", "1") != "0":
+        # budget scaling: the 250ms budget was set against 5k nodes; a
+        # 10x node axis gets a 10x-scaled latency bound and an offered
+        # rate the 2-core box can honestly create against
+        rate = stream_rate or float(
+            os.environ.get("BENCH_SCALE_STREAM_RATE", 2000))
+        budget = stream_budget_ms or float(
+            os.environ.get("BENCH_SCALE_STREAM_BUDGET_MS",
+                           250.0 * stream_nodes / 5000.0))
+        dur = max(3.0, min(6.0, 12_000 / rate))
+        stream = {"n_nodes": stream_nodes, "rate": rate,
+                  "budget_ms": budget}
+        for d in sorted({1, max(devices)}):
+            try:
+                stream[f"devices_{d}"] = _scale_sub(
+                    f"_scale_stream_impl({stream_nodes}, {d}, {rate}, "
+                    f"{dur}, {budget})", d)
+            except Exception as e:
+                stream[f"devices_{d}"] = {"error": str(e)[-500:]}
+        out["stream_50k"] = stream
+    return out
+
+
 def lint_gate_or_die():
     """`--lint-gate` / BENCH_LINT_GATE=1: refuse to report perf numbers
     from a tree carrying unsuppressed graftlint hazards. A number measured
@@ -2262,6 +2552,19 @@ def main():
             print(f"bench: wire-floor measurement failed: {e}",
                   file=sys.stderr)
 
+    # scale sweep (ISSUE 12): 5k/20k/50k nodes x 1-vs-8 forced host
+    # devices, engine-level drain A/B with bit-identity + traffic
+    # counters, plus the 50k streaming leg (BENCH_SCALE_SWEEP=0 to skip;
+    # BENCH_SCALE_SHAPES/BENCH_SCALE_DEVICES/BENCH_SCALE_CHUNK/
+    # BENCH_SCALE_STREAM* knobs)
+    scale_sweep = None
+    if os.environ.get("BENCH_SCALE_SWEEP", "1") != "0":
+        try:
+            scale_sweep = measure_scale_sweep()
+        except Exception as e:
+            import sys
+            print(f"bench: scale sweep failed: {e}", file=sys.stderr)
+
     # mixed-affinity drain (ISSUE 3 headline): same box, same protocol,
     # >=15% required (anti-)affinity pods (BENCH_MIXED=0 to skip)
     mixed = None
@@ -2415,6 +2718,12 @@ def main():
         "binwire_vs_inproc": _ratio(multi_frontend, "binwire_100",
                                     "inproc")
         if multi_frontend else None,
+        # scale sweep (ISSUE 12): node-axis scaling A/B — per-shape 1-vs-8
+        # device walls, bit-identity verdicts, O(n_devices) reduce +
+        # one-shard-per-node delta counters, 50k streaming leg
+        "scale_sweep": scale_sweep,
+        "scale_sharded_equals_unsharded": scale_sweep.get(
+            "sharded_equals_unsharded_all") if scale_sweep else None,
     }, **(churn or {}), **(mixed or {}), **(gangmix or {}))
     print(json.dumps(out))
 
@@ -2424,7 +2733,7 @@ def main():
     # working. BENCH_ARTIFACT= (empty) disables, or names another round;
     # the default is pinned to THIS round so a bench run can never
     # rewrite a prior round's file as commit noise (ISSUE 11 satellite).
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r13.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r14.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

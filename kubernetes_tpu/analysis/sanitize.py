@@ -38,6 +38,11 @@ import numpy as np
 __all__ = ["AliasingViolation", "enabled", "freeze", "upload_copied",
            "upload_frozen", "upload_view"]
 
+# extra upload seams for the resident device mesh (ISSUE 12) are defined
+# below: upload_copied/upload_frozen accept an optional NamedSharding so
+# multi-device residency rides the SAME contract surface (and the same
+# GL001 pragma discipline) as the single-device seams.
+
 
 class AliasingViolation(RuntimeError):
     """A device upload that is contractually a copy aliases its host
@@ -56,19 +61,35 @@ def enabled() -> bool:
 _copy_ctor = jnp.array
 
 
-def upload_copied(host):
-    """Device upload with copy semantics, verified under GRAFT_SANITIZE=1."""
+def upload_copied(host, sharding=None):
+    """Device upload with copy semantics, verified under GRAFT_SANITIZE=1.
+
+    With `sharding` (a NamedSharding — the resident device mesh, ISSUE 12)
+    the host source is copied BEFORE device_put: per-shard placement on the
+    CPU backend may zero-copy an aligned slice, so the alias target must be
+    a throwaway, never the live snapshot array. The copy is host-side and
+    O(bytes shipped); the engine's row-delta path avoids paying it for
+    untouched shards entirely (mesh.ResidentMesh.update_rows)."""
+    if sharding is not None:
+        import jax as _jax
+        return _jax.device_put(np.array(host), sharding)
     dev = _copy_ctor(host)
     if enabled() and isinstance(host, np.ndarray):
         _assert_no_alias(dev, host)
     return dev
 
 
-def upload_frozen(host):
+def upload_frozen(host, sharding=None):
     """Zero-copy device upload of a host buffer that is IMMUTABLE from this
     point on; sanitize mode seals the source so a violation crashes at the
-    offending write."""
-    dev = jnp.asarray(host)
+    offending write. With `sharding`, placement goes through device_put
+    onto the resident mesh — aliasing stays legal under the same frozen
+    contract (per-shard views of a sealed buffer cannot race)."""
+    if sharding is not None:
+        import jax as _jax
+        dev = _jax.device_put(host, sharding)
+    else:
+        dev = jnp.asarray(host)
     if enabled() and isinstance(host, np.ndarray):
         freeze(host)
     return dev

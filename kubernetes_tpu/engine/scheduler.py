@@ -102,7 +102,8 @@ class Scheduler:
                  record_events: bool = True,
                  batch_mode: str = "wave",
                  policy=None,
-                 now=time.monotonic):
+                 now=time.monotonic,
+                 mesh=None):
         self.api = api
         self.scheduler_name = scheduler_name
         # "wave" = wave-parallel throughput mode (engine/waves.py, default);
@@ -121,10 +122,14 @@ class Scheduler:
             kernel_prios, self._policy_algos = algorithms_from_policy(policy)
             if policy.priorities is not None:
                 priorities = kernel_prios
+        # mesh (ISSUE 12): a 1-D node-axis jax.sharding.Mesh makes every
+        # node-indexed device tensor RESIDENT-SHARDED across its devices
+        # and routes waves_loop through the two-stage SPMD reduce;
+        # placements stay bit-identical to the unsharded engine
         self.engine = SchedulingEngine(
             self.cache, priorities=priorities,
             workloads_provider=lambda: list(self._workloads.values()),
-            policy_algos=self._policy_algos)
+            policy_algos=self._policy_algos, mesh=mesh)
         # this Scheduler owns its cache exclusively and routes every
         # mutation through the engine's dirty notes, so refreshes may take
         # the targeted changed_hint path instead of walking all N nodes

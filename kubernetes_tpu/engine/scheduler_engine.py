@@ -813,11 +813,15 @@ _AFF_SLICE3 = ("aff_allow", "aff_keymask", "anti_keymask", "p_keymask",
 _AFF_SLICE2 = ("forbid_static", "prio_static")
 
 
-def _aff_tail_arrays(adata, snap, cols: np.ndarray):
+def _aff_tail_arrays(adata, snap, cols: np.ndarray, rmesh=None):
     """AffinityData device arrays with every domain axis sliced to the
     tail's column projection, plus the matching `labels_aff` [N, Lp] node
     incidence the scan contracts against (place_batch swaps it in for
-    nodes["labels"] on the affinity side only)."""
+    nodes["labels"] on the affinity side only). With a resident mesh the
+    node-axis members place sharded (mesh.aff_spec), everything else
+    replicated — once per encoding, resident across every tail dispatch."""
+    def _sh(k):
+        return None if rmesh is None else rmesh.aff_sharding(k)
     out = {}
     for k in ("fail_all", "forbid_static", "aff_active", "aff_allow",
               "aff_has_static", "aff_self", "aff_keymask", "anti_active",
@@ -832,9 +836,10 @@ def _aff_tail_arrays(adata, snap, cols: np.ndarray):
         # static-per-encoding host arrays (AffinityData owns them, nothing
         # mutates them after build) — zero-copy is the point; the sanitizer
         # seals the sources so a violation crashes at the offending write
-        out[k] = sanitize.upload_frozen(a)
+        out[k] = sanitize.upload_frozen(a, sharding=_sh(k))
     # advanced indexing already copies, so freezing the fresh row is free
-    out["labels_aff"] = sanitize.upload_frozen(snap.labels[:, cols])
+    out["labels_aff"] = sanitize.upload_frozen(snap.labels[:, cols],
+                                               sharding=_sh("labels_aff"))
     return out
 
 
@@ -1018,7 +1023,7 @@ class SchedulingEngine:
                  priorities: Tuple[Tuple[str, int], ...] = prio.DEFAULT_PRIORITIES,
                  mem_shift: int = 10, workloads_provider=None,
                  hard_pod_affinity_weight: int = 1,
-                 volume_ctx=None, policy_algos=None):
+                 volume_ctx=None, policy_algos=None, mesh=None):
         from kubernetes_tpu.state.volumes import VolumeContext
         self.cache = cache
         self.priorities = priorities
@@ -1026,7 +1031,30 @@ class SchedulingEngine:
         # NodeLabelPresence, NodeLabel, ServiceAntiAffinity) — the
         # CreateFromConfig arguments (ops/policy_algos.py)
         self.policy_algos = policy_algos
-        self.snapshot = ClusterSnapshot(mem_shift=mem_shift)
+        # resident device mesh (ISSUE 12): a 1-D jax.sharding.Mesh whose
+        # axis is the NODE axis. When set, every node-indexed device
+        # buffer this engine owns — the snapshot sync, the wave
+        # encodings' topology views, the committed-occupancy seed — is
+        # uploaded SHARDED across the mesh and stays resident between
+        # waves; waves_loop runs its explicit two-stage SPMD path. A
+        # single-device mesh is meaningless residency — treat as None
+        # (the unsharded engine IS the one-device layout).
+        self.mesh = None
+        self._rmesh = None
+        if mesh is not None and int(mesh.devices.size) > 1:
+            from kubernetes_tpu.parallel.mesh import ResidentMesh
+            self.mesh = mesh
+            self._rmesh = ResidentMesh(mesh)
+            # the node axis pads to a multiple of BOTH the baseline
+            # alignment (8) and the device count so shard_map splits it
+            # evenly on any mesh size (a bare max(8, D) breaks D=3/5/6/7:
+            # N padded to a multiple of 8 need not divide by D)
+            import math
+            self.snapshot = ClusterSnapshot(
+                mem_shift=mem_shift,
+                node_pad=math.lcm(8, int(mesh.devices.size)))
+        else:
+            self.snapshot = ClusterSnapshot(mem_shift=mem_shift)
         # PV/PVC mirror (the pvInfo/pvcInfo listers of factory.go); the
         # owner (Scheduler) mutates it and bumps .version on watch events
         self.volume_ctx = volume_ctx if volume_ctx is not None else VolumeContext()
@@ -1419,11 +1447,26 @@ class SchedulingEngine:
         bitmap to ship — the caller sizes it to cover the highest port in use
         by any node or requested by any batch pod (bucketed, so width changes
         rarely); a cluster with no host ports uploads one zero word per node
-        instead of 8KB."""
+        instead of 8KB.
+
+        With a resident mesh (ISSUE 12) every array uploads SHARDED via the
+        shared spec tables and the dynamic arrays ride the ROW-DELTA path:
+        when the snapshot can name the touched rows (snapshot.dirty_rows —
+        the apply_assume_delta / bulk-writer contract), only the shards
+        owning those rows re-upload; untouched shards keep their existing
+        device buffers by reference. The upload unit is a whole shard, so
+        a micro-wave's assume fold moves O(touched_shards x N/D) rows —
+        a fraction of the full [N, R] mirror whenever the fold doesn't
+        touch every shard (engine.shard_upload_bytes counts the actual
+        traffic)."""
         snap = self.snapshot
         if self._device_nodes is None:
             self._device_nodes = {}
+        rmesh = self._rmesh
+        rows = snap.dirty_rows if rmesh is not None else None
         uploaded = 0
+        delta_used = False
+        delta_bytes = 0
         for k in self._NODE_ARRAY_KEYS:
             if k == "port_bitmap":
                 host = snap.port_bitmap[:, :port_words]
@@ -1438,13 +1481,40 @@ class SchedulingEngine:
                 # asynchronously. The pragma makes GL001 reject any future
                 # jnp.asarray "optimization" here; GRAFT_SANITIZE=1
                 # additionally asserts the upload really did not alias.
-                self._device_nodes[k] = sanitize.upload_copied(  # graftlint: copy-required
-                    np.ascontiguousarray(host) if k == "port_bitmap" else host)
+                # (The mesh path inherits the contract: upload_copied
+                # sharded copies host-side before placement, and
+                # ResidentMesh.update_rows copies each touched slice.)
+                if rmesh is not None:
+                    if rows is not None and cur is not None \
+                            and cur.shape == host.shape \
+                            and k in snap.DYNAMIC:
+                        self._device_nodes[k] = rmesh.update_rows(
+                            cur, host, rows)
+                        delta_used = True
+                        delta_bytes += rmesh.touched_nbytes(host, rows)
+                        continue
+                    self._device_nodes[k] = sanitize.upload_copied(  # graftlint: copy-required
+                        host, sharding=rmesh.node_sharding(k, host.ndim))
+                else:
+                    self._device_nodes[k] = sanitize.upload_copied(  # graftlint: copy-required
+                        np.ascontiguousarray(host)
+                        if k == "port_bitmap" else host)
                 uploaded += 1
-        if uploaded:
+        if uploaded or delta_used:
             from kubernetes_tpu.utils.trace import COUNTERS
-            COUNTERS.inc("engine.device_upload_arrays", uploaded)
+            if uploaded:
+                COUNTERS.inc("engine.device_upload_arrays", uploaded)
+            if delta_used:
+                # DISTINCT rows this sync shipped through the per-shard
+                # delta path (counted once, not once per dynamic array —
+                # comparable to snapshot.assume_delta_rows' per-placement
+                # count), plus the actual bytes moved (whole touched
+                # shards, every dynamic array included)
+                COUNTERS.inc("engine.shard_delta_rows", len(rows))
+                COUNTERS.inc("engine.shard_upload_bytes", delta_bytes)
         snap.dirty.clear()
+        if rmesh is not None:
+            snap.dirty_rows = set()  # arm row tracking for the next sync
         self._device_version = snap.version
         return self._device_nodes
 
@@ -1685,7 +1755,9 @@ class SchedulingEngine:
             enc.aff_patch_dirty = True
         if enc.tail_cols is not None and enc.aff_tail_dev is not None:
             enc.aff_tail_dev["labels_aff"] = sanitize.upload_frozen(
-                snap.labels[:, enc.tail_cols])
+                snap.labels[:, enc.tail_cols],
+                sharding=None if self._rmesh is None
+                else self._rmesh.aff_sharding("labels_aff"))
         enc.labels_gen = snap.labels_gen
         COUNTERS.inc("engine.label_patch_rows", len(rows))
         return True
@@ -1697,20 +1769,26 @@ class SchedulingEngine:
         mutating patch over patch)."""
         if not enc.aff_patch_dirty:
             return
+
+        def _sh(k):
+            return None if self._rmesh is None \
+                else self._rmesh.aff_sharding(k)
         if enc.aff_wave_dev is not None:
             merged = enc.static_forbid_hit.astype(np.int32)
             if enc.foreign_forbid is not None:
                 merged = merged + enc.foreign_forbid
             enc.aff_wave_dev["static_forbid"] = sanitize.upload_frozen(
-                np.minimum(merged, 127).astype(np.int8))
+                np.minimum(merged, 127).astype(np.int8),
+                sharding=_sh("static_forbid"))
             enc.aff_wave_dev["key_node"] = sanitize.upload_frozen(
-                enc.key_node.copy())
+                enc.key_node.copy(), sharding=_sh("key_node"))
         if enc.aff_tail_dev is not None and enc.tail_cols is not None:
             base = enc.adata.forbid_static[:, enc.tail_cols].astype(np.int32)
             if enc.foreign_forbid_dom is not None:
                 base = base + enc.foreign_forbid_dom
             enc.aff_tail_dev["forbid_static"] = sanitize.upload_frozen(
-                np.minimum(base, 127).astype(np.int8))
+                np.minimum(base, 127).astype(np.int8),
+                sharding=_sh("forbid_static"))
         enc.aff_patch_dirty = False
 
     def _wave_encoding(self, pods: Sequence[Pod], infos):
@@ -1814,17 +1892,26 @@ class SchedulingEngine:
                 has_aff_pod[c] = _has_affinity(rep)
             if fits_on:
                 key_node, static_forbid_hit = _aff_node_views(adata, snap)
-                # static per encoding — frozen-alias seam, like the tail
+
+                def _sh(k):
+                    return None if self._rmesh is None \
+                        else self._rmesh.aff_sharding(k)
+                # static per encoding — frozen-alias seam, like the tail;
+                # node-axis members shard over the resident mesh
                 aff_wave_dev = {
-                    "m_anti": sanitize.upload_frozen(adata.m_anti),
-                    "key_node": sanitize.upload_frozen(key_node),
+                    "m_anti": sanitize.upload_frozen(adata.m_anti,
+                                                     sharding=_sh("m_anti")),
+                    "key_node": sanitize.upload_frozen(
+                        key_node, sharding=_sh("key_node")),
                     "static_forbid": sanitize.upload_frozen(
-                        static_forbid_hit),
-                    "wave_gate": sanitize.upload_frozen(adata.wave_gate),
+                        static_forbid_hit, sharding=_sh("static_forbid")),
+                    "wave_gate": sanitize.upload_frozen(
+                        adata.wave_gate, sharding=_sh("wave_gate")),
                 }
             if fits_on or prio_on:
                 tail_cols = _aff_tail_cols(adata, prio_on)
-                aff_tail_dev = _aff_tail_arrays(adata, snap, tail_cols)
+                aff_tail_dev = _aff_tail_arrays(adata, snap, tail_cols,
+                                                rmesh=self._rmesh)
         COUNTERS.inc("engine.wave_encode_build")
         cls_arr = pod_arrays_padded(rb, c_pad)
         key_index = {pod_class_key(rep): c
@@ -1938,14 +2025,17 @@ class SchedulingEngine:
                 # copy-required contract + the class-scoped alias check
                 # both reject a jnp.asarray regression here.
                 committed_dev = sanitize.upload_copied(  # graftlint: copy-required
-                    enc.committed_nodes)
+                    enc.committed_nodes,
+                    sharding=None if self._rmesh is None
+                    else self._rmesh.committed_sharding())
                 packed, state_out, committed_out = waves.waves_loop(
                     enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
                     self._kernel_priorities(), 64, extra_score=extra,
                     aff=enc.aff_wave_dev,
                     committed0=committed_dev,
                     active0=jnp.asarray(act),
-                    pre=self._tail_wave_pre(enc, nodes))
+                    pre=self._tail_wave_pre(enc, nodes),
+                    spmd_mesh=self.mesh)
                 if strict_idx.size:
                     COUNTERS.inc("engine.affinity_strict_tail",
                                  int(strict_idx.size))
@@ -1953,7 +2043,8 @@ class SchedulingEngine:
                 packed, state_out = waves.waves_loop(
                     enc.cls_arr, nodes, state, jnp.asarray(pc_pad), counter,
                     self._kernel_priorities(), 64, extra_score=extra,
-                    pre=self._tail_wave_pre(enc, nodes))
+                    pre=self._tail_wave_pre(enc, nodes),
+                    spmd_mesh=self.mesh)
             counter_out = packed[3 * p_pad].astype(jnp.uint32)
             self._rr_chain = counter_out
             blind: set = set()
@@ -2005,6 +2096,21 @@ class SchedulingEngine:
             # wave's device wait while the NEXT wave already runs
             packed_h = np.asarray(handle.packed)  # graftlint: sync-ok
         t_block = _time.perf_counter() - t0
+        # the per-wave device->host payload: [3P+2] int32 regardless of N —
+        # the scale_sweep's proof that harvesting never fetches node-axis
+        # tensors (the winner reduce already collapsed them on device)
+        COUNTERS.inc("engine.host_fetch_bytes", int(packed_h.nbytes))
+        if self.mesh is not None:
+            # structural traffic accounting for the two-stage winner
+            # reduce (ISSUE 12): each INNER wave iteration's cross-shard
+            # stage moves the [D, C] tie-count table + O(P) candidate
+            # combines — scale by waves_used (packed[3P+1]), not per
+            # dispatch, so the counter states actual cross-device traffic.
+            # The bench reads this against the O(N) rows a single-device
+            # gather would have moved.
+            COUNTERS.inc("engine.reduce_candidate_rows",
+                         int(self.mesh.devices.size) * handle.enc.c_pad
+                         * int(packed_h[3 * p_pad + 1]))
         sel = packed_h[:n].copy()
         fc = packed_h[p_pad:p_pad + n].copy()
         act = packed_h[2 * p_pad:2 * p_pad + n].astype(bool)

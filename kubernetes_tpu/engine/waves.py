@@ -68,6 +68,140 @@ Arrays = Dict[str, jnp.ndarray]
 _BIG = np.int32(2 ** 31 - 1)
 
 
+# --------------------------------------------------------------------------
+# node-axis collectives (ISSUE 12): every cross-node-axis operation in the
+# wave body — row reductions, the winner tie-selection, per-row gathers,
+# commit scatters — goes through ONE of these vtables so the single-device
+# trace stays byte-for-byte what it always was while the sharded trace
+# (waves_loop's spmd_mesh path, run under shard_map) becomes an explicit
+# TWO-STAGE reduce: local per-shard work over N/D rows, then a tiny
+# cross-device combine over n_devices candidates. No step ever gathers a
+# full-N tensor to one device; the only cross-device payloads are [D, C]
+# tie counts (all_gather), [C]/[P] psum/pmax combines, and the O(P)
+# ownership-masked candidate sums.
+# --------------------------------------------------------------------------
+
+
+class _GlobalCol:
+    """Whole-node-axis implementation — the ops exactly as the unsharded
+    wave body always wrote them (bit-identity anchor for the A/B)."""
+
+    spmd = False
+
+    def __init__(self, n_global: int):
+        self.n_global = n_global   # GLOBAL node-id sentinel bound
+        self.n_local = n_global    # scatter width (== global here)
+
+    def row_sum(self, x):
+        return x.sum(axis=1)
+
+    def row_max(self, x, keepdims=False):
+        return x.max(axis=1, keepdims=keepdims)
+
+    def first_fit(self, fits):
+        """Global index of each class's first fitting node."""
+        return jnp.argmax(fits, axis=1).astype(jnp.int32)
+
+    def tie_select(self, ties, pod_class, kz):
+        """Node index of the kz-th tie (ascending node order) of each
+        pod's class — the RR fan-out lookup."""
+        n = ties.shape[1]
+        idx_n = jnp.arange(n, dtype=jnp.int32)
+        rank = jnp.cumsum(ties.astype(jnp.int32), axis=1) - 1
+        cols = jnp.where(ties, rank, n)
+        rows = jnp.broadcast_to(jnp.arange(ties.shape[0])[:, None],
+                                ties.shape)
+        tiemat = jnp.zeros(ties.shape, dtype=jnp.int32).at[rows, cols].set(
+            jnp.broadcast_to(idx_n[None, :], ties.shape), mode="drop")
+        return tiemat[pod_class, kz]
+
+    def take_rows(self, arr, idx):
+        """arr[idx] for node-axis-0 arrays, idx = global node ids >= 0."""
+        return arr[idx]
+
+    def take2(self, arr, rows, cols):
+        """arr[rows, cols] for [C, N] arrays, cols = global node ids."""
+        return arr[rows, cols]
+
+    def to_local(self, ids):
+        """Scatter ids: global node id, or -1 -> the drop sentinel."""
+        return jnp.where(ids < 0, jnp.int32(self.n_global), ids)
+
+
+class _ShardCol:
+    """Per-shard implementation, legal only inside shard_map over the node
+    axis: shard d owns global rows [d*Nl, (d+1)*Nl). Reductions are local
+    + psum/pmax; the tie lookup resolves ownership from an all-gathered
+    [D, C] tie-count table (the O(n_devices) candidate traffic the bench
+    counter reports); gathers/scatters translate global ids to local rows
+    and drop the rest — each commit row is written by exactly ONE shard."""
+
+    spmd = True
+
+    def __init__(self, axis: str, n_global: int, n_local: int):
+        self.axis = axis
+        self.n_global = n_global
+        self.n_local = n_local
+
+    def _off(self):
+        return (lax.axis_index(self.axis) * self.n_local).astype(jnp.int32)
+
+    def row_sum(self, x):
+        return lax.psum(x.sum(axis=1), self.axis)
+
+    def row_max(self, x, keepdims=False):
+        m = lax.pmax(x.max(axis=1), self.axis)
+        return m[:, None] if keepdims else m
+
+    def first_fit(self, fits):
+        local = jnp.where(
+            fits.any(axis=1),
+            self._off() + jnp.argmax(fits, axis=1).astype(jnp.int32),
+            _BIG)
+        return lax.pmin(local, self.axis)
+
+    def tie_select(self, ties, pod_class, kz):
+        nl = ties.shape[1]
+        off = self._off()
+        m_l = ties.sum(axis=1).astype(jnp.int32)            # [C] local
+        m_all = lax.all_gather(m_l, self.axis)              # [D, C] tiny
+        prefix = jnp.cumsum(m_all, axis=0) - m_all          # exclusive
+        my_prefix = prefix[lax.axis_index(self.axis)]       # [C]
+        rank = jnp.cumsum(ties.astype(jnp.int32), axis=1) - 1
+        cols = jnp.where(ties, rank, nl)
+        rows = jnp.broadcast_to(jnp.arange(ties.shape[0])[:, None],
+                                ties.shape)
+        idx_n = off + jnp.arange(nl, dtype=jnp.int32)       # GLOBAL ids
+        tiemat_l = jnp.zeros(ties.shape, dtype=jnp.int32).at[
+            rows, cols].set(jnp.broadcast_to(idx_n[None, :], ties.shape),
+                            mode="drop")
+        lr = kz - my_prefix[pod_class]                      # local rank
+        owned = (lr >= 0) & (lr < m_l[pod_class])
+        cand = jnp.where(owned,
+                         tiemat_l[pod_class, jnp.clip(lr, 0, nl - 1)], 0)
+        return lax.psum(cand, self.axis)                    # [P] combine
+
+    def take_rows(self, arr, idx):
+        nl = arr.shape[0]
+        loc = idx - self._off()
+        ok = (loc >= 0) & (loc < nl)
+        vals = arr[jnp.clip(loc, 0, nl - 1)]
+        mask = ok.reshape(ok.shape + (1,) * (arr.ndim - 1))
+        return lax.psum(jnp.where(mask, vals, 0), self.axis)
+
+    def take2(self, arr, rows, cols):
+        nl = arr.shape[1]
+        loc = cols - self._off()
+        ok = (loc >= 0) & (loc < nl)
+        vals = arr[rows, jnp.clip(loc, 0, nl - 1)]
+        return lax.psum(jnp.where(ok, vals, 0), self.axis)
+
+    def to_local(self, ids):
+        loc = ids - self._off()
+        return jnp.where((ids >= 0) & (loc >= 0) & (loc < self.n_local),
+                         loc, jnp.int32(self.n_local))
+
+
 def _dynamic_fits(cls: Arrays, nodes: Arrays, state: NodeState) -> jnp.ndarray:
     """Capacity-dependent predicate chain vs the wave's frozen state, [C,N].
     Same math as ops/predicates.fits but reading the evolving NodeState."""
@@ -137,9 +271,14 @@ precompute_jit = jax.jit(precompute, static_argnames=("priorities",))
 
 def _wave_scores(cls: Arrays, nodes: Arrays, state: NodeState,
                  pre: Arrays, fits: jnp.ndarray,
-                 priorities: Tuple[Tuple[str, int], ...]) -> jnp.ndarray:
+                 priorities: Tuple[Tuple[str, int], ...],
+                 col=None) -> jnp.ndarray:
     """Weighted priority sum [C,N] against the frozen state; identical
-    per-node integer formulas as the strict path (batch._step_scores)."""
+    per-node integer formulas as the strict path (batch._step_scores).
+    `col` carries the node-axis reductions (the reduce-priority maxima) so
+    the sharded trace reduces two-stage (ISSUE 12)."""
+    if col is None:
+        col = _GlobalCol(nodes["alloc"].shape[0])
     total = pre["static_score"]
     alloc = nodes["alloc"]
     for name, weight in priorities:
@@ -152,13 +291,13 @@ def _wave_scores(cls: Arrays, nodes: Arrays, state: NodeState,
         elif name == "TaintTolerationPriority":
             cnt = pre["tt_cnt"]
             masked = jnp.where(fits, cnt, 0)
-            mx = masked.max(axis=1, keepdims=True)
+            mx = col.row_max(masked, keepdims=True)
             s = jnp.where(mx == 0, MAX_PRIORITY,
                           (MAX_PRIORITY * (mx - cnt)) // jnp.maximum(mx, 1))
         elif name == "NodeAffinityPriority":
             cnt = pre["na_cnt"]
             masked = jnp.where(fits, cnt, 0)
-            mx = masked.max(axis=1, keepdims=True)
+            mx = col.row_max(masked, keepdims=True)
             s = jnp.where(mx > 0, (MAX_PRIORITY * cnt) // jnp.maximum(mx, 1), 0)
         else:  # static and host-only priorities are in pre["static_score"]
             continue
@@ -264,6 +403,7 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
                priorities: Tuple[Tuple[str, int], ...],
                aff: Arrays = None,
                committed: jnp.ndarray = None,
+               col=None,
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                           NodeState, jnp.ndarray, jnp.ndarray]:
     """One wave (pure traceable body — jitted standalone as wave_step and
@@ -271,12 +411,17 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     state-independent tensors (see precompute). With `aff` given, the
     required-anti mask is re-evaluated against the per-node occupancy
     carry each wave and commits update it (the on-device topology
-    AssumePod — ISSUE 3). Returns (selected [P] (-1 = no fit), accepted
-    [P] bool, fit_count [P] int32, new state, new counter, new committed)."""
+    AssumePod — ISSUE 3). `col` is the node-axis collectives vtable
+    (ISSUE 12): _GlobalCol preserves the single-device trace exactly;
+    _ShardCol (inside waves_loop's shard_map) makes every node-axis
+    reduction/gather/scatter a two-stage per-shard form. Returns
+    (selected [P] (-1 = no fit), accepted [P] bool, fit_count [P] int32,
+    new state, new counter, new committed). `selected` always carries
+    GLOBAL node indices, whichever col runs."""
     P = pod_class.shape[0]
-    N = nodes["alloc"].shape[0]
+    if col is None:
+        col = _GlobalCol(nodes["alloc"].shape[0])
     iota = jnp.arange(P, dtype=jnp.int32)
-    idx_n = jnp.arange(N, dtype=jnp.int32)
 
     # conditions fresh per dispatch (NOT from pre): the cached precompute
     # survives node kills/flaps/cordons/respawns since ISSUE 8, so the
@@ -285,18 +430,12 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
         & _dynamic_fits(cls, nodes, state)  # [C,N]
     if aff is not None:
         fits = fits & _wave_aff_mask(aff, committed)
-    fitcnt = fits.sum(axis=1).astype(jnp.int32)  # [C]
-    scores = _wave_scores(cls, nodes, state, pre, fits, priorities)
+    fitcnt = col.row_sum(fits).astype(jnp.int32)  # [C]
+    scores = _wave_scores(cls, nodes, state, pre, fits, priorities, col=col)
     masked = jnp.where(fits, scores, jnp.int32(-1))
-    best = masked.max(axis=1, keepdims=True)
+    best = col.row_max(masked, keepdims=True)
     ties = (masked == best) & fits  # [C,N]
-    m = ties.sum(axis=1).astype(jnp.int32)  # [C]
-    # tiemat[c, r] = node index of the r-th tie (ascending node order)
-    rank = jnp.cumsum(ties.astype(jnp.int32), axis=1) - 1
-    cols = jnp.where(ties, rank, N)
-    rows = jnp.broadcast_to(jnp.arange(ties.shape[0])[:, None], ties.shape)
-    tiemat = jnp.zeros(ties.shape, dtype=jnp.int32).at[rows, cols].set(
-        jnp.broadcast_to(idx_n[None, :], ties.shape), mode="drop")
+    m = col.row_sum(ties).astype(jnp.int32)  # [C] global tie count
 
     fc = fitcnt[pod_class]  # [P]
     # FIFO draw from the shared RR counter (selectHost counter discipline)
@@ -305,15 +444,18 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
         - multi.astype(jnp.int32)
     mz = jnp.maximum(m[pod_class], 1)
     kz = (draw % mz).astype(jnp.int32)
-    sel_multi = tiemat[pod_class, kz]
-    sel_single = jnp.argmax(fits, axis=1).astype(jnp.int32)[pod_class]
+    # the winner reduce: kz-th tie of each pod's class, ascending node
+    # order (local rank + cross-shard prefix under _ShardCol)
+    sel_multi = col.tie_select(ties, pod_class, kz)
+    sel_single = col.first_fit(fits)[pod_class]
     sel = jnp.where(~active | (fc == 0), jnp.int32(-1),
                     jnp.where(fc == 1, sel_single, sel_multi))
     new_counter = counter + multi.sum().astype(jnp.uint32)
 
     # ---- per-node FIFO conflict resolution --------------------------------
     placeable = sel >= 0
-    key = jnp.where(placeable, sel, N) * P + iota  # unique, segment-sorted
+    key = jnp.where(placeable, sel, col.n_global) * P + iota  # unique,
+    # segment-sorted
     order = jnp.argsort(key)
     s_sel = sel[order]
     s_class = pod_class[order]
@@ -327,7 +469,7 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     same_run = (same_run - same_run[bs]) == 0  # prefix run of first class
     cap = _class_capacity(cls, nodes, state)  # [C,N]
     safe_sel = jnp.maximum(s_sel, 0)
-    cap_lim = jnp.minimum(cap[s_class, safe_sel], K_WAVE)
+    cap_lim = jnp.minimum(col.take2(cap, s_class, safe_sel), K_WAVE)
     special_cls = ((cls["ports"][:, 0] >= 0)
                    | (cls["vol_hard"].sum(axis=1) + cls["vol_ro"].sum(axis=1)
                       + cls["pd_req"].sum(axis=1) > 0))
@@ -343,18 +485,18 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     # >= the frozen runner-up (max score over non-tie nodes). Overflow-safe:
     # r_eff*nz is bounded either by cap (r*req <= alloc per resources_fit)
     # or by K_WAVE * the nonzero defaults (~8.4e8 < 2^31).
-    thr = jnp.where(ties, jnp.int32(-1), masked).max(axis=1)  # [C]
+    thr = col.row_max(jnp.where(ties, jnp.int32(-1), masked))  # [C]
     r_eff = jnp.minimum(rank_in_seg, cap_lim)
     nz_z = cls["nonzero"][s_class]  # [P,2]
-    nz_node = state.nonzero[safe_sel]
-    alloc_rows = nodes["alloc"][safe_sel]
+    nz_node = col.take_rows(state.nonzero, safe_sel)
+    alloc_rows = col.take_rows(nodes["alloc"], safe_sel)
     tot0 = nz_node + nz_z
     tot_r = nz_node + (r_eff[:, None] + 1) * nz_z
     dyn0 = _dyn_at(tot0[:, 0], tot0[:, 1], alloc_rows[:, 0], alloc_rows[:, 1],
                    priorities)
     dyn_r = _dyn_at(tot_r[:, 0], tot_r[:, 1], alloc_rows[:, 0],
                     alloc_rows[:, 1], priorities)
-    score_r = masked[s_class, safe_sel] - dyn0 + dyn_r
+    score_r = col.take2(masked, s_class, safe_sel) - dyn0 + dyn_r
     acc_core = (s_place & same_run & (rank_in_seg < cap_lim)
                 & (~special | (rank_in_seg == 0))
                 & ((rank_in_seg == 0) | (score_r >= thr[s_class])))
@@ -367,20 +509,24 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     accepted = jnp.zeros(P, dtype=bool).at[order].set(acc_s)
 
     # ---- commit (batched AssumePod) ---------------------------------------
-    seg_ids = jnp.where(acc_s, s_sel, N)
+    # scatter ids translate to LOCAL rows under _ShardCol (drop sentinel =
+    # local width): each accepted row lands on exactly the shard owning its
+    # node — the "one shard written per commit" half of the delta story
+    nl = col.n_local
+    seg_ids = col.to_local(jnp.where(acc_s, s_sel, -1))
     gain = acc_s.astype(jnp.int32)
     add_req = jax.ops.segment_sum(cls["req"][s_class] * gain[:, None],
-                                  seg_ids, num_segments=N + 1)[:N]
+                                  seg_ids, num_segments=nl + 1)[:nl]
     add_nz = jax.ops.segment_sum(cls["nonzero"][s_class] * gain[:, None],
-                                 seg_ids, num_segments=N + 1)[:N]
-    add_cnt = jax.ops.segment_sum(gain, seg_ids, num_segments=N + 1)[:N]
+                                 seg_ids, num_segments=nl + 1)[:nl]
+    add_cnt = jax.ops.segment_sum(gain, seg_ids, num_segments=nl + 1)[:nl]
     requested = state.requested + add_req
     nonzero = state.nonzero + add_nz
     pod_count = state.pod_count + add_cnt
     # specials: at most one accepted per node -> direct batched scatters
     sp = acc_s & special
     sp_gain = sp.astype(jnp.int32)
-    sp_sel = jnp.where(sp, s_sel, N)
+    sp_sel = col.to_local(jnp.where(sp, s_sel, -1))
     ports = cls["ports"][s_class]  # [P,8]
     want = (ports >= 0) & sp[:, None]
     wsafe = jnp.maximum(ports, 0)
@@ -388,7 +534,7 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     bits = jnp.where(want, jnp.uint32(1) << (wsafe % 32).astype(jnp.uint32),
                      jnp.uint32(0))
     port_bitmap = state.port_bitmap.at[
-        jnp.where(sp, s_sel, N)[:, None], words].add(bits, mode="drop")
+        sp_sel[:, None], words].add(bits, mode="drop")
     vh = cls["vol_hard"][s_class]
     vr = cls["vol_ro"][s_class]
     pdq = cls["pd_req"][s_class]
@@ -402,7 +548,8 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
     for k in range(3):
         req_k = pdq * nodes["pd_kind"][k][None, :]
         overlap = jnp.einsum("pv,pv->p", req_k.astype(jnp.int32),
-                             state.pd_present[safe_sel].astype(jnp.int32))
+                             col.take_rows(state.pd_present,
+                                           safe_sel).astype(jnp.int32))
         pd_new.append(cls["pd_req_count"][s_class, k] - overlap)
     pd_counts = state.pd_counts.at[sp_sel].add(
         jnp.stack(pd_new, axis=1) * sp_gain[:, None], mode="drop")
@@ -414,9 +561,10 @@ def _wave_once(cls: Arrays, nodes: Arrays, state: NodeState,
         # node) cell, making it visible to the NEXT wave's mask (and to
         # the seeded strict tail / harvest fence afterwards). Scatter-add
         # accumulates duplicate (class, node) pairs; rejected rows land on
-        # the dropped N column.
+        # the dropped column.
         committed = committed.at[
-            s_class, jnp.where(acc_s, s_sel, N)].add(gain, mode="drop")
+            s_class, col.to_local(jnp.where(acc_s, s_sel, -1))].add(
+                gain, mode="drop")
     return sel, accepted, fc, new_state, new_counter, committed
 
 
@@ -426,6 +574,40 @@ def wave_step(cls, nodes, state, pod_class, active, counter, priorities):
     pre = precompute(cls, nodes, priorities)
     return _wave_once(cls, nodes, state, pre, pod_class, active, counter,
                       priorities)[:5]
+
+
+def _waves_loop_inner(cls, nodes, state, pod_class, counter, pre,
+                      committed0, active0, aff, priorities, max_waves, col):
+    """The wave iteration proper — shared verbatim by the single-program
+    path and the shard_map SPMD path (the `col` vtable is the only
+    difference). Returns (packed, state, committed)."""
+    P = pod_class.shape[0]
+
+    def cond(carry):
+        _, active, _, _, _, _, w = carry
+        return (w < max_waves) & active.any()
+
+    def body(carry):
+        state, active, counter, fsel, ffc, committed, w = carry
+        sel, accepted, fc, state2, counter2, committed2 = _wave_once(
+            cls, nodes, state, pre, pod_class, active, counter, priorities,
+            aff=aff, committed=committed, col=col)
+        if aff is None:
+            committed2 = committed
+        placed = active & accepted
+        fsel = jnp.where(placed, sel, fsel)
+        ffc = jnp.where(active, fc, ffc)
+        active2 = active & ~accepted & (sel >= 0)
+        return (state2, active2, counter2, fsel, ffc, committed2, w + 1)
+
+    init = (state, active0, counter,
+            jnp.full(P, -1, dtype=jnp.int32), jnp.zeros(P, dtype=jnp.int32),
+            committed0, jnp.int32(0))
+    (state, active, counter, fsel, ffc, committed, w) = \
+        lax.while_loop(cond, body, init)
+    packed = jnp.concatenate([fsel, ffc, active.astype(jnp.int32),
+                              counter.astype(jnp.int32)[None], w[None]])
+    return packed, state, committed
 
 
 @functools.partial(jax.jit, static_argnames=("weights",))
@@ -464,7 +646,8 @@ def frozen_affinity_scores(cls: Arrays, nodes: Arrays, state: NodeState,
     return extra
 
 
-@functools.partial(jax.jit, static_argnames=("priorities", "max_waves"))
+@functools.partial(jax.jit,
+                   static_argnames=("priorities", "max_waves", "spmd_mesh"))
 def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
                pod_class: jnp.ndarray, counter: jnp.ndarray,
                priorities: Tuple[Tuple[str, int], ...],
@@ -474,6 +657,7 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
                committed0: jnp.ndarray = None,
                active0: jnp.ndarray = None,
                pre: Arrays = None,
+               spmd_mesh=None,
                ) -> Union[Tuple[jnp.ndarray, NodeState],
                           Tuple[jnp.ndarray, NodeState, jnp.ndarray]]:
     """The whole wave iteration as ONE device program (lax.while_loop over
@@ -487,6 +671,14 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
     occupancy commit run inside the loop; active0 masks out pods routed to
     the seeded strict tail (AffinityData.wave_strict) — they exit with
     selected = -1 and still_active = 0 and the harvest places them.
+
+    With `spmd_mesh` (a jax.sharding.Mesh whose one axis is the node
+    axis — ISSUE 12), the WHOLE loop runs under shard_map: every
+    node-axis tensor stays resident on its shard, the winner selection is
+    the explicit two-stage reduce of _ShardCol, and commits write exactly
+    the shard owning each node. Placements are bit-identical to the
+    single-program run (the vtable swaps op implementations, never
+    semantics); pass None (default) everywhere a mesh is not resident.
 
     Returns (packed, final state[, committed]) with packed =
     [selected(P), fit_count(P), still_active(P), counter, waves_used];
@@ -504,36 +696,77 @@ def waves_loop(cls: Arrays, nodes: Arrays, state: NodeState,
         committed0 = committed0.astype(jnp.int32)
     else:  # inert carry keeps ONE loop structure for both trace variants
         committed0 = jnp.zeros((1, 1), dtype=jnp.int32)
-
-    def cond(carry):
-        _, active, _, _, _, _, w = carry
-        return (w < max_waves) & active.any()
-
-    def body(carry):
-        state, active, counter, fsel, ffc, committed, w = carry
-        sel, accepted, fc, state2, counter2, committed2 = _wave_once(
-            cls, nodes, state, pre, pod_class, active, counter, priorities,
-            aff=aff, committed=committed)
-        if aff is None:
-            committed2 = committed
-        placed = active & accepted
-        fsel = jnp.where(placed, sel, fsel)
-        ffc = jnp.where(active, fc, ffc)
-        active2 = active & ~accepted & (sel >= 0)
-        return (state2, active2, counter2, fsel, ffc, committed2, w + 1)
-
-    init = (state,
-            jnp.ones(P, dtype=bool) if active0 is None else active0,
-            counter,
-            jnp.full(P, -1, dtype=jnp.int32), jnp.zeros(P, dtype=jnp.int32),
-            committed0, jnp.int32(0))
-    (state, active, counter, fsel, ffc, committed, w) = \
-        lax.while_loop(cond, body, init)
-    packed = jnp.concatenate([fsel, ffc, active.astype(jnp.int32),
-                              counter.astype(jnp.int32)[None], w[None]])
+    if active0 is None:
+        active0 = jnp.ones(P, dtype=bool)
+    n_global = nodes["alloc"].shape[0]
+    if spmd_mesh is None:
+        col = _GlobalCol(n_global)
+        packed, state, committed = _waves_loop_inner(
+            cls, nodes, state, pod_class, counter, pre, committed0,
+            active0, aff, priorities, max_waves, col)
+    else:
+        packed, state, committed = _waves_loop_spmd(
+            cls, nodes, state, pod_class, counter, pre, committed0,
+            active0, aff, priorities, max_waves, spmd_mesh)
     if aff is None:
         return packed, state
     return packed, state, committed
+
+
+def _waves_loop_spmd(cls, nodes, state, pod_class, counter, pre,
+                     committed0, active0, aff, priorities, max_waves,
+                     mesh):
+    """waves_loop's shard_map wrapper: node-axis operands enter sharded
+    (specs from parallel/mesh's shared tables), pod-side operands enter
+    replicated, and _waves_loop_inner runs per shard with _ShardCol
+    supplying the cross-device stages. check_rep is off: the replication
+    checker cannot see through the ownership-masked psum combines, but
+    every P()-spec output is replicated by construction (psum/pmax
+    results and replicated-input math only)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as PS
+
+    from kubernetes_tpu.parallel.mesh import (
+        _NODE_SHARDED_KEYS,
+        aff_spec,
+    )
+
+    axis = mesh.axis_names[0]
+    n_global = nodes["alloc"].shape[0]
+    n_dev = int(mesh.devices.size)
+    col = _ShardCol(axis, n_global, n_global // n_dev)
+    node_sp = PS(axis)
+    rep = PS()
+
+    def nspec(k):
+        return node_sp if k in _NODE_SHARDED_KEYS else rep
+
+    nodes_spec = {k: nspec(k) for k in nodes}
+    state_spec = NodeState(*([node_sp] * len(state)))
+    pre_spec = {k: PS(None, axis) for k in pre}
+    cls_spec = {k: rep for k in cls}
+    comm_spec = PS(None, axis) if aff is not None else rep
+    args = [cls, nodes, state, pod_class, counter, committed0, active0]
+    in_specs = [cls_spec, nodes_spec, state_spec, rep, rep, comm_spec, rep]
+    # pre/aff ride as operands (shard_map forbids closed-over tracers)
+    args.append(pre)
+    in_specs.append(pre_spec)
+    has_aff = aff is not None
+    if has_aff:
+        args.append(aff)
+        in_specs.append({k: aff_spec(k) for k in aff})
+
+    def inner(cls_, nodes_, state_, pc_, ctr_, comm_, act_, pre_,
+              *maybe_aff):
+        aff_ = maybe_aff[0] if maybe_aff else None
+        return _waves_loop_inner(cls_, nodes_, state_, pc_, ctr_, pre_,
+                                 comm_, act_, aff_, priorities, max_waves,
+                                 col)
+
+    return shard_map(inner, mesh=mesh,
+                     in_specs=tuple(in_specs),
+                     out_specs=(rep, state_spec, comm_spec),
+                     check_rep=False)(*args)
 
 
 @functools.partial(jax.jit, static_argnames=("priorities", "aff_mode"))

@@ -10,6 +10,7 @@ of BASELINE.json's five configs, loaded through the apiserver-lite.
 
 from __future__ import annotations
 
+import copy
 import random
 from typing import Dict, List, Optional, Tuple
 
@@ -35,10 +36,68 @@ Gi = 1024 * Mi
 ZONES = ["zone-a", "zone-b", "zone-c"]
 
 
+# ------------------------------------------------------------- columnar
+# ISSUE 12: at 50k nodes / 300k pods the per-object constructor path
+# (make_pod -> Container -> Pod, ~20us each) costs seconds of pure
+# setup per sweep point — enough to drown the measurement it feeds. The
+# bulk builders go columnar: ONE template object per distinct spec,
+# then a tight shallow-copy materialization per name. The templates'
+# spec members (containers, tolerations, condition lists) are shared —
+# every consumer treats pod/node SPEC as immutable (the churn harness
+# rebuilds via dataclasses.replace; schedulers write only node_name /
+# annotations, which each copy owns fresh).
+
+
+def _stamp(p: Pod, name: str, prefix: str,
+           labels: Optional[Dict[str, str]] = None) -> Pod:
+    """Fresh per-pod identity on a shallow template copy (name, uid,
+    labels, annotations); spec members stay shared with the template.
+    The '_class_key' pop is LOAD-BEARING: a copied template would
+    otherwise keep the template's memoized class key and silently
+    misclassify every pod of the profile."""
+    p.name = name
+    p.uid = prefix + name
+    p.labels = {} if labels is None else labels
+    p.annotations = {}
+    p.__dict__.pop("_class_key", None)
+    return p
+
+
+def _materialize_pods(template: Pod, names: List[str], namespace: str,
+                      labels: Optional[List[Dict[str, str]]] = None
+                      ) -> List[Pod]:
+    """Shallow-copy `template` per name; per-pod identity fields (name,
+    uid, labels, annotations) are fresh, spec members shared."""
+    prefix = namespace + "/"
+    cc = copy.copy
+    return [_stamp(cc(template), nm, prefix,
+                   labels[i] if labels is not None else None)
+            for i, nm in enumerate(names)]
+
+
 def hollow_nodes(n: int, seed: int = 0, heterogeneous: bool = False,
                  gpu_fraction: float = 0.0, taint_fraction: float = 0.0
                  ) -> List[Node]:
-    """scheduler_perf node shape by default (scheduler_test.go:49-68)."""
+    """scheduler_perf node shape by default (scheduler_test.go:49-68).
+    The homogeneous no-gpu/no-taint shape (every scale sweep point)
+    materializes from one template columnar-style; heterogeneous/gpu/
+    tainted clusters keep the per-node constructor (seeded rng per
+    node — identical output to every prior round)."""
+    if not heterogeneous and gpu_fraction == 0.0 and taint_fraction == 0.0:
+        template = make_node("hollow-node-0", cpu=4000, memory=32 * Gi,
+                             pods=110)
+        out: List[Node] = []
+        cc = copy.copy
+        for i in range(n):
+            node = cc(template)
+            node.name = f"hollow-node-{i}"
+            node.labels = {
+                "kubernetes.io/hostname": node.name,
+                "failure-domain.beta.kubernetes.io/zone":
+                    ZONES[i % len(ZONES)],
+            }
+            out.append(node)
+        return out
     rng = random.Random(seed)
     nodes = []
     for i in range(n):
@@ -67,21 +126,28 @@ def hollow_nodes(n: int, seed: int = 0, heterogeneous: bool = False,
 def density_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
     """Config 1: uniform small pods (the 'nginx' density workload —
     scheduler_perf creates pods with no requests; we give them the classic
-    100m/500Mi shape so bin-packing is exercised)."""
-    return [make_pod(f"density-{i}", namespace=namespace, cpu=100, memory=500 * Mi)
-            for i in range(n)]
+    100m/500Mi shape so bin-packing is exercised). Columnar: one spec
+    template, shallow-copied per name."""
+    template = make_pod("density-0", namespace=namespace, cpu=100,
+                        memory=500 * Mi)
+    return _materialize_pods(template, [f"density-{i}" for i in range(n)],
+                             namespace)
 
 
 def binpack_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
-    """Config 2: mixed-size pods for PodFitsResources + BalancedResourceAllocation."""
+    """Config 2: mixed-size pods for PodFitsResources + BalancedResourceAllocation.
+    Columnar: one template per shape, rng draws the shape sequence only."""
     rng = random.Random(seed)
     shapes = [(100, 128 * Mi), (250, 512 * Mi), (500, 1 * Gi), (1000, 2 * Gi),
               (2000, 4 * Gi)]
-    out = []
-    for i in range(n):
-        cpu, mem = rng.choice(shapes)
-        out.append(make_pod(f"binpack-{i}", namespace=namespace, cpu=cpu, memory=mem))
-    return out
+    templates = [make_pod(f"binpack-shape-{j}", namespace=namespace,
+                          cpu=cpu, memory=mem)
+                 for j, (cpu, mem) in enumerate(shapes)]
+    prefix = namespace + "/"
+    cc = copy.copy
+    return [_stamp(cc(templates[rng.randrange(len(shapes))]),
+                   f"binpack-{i}", prefix)
+            for i in range(n)]
 
 
 def affinity_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
@@ -121,31 +187,47 @@ def mixed_affinity_pods(n: int, seed: int = 0,
            committed iso pod (predicates.go:1146) per wave.
       78%  plain density pods (distinct app labels, no interactions).
     """
+    # columnar: one template per (kind, app) — the Affinity objects are
+    # shared per app (spec, read-only to every consumer)
+    t_small = make_pod("mixed-t0", namespace=namespace, cpu=100,
+                       memory=256 * Mi)
+    t_big = make_pod("mixed-t1", namespace=namespace, cpu=100,
+                     memory=500 * Mi)
+    iso_aff = {}
+    for a in range(6):
+        app = f"iso-{a}"
+        iso_aff[app] = Affinity(pod_anti_affinity=PodAffinity(
+            required_terms=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": app}),
+                namespaces=[], topology_key=HOSTNAME_KEY)]))
+    pack_aff = {}
+    for a in range(4):
+        app = f"pack-{a}"
+        pack_aff[app] = Affinity(pod_affinity=PodAffinity(
+            required_terms=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": app}),
+                namespaces=[], topology_key=ZONE_KEY)]))
+    prefix = namespace + "/"
     out: List[Pod] = []
+    cc = copy.copy
     for i in range(n):
         r = i % 100
         if r < 15:
             app = f"iso-{r % 6}"
-            p = make_pod(f"mixed-iso-{i}", namespace=namespace, cpu=100,
-                         memory=256 * Mi, labels={"app": app})
-            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
-                required_terms=[PodAffinityTerm(
-                    label_selector=LabelSelector(match_labels={"app": app}),
-                    namespaces=[], topology_key=HOSTNAME_KEY)]))
+            p = _stamp(cc(t_small), f"mixed-iso-{i}", prefix,
+                       {"app": app})
+            p.affinity = iso_aff[app]
         elif r < 17:
             app = f"pack-{i % 4}"
-            p = make_pod(f"mixed-pack-{i}", namespace=namespace, cpu=100,
-                         memory=256 * Mi, labels={"app": app})
-            p.affinity = Affinity(pod_affinity=PodAffinity(
-                required_terms=[PodAffinityTerm(
-                    label_selector=LabelSelector(match_labels={"app": app}),
-                    namespaces=[], topology_key=ZONE_KEY)]))
+            p = _stamp(cc(t_small), f"mixed-pack-{i}", prefix,
+                       {"app": app})
+            p.affinity = pack_aff[app]
         elif r < 22:
-            p = make_pod(f"mixed-tgt-{i}", namespace=namespace, cpu=100,
-                         memory=500 * Mi, labels={"app": f"iso-{r % 6}"})
+            p = _stamp(cc(t_big), f"mixed-tgt-{i}", prefix,
+                       {"app": f"iso-{r % 6}"})
         else:
-            p = make_pod(f"mixed-web-{i}", namespace=namespace, cpu=100,
-                         memory=500 * Mi, labels={"app": f"web-{i % 8}"})
+            p = _stamp(cc(t_big), f"mixed-web-{i}", prefix,
+                       {"app": f"web-{i % 8}"})
         out.append(p)
     return out
 
@@ -164,24 +246,33 @@ def churn_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
            forbid row; it must NOT rebuild AffinityData wholesale).
       84%  plain density pods — the no-op patch majority.
     """
+    t_small = make_pod("churn-t0", namespace=namespace, cpu=100,
+                       memory=256 * Mi)
+    t_big = make_pod("churn-t1", namespace=namespace, cpu=100,
+                     memory=500 * Mi)
+    anti_aff = {}
+    for a in range(4):
+        app = f"churn-iso-{a}"
+        anti_aff[app] = Affinity(pod_anti_affinity=PodAffinity(
+            required_terms=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": app}),
+                namespaces=[], topology_key=HOSTNAME_KEY)]))
+    prefix = namespace + "/"
     out: List[Pod] = []
+    cc = copy.copy
     for i in range(n):
         r = i % 100
         if r < 6:
             app = f"churn-iso-{r % 4}"
-            p = make_pod(f"churn-anti-{i}", namespace=namespace, cpu=100,
-                         memory=256 * Mi, labels={"app": app})
-            p.affinity = Affinity(pod_anti_affinity=PodAffinity(
-                required_terms=[PodAffinityTerm(
-                    label_selector=LabelSelector(match_labels={"app": app}),
-                    namespaces=[], topology_key=HOSTNAME_KEY)]))
+            p = _stamp(cc(t_small), f"churn-anti-{i}", prefix,
+                       {"app": app})
+            p.affinity = anti_aff[app]
         elif r < 16:
-            p = make_pod(f"churn-tgt-{i}", namespace=namespace, cpu=100,
-                         memory=500 * Mi,
-                         labels={"app": f"churn-iso-{r % 4}"})
+            p = _stamp(cc(t_big), f"churn-tgt-{i}", prefix,
+                       {"app": f"churn-iso-{r % 4}"})
         else:
-            p = make_pod(f"churn-web-{i}", namespace=namespace, cpu=100,
-                         memory=500 * Mi, labels={"app": f"web-{i % 8}"})
+            p = _stamp(cc(t_big), f"churn-web-{i}", prefix,
+                       {"app": f"web-{i % 8}"})
         out.append(p)
     return out
 

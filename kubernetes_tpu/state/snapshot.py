@@ -172,6 +172,15 @@ class ClusterSnapshot:
         # a consumer further behind than the ring rebuilds.
         self._labels_log: List[Tuple[int, int]] = []
         self.dirty: set = set()
+        # ROW-granular dirt for the DYNAMIC arrays (requested/nonzero/
+        # pod_count), consumed by the sharded device sync (ISSUE 12): a
+        # mesh-resident engine re-uploads only the SHARDS owning touched
+        # rows instead of the whole [N, R] array per wave. None = unknown
+        # (full upload required); the consumer arms tracking by assigning
+        # a fresh set after each full sync. Writers that know their rows
+        # call _note_rows; writers that rewrite wholesale call
+        # _note_rows(None).
+        self.dirty_rows = None
         self._label_index: Dict[str, set] = {}  # key -> values across nodes
         self._row_labels: List[Dict[str, str]] = []  # per-row node label maps
         self._labels_width = _pad(0)
@@ -536,6 +545,7 @@ class ClusterSnapshot:
             if prev is not None:  # unseen node: next refresh rewrites it
                 gens[nm] = (info.generation, prev[1], prev[2], info)
         self.dirty.update(self.DYNAMIC)
+        self._note_rows(touched)
         self.version += 1
 
     def _write_dynamic_rows_bulk(self, updates) -> None:
@@ -588,6 +598,7 @@ class ClusterSnapshot:
             self._raw_dyn[idx, 5:7] = nz
             self.pod_count[idx] = cnt
             self.dirty.update(self.DYNAMIC)
+            self._note_rows(idx)
         for i, _nm, info in slow:
             self._write_dynamic_row(i, info)
         for i, nm, info in updates:
@@ -643,6 +654,7 @@ class ClusterSnapshot:
         self.dirty = {"requested", "nonzero", "pod_count", "port_bitmap",
                       "vol_present", "vol_rw", "pd_present", "pd_counts",
                       "pd_kind", *self.STATIC}
+        self._note_rows(None)  # fresh arrays: shape moved, full sync
 
     def _write_rows_bulk(self, names: List[str],
                          infos: Dict[str, NodeInfo]) -> None:
@@ -747,6 +759,15 @@ class ClusterSnapshot:
         self._scatter_labels(n)
         self.dirty.update(self.DYNAMIC)
         self.dirty.update(self.STATIC)
+        self._note_rows(None)  # wholesale rewrite — row dirt meaningless
+
+    def _note_rows(self, rows) -> None:
+        """Record dynamic-row dirt for the sharded delta sync. rows=None
+        means "cannot name the rows" — the next sync uploads wholesale."""
+        if rows is None:
+            self.dirty_rows = None
+        elif self.dirty_rows is not None:
+            self.dirty_rows.update(int(r) for r in rows)
 
     @staticmethod
     def _i32(col: np.ndarray) -> np.ndarray:
@@ -785,6 +806,7 @@ class ClusterSnapshot:
                 self.taints_pref[i, idx] = 1
 
     def _write_dynamic_row(self, i: int, info: NodeInfo) -> None:
+        self._note_rows((i,))
         r = self.num_resources
         req_ = info.requested
         self._raw_dyn[i] = (req_.milli_cpu, req_.memory, req_.nvidia_gpu,

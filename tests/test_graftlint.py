@@ -957,3 +957,88 @@ def test_gate_is_pure_ast_fast():
     t0 = time.perf_counter()
     lint_gate(PKG_DIR)
     assert time.perf_counter() - t0 < 20.0
+
+
+# ------------------------------------------------- ISSUE 12: mesh seams
+
+
+def test_gl001_fires_on_shard_resident_fold_alias(tmp_path):
+    """The shard-resident buffer lifecycle (ISSUE 12): a host array that
+    backs a RESIDENT sharded upload while a later fold mutates it in
+    place is the committed_nodes race at mesh scale — degrading the
+    copying upload (sanitize.upload_copied(..., sharding=...) /
+    ResidentMesh.update_rows' per-slice np.array) to a zero-copy
+    jnp.asarray must fire; the shipped copying shape stays silent."""
+    bad = lint_src(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        class ResidentEngine:
+            def sync_shards(self, enc):
+                # sharded residency built over an alias of the live fold
+                # target — the regression GL001 exists to reject
+                return jnp.asarray(enc.committed_nodes)
+
+            def fold(self, enc, cls, node):
+                np.add.at(enc.committed_nodes, (cls, node), 1)
+    """)
+    assert rules_of(bad) == ["GL001"]
+    good = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        class ResidentEngine:
+            def sync_shards(self, enc, sharding):
+                # the shipped seam: copy host-side BEFORE placement, so
+                # even a zero-copy per-shard device_put aliases only the
+                # throwaway copy (sanitize.upload_copied(sharding=...) /
+                # mesh.ResidentMesh.update_rows)
+                return jax.device_put(np.array(enc.committed_nodes),
+                                      sharding)
+
+            def fold(self, enc, cls, node):
+                np.add.at(enc.committed_nodes, (cls, node), 1)
+    """)
+    assert not [f for f in good if f.rule == "GL001"], good
+
+
+def test_gl003_fires_on_ragged_per_shard_slice_into_reduce(tmp_path):
+    """ISSUE 12: the two-stage winner reduce consumes PER-SHARD candidate
+    rows — a host loop slicing the candidate table to data-dependent
+    per-shard offsets before a registered jitted entry point is the
+    recompile storm at mesh scale (one compile per ragged shard width).
+    The shipped shape — the whole [D, C] table into ONE program, shard
+    ownership resolved inside — stays silent."""
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    bad = tmp_path / "ragged_reduce.py"
+    bad.write_text(textwrap.dedent("""
+        from kubernetes_tpu.engine.waves import waves_loop
+
+        def combine(shard_offs, cls_arr, nodes, state, pc, ctr, prios):
+            out = []
+            for d in range(len(shard_offs) - 1):
+                lo, hi = shard_offs[d], shard_offs[d + 1]
+                out.append(waves_loop(cls_arr, nodes, state, pc[lo:hi],
+                                      ctr, prios))
+            return out
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(bad)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert any(f.rule == "GL003" and "combine" in f.context
+               for f in findings), findings
+    good = tmp_path / "whole_table_reduce.py"
+    good.write_text(textwrap.dedent("""
+        from kubernetes_tpu.engine.waves import waves_loop
+
+        def combine(cls_arr, nodes, state, pc_all, ctr, prios):
+            # one program over the WHOLE padded table; shard ownership is
+            # the device program's job (waves_loop spmd_mesh), never a
+            # host-side ragged slice
+            return waves_loop(cls_arr, nodes, state, pc_all, ctr, prios)
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(good)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert not [f for f in findings if f.rule == "GL003"
+                and "whole_table_reduce" in f.path], findings

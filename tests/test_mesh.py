@@ -250,3 +250,161 @@ def test_frozen_affinity_scores_parity_under_mesh(seed):
             (2, 1))
         got.block_until_ready()
     np.testing.assert_array_equal(np.asarray(got), base)
+
+
+# ------------------------------------------------- ISSUE 12: residency
+
+
+def test_two_stage_tie_select_matches_global():
+    """The winner-reduce contract: _ShardCol's two-stage tie selection
+    (local rank + all-gathered [D, C] prefix + ownership-masked psum)
+    must equal _GlobalCol's whole-axis tiemat lookup for every (class,
+    draw) — including empty tie sets and ties straddling shard
+    boundaries."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as PS
+
+    from kubernetes_tpu.engine.waves import _GlobalCol, _ShardCol
+    from kubernetes_tpu.parallel.mesh import NODE_AXIS
+
+    rng = np.random.default_rng(7)
+    C, N, P_ = 5, 64, 40
+    ties = rng.random((C, N)) < 0.2
+    ties[3] = False                      # empty tie set
+    ties[4, N - 1] = True                # tie on the last shard edge
+    ties_j = jnp.asarray(ties)
+    pod_class = jnp.asarray(rng.integers(0, C, P_).astype(np.int32))
+    m = ties.sum(axis=1).astype(np.int32)
+    draw = rng.integers(0, 1000, P_).astype(np.int32)
+    kz = jnp.asarray(draw % np.maximum(m[np.asarray(pod_class)], 1))
+
+    base = _GlobalCol(N).tie_select(ties_j, pod_class, kz)
+
+    mesh = make_mesh(N_DEV)
+    col = _ShardCol(NODE_AXIS, N, N // N_DEV)
+    got = shard_map(
+        lambda t, pc, k: col.tie_select(t, pc, k),
+        mesh=mesh, in_specs=(PS(None, NODE_AXIS), PS(), PS()),
+        out_specs=PS(), check_rep=False)(ties_j, pod_class, kz)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+
+
+def test_spmd_waves_loop_matches_global():
+    """waves_loop(spmd_mesh=...) — the whole wave program under shard_map
+    with the two-stage reduce — must produce the identical packed result
+    and final NodeState as the single-program run (the tier-1 pin of the
+    scale_sweep's bit-identity acceptance)."""
+    snap, pods = _cluster(3, n_nodes=24, n_pods=48)
+    cbatch = ClassBatch(pods, snap)
+    cls = preds.pod_arrays(cbatch.reps_batch)
+    narr = preds.node_arrays(snap)
+    pc = jnp.asarray(cbatch.pod_class)
+    ctr = jnp.uint32(0)
+    packed0, st0 = waves.waves_loop(cls, narr, node_state(narr), pc, ctr,
+                                    PRIO, 32)
+    mesh = make_mesh(N_DEV)
+    packed1, st1 = waves.waves_loop(cls, narr, node_state(narr), pc, ctr,
+                                    PRIO, 32, spmd_mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(packed1), np.asarray(packed0))
+    np.testing.assert_array_equal(np.asarray(st1.requested),
+                                  np.asarray(st0.requested))
+    np.testing.assert_array_equal(np.asarray(st1.pod_count),
+                                  np.asarray(st0.pod_count))
+
+
+def _mesh_sched(n_nodes, mesh):
+    from kubernetes_tpu.engine.scheduler import Scheduler
+    from kubernetes_tpu.models.hollow import hollow_nodes, load_cluster
+    from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+
+    api = ApiServerLite()
+    load_cluster(api, hollow_nodes(n_nodes), [])
+    s = Scheduler(api, record_events=False, mesh=mesh)
+    s.start()
+    return api, s
+
+
+def test_resident_engine_partition_specs_and_identity():
+    """Tier-1 mesh smoke (ISSUE 12): a tiny drain on the resident-mesh
+    engine pins (a) the partition layout — node-axis device buffers
+    sharded over all 8 devices, pod-side/class-side replicated — and
+    (b) placements bit-identical to the unsharded engine."""
+    from kubernetes_tpu.models.hollow import PROFILES
+
+    def run(mesh):
+        api, s = _mesh_sched(64, mesh)
+        for p in PROFILES["density"](200):
+            api.create("Pod", p)
+        s.run_until_drained(max_batch=64)
+        return api, s
+
+    api0, _ = run(None)
+    mesh = make_mesh(N_DEV)
+    api1, s1 = run(mesh)
+    p0 = {p.name: p.node_name for p in api0.list("Pod")[0]}
+    p1 = {p.name: p.node_name for p in api1.list("Pod")[0]}
+    assert p0 == p1 and all(p0.values())
+    dev = s1.engine._device_nodes
+    # node-axis arrays: one shard per device, rows split evenly
+    for k in ("alloc", "requested", "labels", "pod_count"):
+        shards = dev[k].addressable_shards
+        assert len(shards) == N_DEV, k
+        n = dev[k].shape[0]
+        assert all(s.data.shape[0] == n // N_DEV for s in shards), k
+    # pod-side tables stay replicated (pd_kind has no node axis)
+    assert all(s.data.shape == dev["pd_kind"].shape
+               for s in dev["pd_kind"].addressable_shards)
+    # the sharded sync armed row tracking on the snapshot
+    assert s1.engine.snapshot.dirty_rows is not None
+
+
+def test_stream_sharded_equals_unsharded_frozen_trace():
+    """ISSUE 12 satellite: the sharded==unsharded bit-identity A/B
+    extended from the drain shapes to the STREAMING micro-wave path — the
+    same frozen arrival trace consumed by two streaming loops (one
+    mesh-resident, one unsharded) binds every pod to the same node, and
+    the mesh run keeps the delta-only invariants: zero encode rebuilds
+    after warmup and dynamic-row deltas riding the per-shard row path."""
+    from kubernetes_tpu.models.hollow import PROFILES
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    trace = (37, 96, 5, 64)
+    quantum = 128
+
+    def run(mesh):
+        api, s = _mesh_sched(48, mesh)
+        loop = s.stream(budget_s=30.0, min_quantum=quantum,
+                        max_quantum=quantum)
+        # warm: one group compiles shapes + builds the encoding
+        for p in PROFILES["density"](quantum):
+            p.name = "warm-" + p.name
+            api.create("Pod", p)
+        loop.step()
+        loop.drain()
+        snap0 = COUNTERS.snapshot()
+        for gi, group in enumerate(trace):
+            pods = PROFILES["density"](group)
+            for p in pods:
+                p.name = f"g{gi}-{p.name}"
+                api.create("Pod", p)
+            loop.step()
+        loop.drain()
+        loop.close()
+        snap1 = COUNTERS.snapshot()
+
+        def delta(name):
+            return snap1.get(name, (0, 0))[0] - snap0.get(name, (0, 0))[0]
+        return ({p.name: p.node_name for p in api.list("Pod")[0]},
+                {k: delta(k) for k in ("engine.wave_encode_build",
+                                       "engine.shard_delta_rows",
+                                       "snapshot.assume_delta_rows")})
+
+    pa, _ = run(None)
+    pb, counters = run(make_mesh(N_DEV))
+    assert pa == pb, {k: (pa[k], pb[k]) for k in pa if pa[k] != pb[k]}
+    assert all(v for v in pa.values())
+    # delta-only invariant, mesh edition: no re-tensorization mid-stream,
+    # and the assume folds shipped through the per-shard row path
+    assert counters["engine.wave_encode_build"] == 0
+    assert counters["engine.shard_delta_rows"] > 0
+    assert counters["snapshot.assume_delta_rows"] >= sum(trace)
