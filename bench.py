@@ -20,7 +20,11 @@ BENCH_ARRIVAL_BUDGET_MS (create->bound latency budget driving micro-wave
 admission, default 250), BENCH_ARRIVAL_SECONDS (offer window; default auto),
 BENCH_ARRIVAL_BURST (creator max pods per wakeup; default ~4ms of rate),
 BENCH_ARRIVAL_SWEEP (comma rates; "" disables), BENCH_ARRIVAL_SAT=0 to skip
-the saturation search. Churn scenario (ISSUE 8): BENCH_CHURN=0 to skip,
+the saturation search, BENCH_RECORDER_AB=0 to skip the flight-recorder
+on/off A/B (ISSUE 13: the headline re-run with the recorder armed,
+interleaved trials with per-arm medians — BENCH_RECORDER_AB_TRIALS,
+default 2; telemetry_overhead_pct travels in the artifact). Churn
+scenario (ISSUE 8): BENCH_CHURN=0 to skip,
 BENCH_CHURN_RATE (offered rate; default the arrival rate),
 BENCH_CHURN_SEED, BENCH_CHURN_NODE_PCT_MIN (node churn fraction/min,
 default 0.10), BENCH_CHURN_BIND_FAIL / BENCH_CHURN_BIND_TIMEOUT
@@ -1418,7 +1422,8 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
                 budget_ms: float = 250.0, max_burst: int = 0,
                 min_quantum: int = 256, max_quantum: int = 16384,
                 interval_s: float = 0.0, warm: bool = False,
-                churn_cfg=None, mesh_devices: int = 0):
+                churn_cfg=None, mesh_devices: int = 0,
+                recorder: bool = False):
     """THE headline scenario (ISSUE 7): pods are CREATED at a configured
     rate while the ALWAYS-ON loop runs — the reference's density suite
     semantics (test/integration/scheduler_perf/scheduler_test.go:34-39
@@ -1546,6 +1551,20 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     gc.collect()
     gc.freeze()
     gc.disable()
+    # flight recorder (ISSUE 13): armed for the measured window only —
+    # the recorder-on leg of the telemetry-overhead A/B. The warm/prime
+    # phases above ran with it off, so the ring holds exactly the
+    # offered stream's waves. recorder=False FORCE-disables for the
+    # window (restored after): with GRAFT_FLIGHT_RECORDER=1 in the env
+    # the off arm would otherwise silently record too, and the A/B
+    # would compare on-vs-on — a vacuous pass of the overhead bar.
+    from kubernetes_tpu.observability.recorder import RECORDER as _flight
+    _flight_was = _flight.enabled
+    if recorder:
+        _flight.clear()
+        _flight.enable()
+    else:
+        _flight.disable()
     created = [0]
     create_ts = np.full(total, -1.0)   # per-pod create instant, rel. t0
     create_log = []                    # (t_rel, batch_size) per burst
@@ -1658,6 +1677,11 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     finally:
         gc.enable()
         gc.unfreeze()
+        # restore the PRE-leg state either way: the on arm armed it for
+        # the window, the off arm force-disabled it — an env-armed
+        # recorder (GRAFT_FLIGHT_RECORDER=1) stays armed for whatever
+        # runs next in this process
+        _flight.enabled = _flight_was
         if churn_stop is not None:
             churn_stop.set()
     creator_thread.join(timeout=10)
@@ -1764,6 +1788,9 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
         "duplicate_binds": int(duplicate_binds),
         "counters_at_offer_start": counters_at_offer_start,
     }
+    if recorder:
+        out["recorder_events"] = int(_flight.stats()["events"])
+        out["recorder_dropped"] = int(_flight.stats()["dropped"])
     if injector is not None:
         out.update({
             "churn_ops_applied": dict(injector.applied),
@@ -2474,6 +2501,64 @@ def main():
             import sys
             print(f"bench: arrival measurement failed: {e}", file=sys.stderr)
 
+    # recorder on/off A/B (ISSUE 13): the SAME arrival headline re-run
+    # with the flight recorder armed, INTERLEAVED on/off trials on the
+    # same box with per-arm medians — the telemetry overhead is
+    # measured, not asserted (acceptance: <= 2% sustained-throughput
+    # overhead), and a single-pair A/B cannot resolve 2% through this
+    # box's documented +-30% run-to-run swing (the r13 lesson: one bad
+    # leg reads as a fake regression). The headline run above is the
+    # first off-arm trial. BENCH_RECORDER_AB=0 to skip,
+    # BENCH_RECORDER_AB_TRIALS sets trials per arm (default 2).
+    recorder_ab = None
+    if arrival is not None \
+            and os.environ.get("BENCH_RECORDER_AB", "1") != "0":
+        import statistics
+        trials = max(int(os.environ.get("BENCH_RECORDER_AB_TRIALS", "2")),
+                     1)
+        offs = [arrival["sustained_pods_s"]]
+        ons, on_p99s = [], []
+        rec_events = rec_dropped = None
+        try:
+            def _leg(rec_on):
+                return run_arrival(
+                    n_nodes, rate=arrival_rate,
+                    duration_s=arrival_duration, profile=arrival_profile,
+                    budget_ms=arrival_budget,
+                    max_burst=int(os.environ.get("BENCH_ARRIVAL_BURST",
+                                                 0)),
+                    warm=warmup, recorder=rec_on)
+
+            for _i in range(trials):
+                r_on = _leg(True)
+                ons.append(r_on["sustained_pods_s"])
+                if r_on["p99_ms"] is not None:
+                    on_p99s.append(r_on["p99_ms"])
+                rec_events = r_on.get("recorder_events")
+                rec_dropped = r_on.get("recorder_dropped")
+                if len(offs) < trials:
+                    offs.append(_leg(False)["sustained_pods_s"])
+            off_s = statistics.median(offs)
+            on_s = statistics.median(ons)
+            recorder_ab = {
+                "recorder_off_sustained_pods_s": round(off_s, 1),
+                "recorder_on_sustained_pods_s": round(on_s, 1),
+                "recorder_off_trials": offs,
+                "recorder_on_trials": ons,
+                "recorder_on_p99_ms": round(statistics.median(on_p99s), 3)
+                if on_p99s else None,
+                "recorder_events": rec_events,
+                "recorder_dropped": rec_dropped,
+                # positive = the recorder cost throughput; negative =
+                # box noise favored the on arm (both travel — medians
+                # over interleaved trials, never a cherry-pick)
+                "telemetry_overhead_pct": round(
+                    (off_s - on_s) / off_s * 100.0, 2) if off_s else None,
+            }
+        except Exception as e:
+            import sys
+            print(f"bench: recorder A/B failed: {e}", file=sys.stderr)
+
     # offered-rate sweep + saturation search (BENCH_ARRIVAL_SWEEP=""
     # disables the sweep, BENCH_ARRIVAL_SAT=0 the search)
     sweep_env = os.environ.get("BENCH_ARRIVAL_SWEEP",
@@ -2670,6 +2755,11 @@ def main():
         if arrival else None,
         "arrival_degraded_steps": arrival["degraded_steps"]
         if arrival else None,
+        # recorder on/off A/B (ISSUE 13): telemetry overhead measured on
+        # the same box, back-to-back with the headline
+        "arrival_recorder_ab": recorder_ab,
+        "telemetry_overhead_pct": recorder_ab["telemetry_overhead_pct"]
+        if recorder_ab else None,
         # offered sweeps + saturation search: the max offered rate the
         # engine sustains with p99 create->bound under the budget
         "arrival_sweeps": sweeps,
@@ -2733,7 +2823,7 @@ def main():
     # working. BENCH_ARTIFACT= (empty) disables, or names another round;
     # the default is pinned to THIS round so a bench run can never
     # rewrite a prior round's file as commit noise (ISSUE 11 satellite).
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r14.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r15.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

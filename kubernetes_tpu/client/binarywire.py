@@ -183,6 +183,16 @@ class BinaryWireClient:
             raise WireError(f"unexpected verb 0x{verb:02x} to METRICS")
         return framing.decode_metrics_text(payload)
 
+    def stats(self, last: int = 0) -> dict:
+        """Live introspection (ISSUE 13): {"vars": <registry snapshot>,
+        "trace": [last N recorder events]} — the binary twin of HTTP
+        /debug/vars + /debug/trace."""
+        verb, payload = self._roundtrip(framing.STATS,
+                                        framing.encode_stats_request(last))
+        if verb != framing.STATS_RESULT:
+            raise WireError(f"unexpected verb 0x{verb:02x} to STATS")
+        return framing.decode_stats_result(payload)
+
     def __enter__(self) -> "BinaryWireClient":
         return self.connect()
 

@@ -67,6 +67,9 @@ from kubernetes_tpu.engine.scheduler_engine import (
     SchedulingEngine,
 )
 from kubernetes_tpu.engine.streaming import ScheduleLoop
+from kubernetes_tpu.observability import recorder as flightrec
+from kubernetes_tpu.observability.recorder import RECORDER
+from kubernetes_tpu.observability.registry import TelemetryRegistry
 from kubernetes_tpu.ops import priorities as prio
 from kubernetes_tpu.server.apiserver_lite import (
     ApiServerLite,
@@ -150,6 +153,11 @@ class Scheduler:
         # gangmix_flush_elapsed_s measurement).
         self.gang_pipeline = True
         self.metrics = SchedulerMetrics()
+        # unified telemetry (ISSUE 13): this scheduler's histograms +
+        # counters in the one labeled namespace; a live ScheduleLoop
+        # registers its stream gauges (quantum/backlog/degraded) here
+        self.telemetry = TelemetryRegistry()
+        self.telemetry.register_metrics("scheduler", self.metrics)
         self.record_events = record_events
         self.events: List[Event] = []
         # per-wave bind telemetry for loop owners (bench.run_arrival's
@@ -507,6 +515,9 @@ class Scheduler:
         t_bind = bind_done - tb0
         bound_pods, n_errors = self._finish_binds(
             [r.pod for r in placed], errs)
+        if placed and RECORDER.enabled:
+            RECORDER.record(flightrec.BIND_FLUSH, t0=tb0, dur=t_bind,
+                            a=len(bound_pods), b=n_errors)
         stats["bind_errors"] += n_errors
         stats["bound"] += len(bound_pods)
         trace.step("bindings written")
@@ -822,6 +833,10 @@ class Scheduler:
         bound_pods, n_errors = self._finish_binds(res.bound, errs)
         out["bind_errors"] += n_errors
         bind_done = time.monotonic()
+        if RECORDER.enabled:
+            RECORDER.record(flightrec.BIND_FLUSH, wave=handle.wave_id,
+                            t0=tb0, dur=t_bind, a=len(bound_pods),
+                            b=n_errors)
         keys = [p.key() for p in bound_pods]  # computed once, shared by the
         # TTL pass and the latency harvest below
         self.cache.finish_bindings_bulk(bound_pods, keys=keys)

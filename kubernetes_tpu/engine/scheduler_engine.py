@@ -44,6 +44,8 @@ from kubernetes_tpu.analysis import sanitize
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.engine.batch import NodeState, gather_place_batch
 from kubernetes_tpu.engine import waves
+from kubernetes_tpu.observability import recorder as flightrec
+from kubernetes_tpu.observability.recorder import RECORDER
 from kubernetes_tpu.ops import oracle
 from kubernetes_tpu.ops import priorities as prio
 from kubernetes_tpu.ops.predicates import bucket
@@ -959,11 +961,12 @@ class WaveHandle:
 
     __slots__ = ("pods", "pc", "enc", "packed", "state_out", "counter_out",
                  "nodes", "blind", "pop_ts", "dispatch_ts", "pad_floor",
-                 "committed_out", "strict_idx", "gangs")
+                 "committed_out", "strict_idx", "gangs", "wave_id")
 
     def __init__(self, pods, pc, enc, packed, state_out, counter_out, nodes,
                  blind, pop_ts, dispatch_ts, pad_floor=0,
-                 committed_out=None, strict_idx=None, gangs=None):
+                 committed_out=None, strict_idx=None, gangs=None,
+                 wave_id=-1):
         self.pad_floor = pad_floor
         self.pods = pods
         self.pc = pc                  # host int32 [n] class index per pod
@@ -984,6 +987,10 @@ class WaveHandle:
         # indices into `pods`, quorum)] — the harvest's gang fence commits
         # or atomically rolls back each one
         self.gangs = gangs or []
+        # flight-recorder wave id (ISSUE 13): joins this wave's dispatch /
+        # harvest / bind-flush events on the exported timeline; -1 when
+        # the recorder was off at dispatch
+        self.wave_id = wave_id
 
     def block(self) -> None:
         """Force device completion now (sequential/debug mode): the values
@@ -1649,6 +1656,8 @@ class SchedulingEngine:
         if touched:
             enc.aff_patch_dirty = True
         COUNTERS.inc("engine.aff_patch_rows", patched)
+        if patched and RECORDER.enabled:
+            RECORDER.record(flightrec.PATCH, a=patched)
         return True
 
     def _try_patch_labels(self, enc: "_WaveEncoding", infos) -> bool:
@@ -1760,6 +1769,8 @@ class SchedulingEngine:
                 else self._rmesh.aff_sharding("labels_aff"))
         enc.labels_gen = snap.labels_gen
         COUNTERS.inc("engine.label_patch_rows", len(rows))
+        if rows and RECORDER.enabled:
+            RECORDER.record(flightrec.PATCH, b=len(rows))
         return True
 
     def _flush_aff_patches(self, enc: "_WaveEncoding") -> None:
@@ -1975,6 +1986,10 @@ class SchedulingEngine:
             return None
         if self.workloads_provider():
             return None
+        # flight recorder (ISSUE 13): one host-side timestamp when armed,
+        # nothing at all when off — the event itself is emitted after the
+        # async launch, carrying only host scalars already in hand
+        _rec_t0 = _time.monotonic() if RECORDER.enabled else 0.0
         with timed_span("pipeline.dispatch"):
             infos = self._refresh()
             out = self._wave_encoding(pods, infos)
@@ -2056,11 +2071,19 @@ class SchedulingEngine:
             COUNTERS.inc("engine.wave_dispatch_pods", n)
             if gangs:
                 COUNTERS.inc("engine.gang_wave_dispatch", len(gangs))
+            wave_id = -1
+            if _rec_t0 and RECORDER.enabled:
+                wave_id = RECORDER.next_wave()
+                RECORDER.record(flightrec.DISPATCH, wave=wave_id,
+                                t0=_rec_t0,
+                                dur=_time.monotonic() - _rec_t0,
+                                a=n, b=len(gangs) if gangs else 0)
             return WaveHandle(list(pods), pc, enc, packed, state_out,
                               counter_out, nodes, blind, pop_ts,
                               _time.monotonic(), self.wave_pad_floor,
                               committed_out=committed_out,
-                              strict_idx=strict_idx, gangs=gangs)
+                              strict_idx=strict_idx, gangs=gangs,
+                              wave_id=wave_id)
 
     def harvest_waves(self, handle: WaveHandle) -> WaveHarvest:
         """Block on one wave's device→host sync, fence its placements
@@ -2075,6 +2098,7 @@ class SchedulingEngine:
 
         from kubernetes_tpu.utils.trace import COUNTERS, timed_span
 
+        _rec_t0 = _time.monotonic() if RECORDER.enabled else 0.0
         # the fence below compares against snapshot arrays — fold in any
         # commits/events since the last dispatch (hinted: near-free when
         # nothing moved)
@@ -2096,6 +2120,10 @@ class SchedulingEngine:
             # wave's device wait while the NEXT wave already runs
             packed_h = np.asarray(handle.packed)  # graftlint: sync-ok
         t_block = _time.perf_counter() - t0
+        # block-END instant on the ring's timebase: the device-eval lane's
+        # right edge (the exporter reconstructs the window as
+        # [dispatch end → this instant])
+        _rec_block_end = _time.monotonic() if _rec_t0 else 0.0
         # the per-wave device->host payload: [3P+2] int32 regardless of N —
         # the scale_sweep's proof that harvesting never fetches node-axis
         # tensors (the winner reduce already collapsed them on device)
@@ -2323,6 +2351,15 @@ class SchedulingEngine:
                               1)
                 enc.aff_seq += len(acc_l)
             bound = [pods[i] for i in sorted(acc_l)]
+        if _rec_t0 and RECORDER.enabled:
+            RECORDER.record(flightrec.HARVEST, wave=handle.wave_id,
+                            t0=_rec_block_end - t_block, dur=t_block,
+                            a=len(bound),
+                            b=len(conflicts) + len(liveness))
+            if conflicts or liveness:
+                RECORDER.record(flightrec.FENCE_REQUEUE,
+                                wave=handle.wave_id,
+                                a=len(conflicts), b=len(liveness))
         return WaveHarvest(bound, conflicts, unschedulable, t_block,
                            gang_committed=gang_committed,
                            gang_requeued=gang_requeued,

@@ -48,8 +48,10 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
+from kubernetes_tpu.observability import recorder as flightrec
+from kubernetes_tpu.observability.recorder import RECORDER
 from kubernetes_tpu.ops.predicates import bucket
-from kubernetes_tpu.utils.trace import COUNTERS
+from kubernetes_tpu.utils.trace import COUNTERS, Trace
 
 
 class ScheduleLoop:
@@ -120,8 +122,38 @@ class ScheduleLoop:
         self.recover_steps = 16
         self._breach_streak = 0
         self._degraded_left = 0
+        # budget-breach tracing (ISSUE 13 satellite): a pod-ful streaming
+        # step that outlives the latency budget dumps its step breakdown
+        # (utils/trace.Trace.log_if_long — the reference's slow-Schedule
+        # discipline at the micro-wave grain). trace_now/trace_sink are
+        # the test seams (fake clock, captured sink); threshold 0
+        # disables the trace construction entirely.
+        self.trace_threshold_s = budget_s or 0.0
+        self.trace_now = time.monotonic
+        self.trace_sink = None
+        # stream gauges into the owner's telemetry registry (ISSUE 13):
+        # quantum/backlog/degraded are THE live-introspection answers to
+        # "why is p99 moving" — re-registering under one key means a
+        # replacement loop supersedes a closed one
+        telemetry = getattr(sched, "telemetry", None)
+        if telemetry is not None:
+            telemetry.register_gauges("stream", self._gauges)
 
     # ------------------------------------------------------------- state
+
+    def _gauges(self):
+        """Live stream state for the telemetry registry: what every
+        introspection transport reports next to the counters. A scrape
+        races the loop thread, so the in-flight handle is read ONCE —
+        re-reading after the None check could catch the flush swap
+        mid-stride."""
+        handle = self.inflight
+        inflight = 0 if handle is None else len(handle.pods)
+        return {"stream_quantum": self.quantum,
+                "stream_backlog": self.sched.queue.ready_count() + inflight,
+                "stream_inflight": inflight,
+                "stream_degraded": int(self.degraded),
+                "stream_budget_ms": (self.budget_s or 0.0) * 1e3}
 
     @property
     def idle(self) -> bool:
@@ -192,6 +224,8 @@ class ScheduleLoop:
                     self.degraded = False
                     self._breach_streak = 0
                     COUNTERS.inc("stream.degraded_exit")
+                    if RECORDER.enabled:
+                        RECORDER.record(flightrec.DEGRADED, a=0)
             return
         if attempts <= 0:
             return
@@ -201,6 +235,9 @@ class ScheduleLoop:
                 self.degraded = True
                 self._degraded_left = self.recover_steps
                 COUNTERS.inc("stream.degraded_enter")
+                if RECORDER.enabled:
+                    RECORDER.record(flightrec.DEGRADED, a=1,
+                                    b=self._breach_streak)
         else:
             self._breach_streak = 0
 
@@ -211,7 +248,16 @@ class ScheduleLoop:
         stats = {"popped": 0, "bound": 0, "unschedulable": 0,
                  "bind_errors": 0, "preemptions": 0, "fence_requeued": 0,
                  "liveness_requeued": 0, "degraded_steps": 0}
+        # budget-breach tracing (streaming mode): narrate THIS step's
+        # phases; dumped only when the step outlives the budget — the
+        # scheduler's slow-Schedule discipline at the micro-wave grain
+        trace = None
+        if self.budget_s is not None and self.trace_threshold_s > 0:
+            trace = Trace("micro-wave step", now=self.trace_now,
+                          sink=self.trace_sink, quantum=self.quantum)
         s.sync()  # columnar; node/volume events flush the pipeline first
+        if trace is not None:
+            trace.step("informer sync done")
         now = time.monotonic()
         if now - self._last_gc >= self.gc_interval_s:
             # housekeeping regardless of load (ISSUE 8): a saturated
@@ -222,6 +268,9 @@ class ScheduleLoop:
             self._last_gc = now
         pods = s.queue.pop_batch(max_n=self.quantum, wait=wait)
         stats["popped"] = len(pods)
+        if trace is not None and pods:
+            trace.field("popped", len(pods))
+            trace.step("micro-wave popped")
         handle = None
         if not pods:
             # parked-gang sweep on empty steps only: a pod-ful step either
@@ -246,6 +295,8 @@ class ScheduleLoop:
                 if chunk_pods:
                     handle = s.engine.dispatch_waves(chunk_pods, pop_ts,
                                                      gangs=gang_spans)
+                    if trace is not None and handle is not None:
+                        trace.step("wave dispatched (async)")
             if handle is None and chunk_pods:
                 # chunk needs the strict/oracle machinery (host-check
                 # classes, affinity slot overflow, policy — or gangs with
@@ -256,6 +307,8 @@ class ScheduleLoop:
                 sub["popped"] = 0  # already counted
                 for k, v in sub.items():
                     stats[k] = stats.get(k, 0) + v
+                if trace is not None:
+                    trace.step("classic fallback round done")
             elif handle is not None and not self.overlap:
                 # sequential mode: forfeit the overlap only. The span is
                 # the profiler's measure of RAW per-wave device time (no
@@ -268,6 +321,8 @@ class ScheduleLoop:
             for k, v in s._complete_wave(prev).items():
                 stats[k] = stats.get(k, 0) + v
             self._observe_wave(prev)
+            if trace is not None:
+                trace.step("previous wave harvested + bound")
         if self._pending:
             for k, v in self._pending.items():
                 stats[k] = stats.get(k, 0) + v
@@ -275,6 +330,12 @@ class ScheduleLoop:
         if not pods:
             s._idle_gc()
         self._note_health(stats)
+        if trace is not None and (pods or prev is not None):
+            # only steps that did wave work can breach meaningfully; an
+            # idle tick dumping its (empty) breakdown would be noise
+            trace.field("bound", stats["bound"])
+            trace.field("degraded", int(self.degraded))
+            trace.log_if_long(self.trace_threshold_s)
         return stats
 
     # ------------------------------------------------------------ quiesce
@@ -348,4 +409,12 @@ class ScheduleLoop:
         out, self._pending = self._pending, {}
         if self.sched._pipeline is self:
             self.sched._pipeline = None
+        # drop OUR gauges from the owner's registry (a replacement loop's
+        # registration already superseded them — leave that one alone):
+        # a closed loop serving stale quantum/degraded answers would be
+        # introspection lying, and the registered bound method would pin
+        # this loop (and its WaveHandle fields) alive
+        telemetry = getattr(self.sched, "telemetry", None)
+        if telemetry is not None:
+            telemetry.unregister_gauges("stream", only_if=self._gauges)
         return out

@@ -1,0 +1,30 @@
+"""Flight recorder + unified telemetry (ISSUE 13).
+
+Three pieces, all host-side pure (no jax import, no device fetches —
+the graftlint GL002 fixture pins that the recorder never becomes a
+hidden sync):
+
+- ``recorder``: a bounded, preallocated ring of typed per-wave events
+  (dispatch / harvest / fence-requeue / patch / bind-flush /
+  degraded-transition / churn-op), wired through the engine's
+  dispatch_waves/harvest_waves, the streaming loop, and both bind
+  paths. Exact no-op when disabled; one lock + six scalar array writes
+  per WAVE (not per pod) when on.
+- ``registry``: the unified telemetry registry folding the span
+  counters (utils/trace.py COUNTERS), SchedulerMetrics histograms,
+  the ad-hoc service counter dicts, and live gauges (quantum, backlog,
+  degraded state, commit/snapshot generations) into one labeled
+  namespace with a single snapshot() and a single Prometheus render.
+  Every introspection transport — HTTP ``/debug/vars``, the binary
+  wire's STATS verb, ``VerdictService.debug_snapshot`` — serves THIS.
+- ``perfetto``: a Chrome trace-event exporter rendering the recorder
+  ring as host / device / fence lanes, so the pipeline-overlap
+  attribution profile_bench.py approximates becomes a loadable
+  timeline (``python -m kubernetes_tpu.observability --trace out.json``
+  then chrome://tracing or ui.perfetto.dev).
+"""
+
+from kubernetes_tpu.observability.recorder import RECORDER, FlightRecorder
+from kubernetes_tpu.observability.registry import TelemetryRegistry
+
+__all__ = ["FlightRecorder", "RECORDER", "TelemetryRegistry"]

@@ -342,6 +342,14 @@ class AsyncBinaryServer:
             text = await loop.run_in_executor(self._pool,
                                               self.service.metrics_text)
             return framing.METRICS_TEXT, framing.encode_metrics_text(text)
+        if verb == framing.STATS:
+            # live introspection (ISSUE 13): the registry snapshot takes
+            # per-source locks — off the event loop like every other
+            # service touch
+            last = framing.decode_stats_request(payload)
+            snap = await loop.run_in_executor(
+                self._pool, lambda: self.service.debug_snapshot(last))
+            return framing.STATS_RESULT, framing.encode_stats_result(snap)
         raise framing.FrameError(f"unknown verb 0x{verb:02x}")
 
     def _sync(self, kind: str, payload: bytes) -> int:

@@ -140,6 +140,18 @@ class VerdictService:
     def metrics_text(self) -> str:
         return self.backend.metrics_text()
 
+    def debug_snapshot(self, last: int = 0) -> Dict:
+        """Live introspection (ISSUE 13): the unified telemetry-registry
+        snapshot plus the flight recorder's last ``last`` events —
+        IDENTICAL content to HTTP ``/debug/vars`` + ``/debug/trace`` and
+        the binary wire's STATS verb (transport parity is test-pinned;
+        the registry snapshots each source under its own lock, so a
+        mid-storm read never tears)."""
+        dv = getattr(self.backend, "debug_vars", None)
+        dt = getattr(self.backend, "debug_trace", None)
+        return {"vars": dv() if dv is not None else {},
+                "trace": dt(last) if (last and dt is not None) else []}
+
     # ----------------------------------------------- batch seam (asyncwire)
 
     def eval_batch(self, pods) -> List:

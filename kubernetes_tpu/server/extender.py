@@ -11,6 +11,10 @@ kube-scheduler with `--policy-config-file` pointing at an ExtenderConfig
   POST {prefix}/prioritize  ExtenderArgs -> HostPriorityList
   POST {prefix}/bind        ExtenderBindingArgs -> ExtenderBindingResult
   GET  /healthz, /metrics
+  GET  /debug/vars          unified telemetry-registry snapshot (ISSUE 13
+                            — identical content to the binary STATS verb
+                            and the embedded debug_snapshot)
+  GET  /debug/trace?last=N  the flight recorder's event tail
 
 JSON keys: the reference posts the *internal* structs (no json tags ->
 capitalized keys: "Pod", "Nodes", "NodeNames"); Go's json.Unmarshal is
@@ -155,6 +159,32 @@ class ExtenderHTTPServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/debug/vars":
+                    # live introspection (ISSUE 13): the unified registry
+                    # snapshot — identical content to the binary STATS
+                    # verb and the embedded debug_snapshot, test-pinned
+                    dv = getattr(outer.backend, "debug_vars", None)
+                    if dv is None:
+                        self._write_json({"error": "not found"}, 404)
+                    else:
+                        self._write_json(dv())
+                elif self.path.split("?", 1)[0] == "/debug/trace":
+                    dt = getattr(outer.backend, "debug_trace", None)
+                    if dt is None:
+                        self._write_json({"error": "not found"}, 404)
+                    else:
+                        from urllib.parse import parse_qs, urlsplit
+                        q = parse_qs(urlsplit(self.path).query)
+                        try:
+                            # absent param -> a BOUNDED default tail (a
+                            # full 65k-event ring is a multi-MB body);
+                            # an explicit last (0 included) means
+                            # exactly what it means on the other
+                            # transports
+                            last = int(q.get("last", ["256"])[0])
+                        except ValueError:
+                            last = 256
+                        self._write_json(dt(last))
                 else:
                     self._write_json({"error": "not found"}, 404)
 
@@ -490,10 +520,46 @@ class TPUExtenderBackend:
         self.coalescer = EvalCoalescer(self, window_s=coalesce_window_s,
                                        max_batch=coalesce_max_batch,
                                        max_depth=coalesce_max_depth)
+        # unified telemetry registry (ISSUE 13): the ONE namespace every
+        # introspection transport serves — HTTP /debug/vars, the binary
+        # STATS verb, VerdictService.debug_snapshot and /metrics all read
+        # THIS (transport parity is a dict equality, test-pinned). Each
+        # source snapshots under its own lock, in sequence, never nested
+        # — the r12 torn-read discipline carried over.
+        from kubernetes_tpu.observability.registry import TelemetryRegistry
+        self.telemetry = TelemetryRegistry()
+        self.telemetry.register_metrics("extender", self.metrics)
+        self.telemetry.register_counters("extender", self._counters_snapshot,
+                                         prom_prefix="tpu_extender")
+        self.telemetry.register_gauges("extender", self._gen_gauges)
 
     def _count(self, name: str, n: int = 1) -> None:
         with self._counters_lock:
             self._counters[name] = self._counters.get(name, 0) + n
+
+    def _counters_snapshot(self) -> Dict[str, int]:
+        with self._counters_lock:
+            return dict(self._counters)
+
+    def _gen_gauges(self) -> Dict[str, int]:
+        with self._lock:
+            return {"tpu_extender_commit_gen": self.commit_gen,
+                    "tpu_extender_snapshot_gen": self._snap_gen}
+
+    def debug_vars(self) -> Dict:
+        """The registry snapshot /debug/vars (and every other transport)
+        serves."""
+        return self.telemetry.snapshot()
+
+    def debug_trace(self, last: int = 0):
+        """The flight recorder's event tail for /debug/trace?last=N.
+        ``last <= 0`` returns NOTHING — identical semantics on every
+        transport (binary STATS, embedded debug_snapshot), so parity
+        holds for every literal ``last`` value; a full-ring dump is an
+        explicit ``last >= recorder.capacity`` (the capacity travels in
+        /debug/vars as ``recorder.capacity``)."""
+        from kubernetes_tpu.observability.recorder import RECORDER
+        return RECORDER.snapshot(last) if last > 0 else []
 
     # -- cache sync ---------------------------------------------------------
 
@@ -1021,20 +1087,9 @@ class TPUExtenderBackend:
             return 0.002 + self._rng.random() * 0.01
 
     def metrics_text(self) -> str:
-        base = self.metrics.render()
-        # counters snapshot under THEIR lock, generations under the state
-        # lock — taken in sequence (never while holding the other), so a
-        # scrape can't tear either set (ISSUE 9 satellite audit)
-        with self._counters_lock:
-            snap = dict(self._counters)
-        with self._lock:
-            gens = (self.commit_gen, self._snap_gen)
-        lines = [base]
-        for k in sorted(snap):
-            name = f"tpu_extender_{k}_total"
-            lines.append(f"# TYPE {name} counter\n{name} {snap[k]}")
-        lines.append(f"# TYPE tpu_extender_commit_gen gauge\n"
-                     f"tpu_extender_commit_gen {gens[0]}")
-        lines.append(f"# TYPE tpu_extender_snapshot_gen gauge\n"
-                     f"tpu_extender_snapshot_gen {gens[1]}")
-        return "\n".join(lines)
+        # the single Prometheus render of the unified registry (ISSUE 13):
+        # same families as the pre-r15 hand-rolled fold (scheduler
+        # histograms, tpu_extender_*_total counters, gen gauges) plus the
+        # span and flight-recorder families. Lock discipline unchanged:
+        # each source snapshots under ITS lock, in sequence, never nested.
+        return self.telemetry.render_prometheus()

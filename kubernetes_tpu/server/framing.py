@@ -46,6 +46,11 @@ Verbs — requests:
     PING        -> PONG, no service touch — the no-op round trip
                 bench.measure_wire_floor times against the threaded-HTTP
                 no-op floor.
+    STATS       live introspection (ISSUE 13): u32 last_n ->
+                STATS_RESULT carrying the unified telemetry-registry
+                snapshot plus the flight recorder's last_n events as a
+                JSON blob — identical content to HTTP /debug/vars +
+                /debug/trace and the embedded debug_snapshot.
 
 Verbs — responses:
 
@@ -84,6 +89,12 @@ SYNC_NODES = 0x03
 SYNC_PODS = 0x04
 METRICS = 0x05
 PING = 0x06
+# live introspection (ISSUE 13): u32 last_n (0 = vars only) -> the
+# unified telemetry-registry snapshot + the flight recorder's event
+# tail, identical content to HTTP /debug/vars + /debug/trace and the
+# embedded debug_snapshot — the wire twin of Borg's per-task
+# introspection endpoints
+STATS = 0x07
 
 VERDICT = 0x81
 BIND_RESULT = 0x82
@@ -93,6 +104,7 @@ ERROR = 0x86
 SYNCED = 0x87
 METRICS_TEXT = 0x88
 PONG = 0x89
+STATS_RESULT = 0x8A
 
 FLAG_COMPACT = 0x01
 
@@ -479,18 +491,45 @@ def decode_metrics_text(payload: bytes) -> str:
     return Reader(payload).str_()
 
 
+def encode_stats_request(last: int = 0) -> bytes:
+    """STATS request: how many trailing recorder events to include
+    (0 = registry vars only)."""
+    return bytes(Writer().u32(last).buf)
+
+
+def decode_stats_request(payload: bytes) -> int:
+    return Reader(payload).u32()
+
+
+def encode_stats_result(obj: Dict) -> bytes:
+    """STATS_RESULT: {"vars": <registry snapshot>, "trace": [events]}
+    as one JSON blob — introspection is a debug verb; the payload's
+    open-ended key set does not justify a bespoke struct layout."""
+    return bytes(Writer().blob(json.dumps(
+        obj, separators=(",", ":")).encode()).buf)
+
+
+def decode_stats_result(payload: bytes) -> Dict:
+    try:
+        return json.loads(Reader(payload).blob())
+    except ValueError as e:
+        raise FrameError(f"bad STATS payload: {e}") from e
+
+
 __all__ = [
     "BIND", "BIND_KINDS", "BIND_RESULT", "CODEC_JSON", "CODEC_PROTO",
     "DEADLINE", "ERROR", "FILTER", "FLAG_COMPACT", "FrameDecoder",
     "FrameError", "HEADER_SIZE", "MAX_FRAME", "METRICS", "METRICS_TEXT",
-    "OVERLOADED", "PING", "PONG", "Reader", "SYNCED", "SYNC_NODES",
-    "SYNC_PODS", "VERDICT", "Writer", "decode_bind_request",
-    "decode_bind_request_lazy", "decode_bind_result", "decode_error",
-    "decode_filter_request", "decode_filter_request_lazy",
-    "decode_items_blob", "decode_metrics_text", "decode_overloaded",
-    "decode_pod_blob", "decode_synced", "decode_verdict",
-    "encode_bind_request", "encode_bind_result", "encode_error",
-    "encode_filter_request", "encode_frame", "encode_items_blob",
-    "encode_metrics_text", "encode_overloaded", "encode_pod_blob",
+    "OVERLOADED", "PING", "PONG", "Reader", "STATS", "STATS_RESULT",
+    "SYNCED", "SYNC_NODES", "SYNC_PODS", "VERDICT", "Writer",
+    "decode_bind_request", "decode_bind_request_lazy",
+    "decode_bind_result", "decode_error", "decode_filter_request",
+    "decode_filter_request_lazy", "decode_items_blob",
+    "decode_metrics_text", "decode_overloaded", "decode_pod_blob",
+    "decode_stats_request", "decode_stats_result", "decode_synced",
+    "decode_verdict", "encode_bind_request", "encode_bind_result",
+    "encode_error", "encode_filter_request", "encode_frame",
+    "encode_items_blob", "encode_metrics_text", "encode_overloaded",
+    "encode_pod_blob", "encode_stats_request", "encode_stats_result",
     "encode_sync_request", "encode_synced", "encode_verdict",
 ]

@@ -254,6 +254,16 @@ class ChurnInjector:
 
     def _count(self, op: ChurnOp) -> None:
         self.applied[op.kind] = self.applied.get(op.kind, 0) + 1
+        from kubernetes_tpu.observability.recorder import (
+            CHURN_OP,
+            CHURN_OP_CODES,
+            RECORDER,
+        )
+        if RECORDER.enabled:
+            # flight-recorder marker (ISSUE 13): the fault lands on the
+            # same time axis as the waves it perturbed
+            RECORDER.record(CHURN_OP, a=CHURN_OP_CODES.get(op.kind, -1),
+                            b=1)
 
     def _apply(self, op: ChurnOp) -> None:
         api = self.api

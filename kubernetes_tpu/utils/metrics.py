@@ -27,23 +27,40 @@ DEFAULT_BUCKETS = exponential_buckets(0.001, 2, 15)
 
 
 class Histogram:
+    # bound on retained sample-store entries (weighted tuples + chunk
+    # elements + compacted reservoir points). The r10 always-on loop made
+    # unbounded growth a real leak: create_to_bound appends one chunk per
+    # WAVE forever — at 20k pods/s that is ~7 GB/hour of float64 samples.
+    # Past the bound the store compacts to a weighted quantile reservoir
+    # (RESERVOIR_MAX // 4 points at equal-mass ranks), bounding memory at
+    # O(RESERVOIR_MAX) while percentile() stays exact below the bound and
+    # rank-accurate to ~total/k above it (test-pinned on a known
+    # distribution in tests/test_observability.py).
+    RESERVOIR_MAX = 65536
+
     def __init__(self, name: str, help_text: str = "",
-                 buckets: List[float] = None):
+                 buckets: List[float] = None,
+                 reservoir_max: int = 0):
         self.name = name
         self.help = help_text
         self.buckets = list(buckets or DEFAULT_BUCKETS)
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
-        # (value, multiplicity) samples for exact percentiles in benches —
+        # (value, multiplicity) samples for percentiles in benches —
         # weighted so a 30k-pod batch round is one entry, not 30k appends.
         # observe_batch keeps its per-pod arrays as raw numpy chunks
         # instead (zero per-value Python objects on the drain hot path —
         # the r5 version built 30k (float, 1) tuples per round, a measured
         # slice of the 0.559->0.898s headline regression); percentile()
-        # merges both stores.
+        # merges both stores plus the compacted reservoir.
         self._values: List[tuple] = []
         self._chunks: List = []
+        self._res_vals = None   # compacted reservoir: sorted values
+        self._res_wts = None    # ... and their (float) multiplicities
+        self._points = 0        # retained entries across all three stores
+        self._compactions = 0
+        self.reservoir_max = int(reservoir_max) or self.RESERVOIR_MAX
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -55,6 +72,9 @@ class Histogram:
         self._sum += v * n
         self._count += n
         self._values.append((v, n))
+        self._points += 1
+        if self._points > self.reservoir_max:
+            self._compact_locked()
 
     def observe_many(self, v: float, n: int) -> None:
         """Record n observations of the same value (one lock, one append) —
@@ -81,6 +101,9 @@ class Histogram:
             self._sum += float(arr.sum())
             self._count += len(values)
             self._chunks.append(arr)
+            self._points += len(arr)
+            if self._points > self.reservoir_max:
+                self._compact_locked()
 
     @property
     def count(self) -> int:
@@ -90,32 +113,91 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def totals(self):
+        """(count, sum) read under the lock — the telemetry registry's
+        torn-read-free accessor (count and sum advance together under
+        observe; reading the properties separately could tear)."""
+        with self._lock:
+            return self._count, self._sum
+
+    @property
+    def stored_points(self) -> int:
+        """Retained sample-store entries — what the bounded-growth test
+        pins (memory is O(stored_points), never O(count))."""
+        with self._lock:
+            return self._points
+
+    def _merged_locked(self):
+        """All three stores as (sorted values, aligned weights), or None
+        when empty. Read-time cost only — never on the observe path."""
+        import numpy as np
+        vparts, wparts = [], []
+        if self._res_vals is not None:
+            vparts.append(self._res_vals)
+            wparts.append(self._res_wts)
+        if self._values:
+            vparts.append(np.array([v for v, _ in self._values],
+                                   dtype=np.float64))
+            wparts.append(np.array([n for _, n in self._values],
+                                   dtype=np.float64))
+        for c in self._chunks:
+            vparts.append(c)
+            wparts.append(np.ones(len(c)))
+        if not vparts:
+            return None
+        v = np.concatenate(vparts)
+        w = np.concatenate(wparts)
+        order = np.argsort(v, kind="stable")
+        return v[order], w[order]
+
+    def _compact_locked(self) -> None:
+        """Fold every retained sample into a bounded weighted reservoir:
+        k points at equal-mass ranks (stratum centers), stratum masses as
+        weights — total mass preserved exactly, rank error per later
+        percentile() bounded by ~total/k per compaction."""
+        import numpy as np
+        merged = self._merged_locked()
+        self._values = []
+        self._chunks = []
+        if merged is None:
+            self._res_vals = self._res_wts = None
+            self._points = 0
+            return
+        v, w = merged
+        k = max(self.reservoir_max // 4, 16)
+        if len(v) <= k:
+            self._res_vals, self._res_wts = v, w
+            self._points = len(v)
+            return
+        cum = np.cumsum(w)
+        total = cum[-1]
+        centers = (np.arange(k) + 0.5) * (total / k)
+        idx = np.minimum(np.searchsorted(cum, centers, side="right"),
+                         len(v) - 1)
+        edges = np.arange(1, k) * (total / k)
+        self._res_vals = v[idx]
+        self._res_wts = np.diff(np.concatenate([[0.0], edges, [total]]))
+        self._points = k
+        self._compactions += 1
+
     def percentile(self, p: float) -> float:
-        """Exact percentile over both stores (weighted values + raw
-        chunks), merged with a two-pointer walk — sorting happens here, at
-        read time (benches call this a handful of times), never on the
-        observe hot path."""
+        """Percentile over the merged stores: exact while the sample
+        store is under the reservoir bound (rank semantics identical to
+        the pre-r15 two-pointer walk), rank-accurate to ~total/k once
+        compaction has folded history into the weighted reservoir."""
         import numpy as np
         with self._lock:
-            vs = sorted(self._values)
-            arr = np.sort(np.concatenate(self._chunks)) if self._chunks \
-                else np.empty(0)
-            total = sum(n for _, n in vs) + len(arr)
-            if total == 0:
+            merged = self._merged_locked()
+            if merged is None:
+                return 0.0
+            v, w = merged
+            cum = np.cumsum(w)
+            total = cum[-1]
+            if total <= 0:
                 return 0.0
             target = min(int(p / 100.0 * total), total - 1)
-            cum = 0
-            ai = 0
-            for v, n in vs:
-                j = int(np.searchsorted(arr, v, side="left"))
-                if cum + (j - ai) > target:
-                    return float(arr[ai + target - cum])
-                cum += j - ai
-                ai = j
-                if target < cum + n:
-                    return v
-                cum += n
-            return float(arr[ai + target - cum])
+            i = int(np.searchsorted(cum, target, side="right"))
+            return float(v[min(i, len(v) - 1)])
 
     def render(self) -> str:
         with self._lock:

@@ -1042,3 +1042,79 @@ def test_gl003_fires_on_ragged_per_shard_slice_into_reduce(tmp_path):
     assert not errors, errors
     assert not [f for f in findings if f.rule == "GL003"
                 and "whole_table_reduce" in f.path], findings
+
+
+def test_gl002_flight_recorder_stays_host_pure(tmp_path):
+    """ISSUE 13: the flight recorder's emit sites record TIMESTAMPS and
+    host ints already in hand — they never fetch a device value (one
+    unblessed fetch per wave "to log it" would serialize the pipeline at
+    the dispatch seam, the exact hazard the overlap story rests on). The
+    registry built over the REAL engine sources must produce ZERO GL002
+    findings over the observability modules; a recorder-shaped consumer
+    that DOES fetch a jitted result to populate an event fires — the
+    silence is the recorder's purity, not the rule going blind."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    eng_py = os.path.join(PKG_DIR, "engine", "scheduler_engine.py")
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    obs_files = [
+        os.path.join(PKG_DIR, "observability", "recorder.py"),
+        os.path.join(PKG_DIR, "observability", "registry.py"),
+        os.path.join(PKG_DIR, "observability", "perfetto.py"),
+    ]
+    # scan sanity: an empty jit registry would pass vacuously
+    index = ProjectIndex()
+    for src in (eng_py, waves_py):
+        with open(src, "r", encoding="utf-8") as fh:
+            index.scan(ast.parse(fh.read()))
+    assert "waves_loop" in index.jitted_names
+    findings, _sup, errors = run_paths([eng_py, waves_py] + obs_files,
+                                       rules=["GL002"])
+    assert not errors, errors
+    tainted = [f for f in findings
+               if any(os.path.basename(o) in f.path for o in obs_files)]
+    assert not tainted, tainted
+    # negative control: an event emission that fetches the jitted packed
+    # result to fill its fields fires GL002
+    bad = tmp_path / "bad_recorder_emit.py"
+    bad.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.waves import waves_loop
+        from kubernetes_tpu.observability.recorder import HARVEST, RECORDER
+
+        def record_wave(cls_arr, nodes, state, pc, ctr, prios):
+            packed, _st = waves_loop(cls_arr, nodes, state, pc, ctr,
+                                     prios)
+            fetched = np.asarray(packed)
+            RECORDER.record(HARVEST, a=int(fetched[0]))
+            return fetched
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(bad)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "record_wave" in f.context
+               for f in findings), findings
+    # the shipped shape — timestamps + host ints, no device touch — is
+    # silent even when it calls the jitted entry point in the same scope
+    good = tmp_path / "good_recorder_emit.py"
+    good.write_text(textwrap.dedent("""
+        import time
+        from kubernetes_tpu.engine.waves import waves_loop
+        from kubernetes_tpu.observability.recorder import DISPATCH, RECORDER
+
+        def record_wave(cls_arr, nodes, state, pc, ctr, prios, n):
+            t0 = time.monotonic()
+            packed, _st = waves_loop(cls_arr, nodes, state, pc, ctr,
+                                     prios)
+            if RECORDER.enabled:
+                RECORDER.record(DISPATCH, t0=t0,
+                                dur=time.monotonic() - t0, a=n)
+            return packed
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(good)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "good_recorder_emit" in f.path], \
+        findings
