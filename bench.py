@@ -28,7 +28,14 @@ scenario (ISSUE 8): BENCH_CHURN=0 to skip,
 BENCH_CHURN_RATE (offered rate; default the arrival rate),
 BENCH_CHURN_SEED, BENCH_CHURN_NODE_PCT_MIN (node churn fraction/min,
 default 0.10), BENCH_CHURN_BIND_FAIL / BENCH_CHURN_BIND_TIMEOUT
-(injected bind-fault rates). Multi-frontend fleets (ISSUE 9/11):
+(injected bind-fault rates). Priority/preemption scenario (ISSUE 14):
+BENCH_PRIORITY=0 to skip, BENCH_PRIO_NODES (default 240 — sized so the
+offered stream overcommits the cluster), BENCH_PRIO_RATE (default
+2000), BENCH_PRIO_SECONDS (default 4), BENCH_PRIO_EVICT_FAIL /
+BENCH_PRIO_EVICT_TIMEOUT (injected eviction-fault rates on the
+victim-delete seam), BENCH_PRIO_EVICT_PER_MIN (disruption budget; the
+scenario HARD-FAILS if any sliding window exceeds it).
+Multi-frontend fleets (ISSUE 9/11):
 BENCH_MULTIFRONTEND=0 to skip, BENCH_MF_CLIENTS/BENCH_MF_NODES/
 BENCH_MF_STALE_MS/BENCH_MF_PODS_PER_CLIENT; every client count runs
 BOTH transports (threaded HTTP `clients_*` and async binary wire
@@ -1938,6 +1945,213 @@ def measure_churn(n_nodes: int, rate: float, duration_s: float,
     }
 
 
+def measure_priority_churn(n_nodes: int = 240, rate: float = 2000.0,
+                           duration_s: float = 4.0,
+                           budget_ms: float = 250.0,
+                           drain_s: float = 0.0,
+                           evict_fail_rate: float = 0.02,
+                           evict_timeout_rate: float = 0.01,
+                           max_evictions_per_min: int = 6000):
+    """THE ISSUE 14 scenario: an OVERCOMMITTED cluster under a mixed-band
+    arrival stream — offered pods exceed capacity by design, so the high
+    bands can only land by displacing the low bands through the wave
+    path's atomic preemption, under injected eviction FAILURES and
+    landed-but-timed-out evictions on the victim-delete seam.
+
+    Reported: preemption-latency percentiles (propose -> atomic
+    commit-complete per committed preemption), victims-per-preemption,
+    commit/rollback/budget counters, per-band bound fractions at the
+    end, and the hard audits — the scenario RAISES (numbers over a
+    broken invariant are not numbers) on any duplicate bind, any
+    double-eviction or ghost victim against store truth, or any sliding
+    60 s window exceeding the configured disruption budget."""
+    import threading
+
+    import numpy as np
+
+    from kubernetes_tpu.engine.preempt_wave import DisruptionBudget
+    from kubernetes_tpu.engine.scheduler import Scheduler
+    from kubernetes_tpu.models.hollow import (
+        PRIORITY_BANDS,
+        PROFILES,
+        hollow_nodes,
+        load_cluster,
+    )
+    from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+    from kubernetes_tpu.testing.churn import (
+        FaultyBindApi,
+        audit_cache_vs_store,
+        audit_store_transitions,
+    )
+    from kubernetes_tpu.utils import features
+    from kubernetes_tpu.utils.trace import COUNTERS
+
+    total = int(rate * duration_s)
+    if not drain_s:
+        drain_s = max(6.0, duration_s)
+    min_q, max_q = 256, 2048
+    # the wave-shape ladder compiles with the gate OFF (run_until_drained
+    # routes PodPriority drains classic, which would skip the wave jits)
+    sizes, s = [], min_q
+    while s <= max_q:
+        sizes.append(s)
+        s *= 2
+    _warm_stream_shapes(n_nodes, sizes, profile="priority_churn")
+    features.DEFAULT_FEATURE_GATE.set("PodPriority", True)
+    try:
+        api = ApiServerLite(max_log=max(400_000, 6 * (n_nodes + total)))
+        nodes = hollow_nodes(n_nodes)
+        load_cluster(api, nodes, [])
+        api = FaultyBindApi(api, seed=7,
+                            evict_fail_rate=evict_fail_rate,
+                            evict_timeout_rate=evict_timeout_rate)
+        pods = PROFILES["priority_churn"](total)
+        pod_prio = {p.key(): p.priority for p in pods}
+        sched = Scheduler(api, record_events=False)
+        sched.disruption_budget = DisruptionBudget(
+            max_evictions_per_min=max_evictions_per_min)
+        sched.start()
+        loop = sched.stream(budget_s=budget_ms / 1e3, min_quantum=min_q,
+                            max_quantum=max_q)
+        # compile the victim-scan jit before the measured window
+        sched.engine._refresh()
+        probe = PROFILES["priority_churn"](1)[0]
+        sched.engine.preempt_scan([probe])
+        counters0 = {k: v[0] for k, v in COUNTERS.snapshot().items()}
+        created = [0]
+        bind_events = []
+        plog = []  # (t_rel, latency_s, victims) per committed preemption
+        t0 = time.monotonic()
+        sched.wave_observer = lambda ts, keys: bind_events.append(
+            (ts - t0, keys))
+        sched.preempt_observer = lambda ts, lat, nv: plog.append(
+            (ts - t0, lat, nv))
+        max_burst = max(4, int(rate * 0.004))
+
+        def creator():
+            while created[0] < total:
+                now = time.monotonic() - t0
+                due = min(total, int(rate * now), created[0] + max_burst)
+                if due > created[0]:
+                    for p in pods[created[0]:due]:
+                        api.create("Pod", p)
+                    created[0] = due
+                delay = t0 + (created[0] + 1) / rate - time.monotonic()
+                if delay > 0:
+                    time.sleep(min(delay, 0.002))
+
+        th = threading.Thread(target=creator, daemon=True)
+        th.start()
+        t_stop = t0 + duration_s + drain_s
+        agg = {"degraded_steps": 0, "preemptions": 0,
+               "preempt_rollbacks": 0, "victims_evicted": 0,
+               "budget_deferred": 0}
+
+        def note(stats, _loop):
+            for k in agg:
+                agg[k] += stats.get(k, 0)
+
+        def done(stats, _loop) -> bool:
+            # an overcommitted cluster never settles (the displaced low
+            # bands legitimately wait forever) — the stop is wall-clock
+            return created[0] >= total and time.monotonic() >= t_stop
+
+        try:
+            loop.run(done, on_step=note)
+        finally:
+            loop.close()
+        th.join(timeout=10)
+        sched.sync()  # drain the final watch events before auditing
+        sched.wave_observer = None
+        sched.preempt_observer = None
+        counters1 = {k: v[0] for k, v in COUNTERS.snapshot().items()}
+
+        def cnt(name):
+            return counters1.get(name, 0) - counters0.get(name, 0)
+
+        # ---- hard audits -------------------------------------------
+        # duplicate binds reconcile against STORE truth: an evicted
+        # victim that later REBINDS is the starvation guard working (two
+        # observer events, two store binds with an eviction between) —
+        # a duplicate is the scheduler REPORTING more binds for a pod
+        # than the store ever accepted
+        trans = audit_store_transitions(api)
+        observed: dict = {}
+        for _ts, keys in bind_events:
+            for k in keys:
+                observed[k] = observed.get(k, 0) + 1
+        dup = sum(max(0, c - trans["binds"].get(k, 0))
+                  for k, c in observed.items())
+        over_evicted = [k for k, c in trans["evicts"].items()
+                        if c > trans["binds"].get(k, 0)]
+        ghosts = audit_cache_vs_store(sched, api)
+        # sliding-window budget check over the actual eviction instants
+        evict_ts = sorted(t for t, _lat, nv in plog for _ in range(nv))
+        window_peak = 0
+        j = 0
+        for i, t in enumerate(evict_ts):
+            while evict_ts[j] <= t - DisruptionBudget.WINDOW_S:
+                j += 1
+            window_peak = max(window_peak, i - j + 1)
+        if dup or over_evicted or ghosts \
+                or window_peak > max_evictions_per_min:
+            raise RuntimeError(
+                f"priority_churn invariant broken: duplicate_binds={dup} "
+                f"double_evictions={len(over_evicted)} "
+                f"ghost_discrepancies={ghosts[:5]} "
+                f"budget_window_peak={window_peak}/"
+                f"{max_evictions_per_min}")
+        # ---- per-band outcome against store truth ------------------
+        store_bound = {p.key() for p in api.list("Pod")[0]
+                       if p.node_name}
+        band_of = {v: k for k, v in PRIORITY_BANDS.items()}
+        band_tot: dict = {}
+        band_bnd: dict = {}
+        for p in pods:
+            b = band_of.get(pod_prio[p.key()], "other")
+            band_tot[b] = band_tot.get(b, 0) + 1
+            if p.key() in store_bound:
+                band_bnd[b] = band_bnd.get(b, 0) + 1
+        lats = np.array([lat for _t, lat, _nv in plog])
+        vics = np.array([nv for _t, _lat, nv in plog])
+        n_commit = len(plog)
+        return {
+            "prio_offered_pods": total,
+            "prio_nodes": n_nodes,
+            "prio_offered_pods_s": float(rate),
+            "prio_bound": len(store_bound),
+            "prio_band_bound_fraction": {
+                b: round(band_bnd.get(b, 0) / band_tot[b], 3)
+                for b in band_tot},
+            "prio_preempt_commits": cnt("engine.preempt_commits"),
+            "prio_preempt_rollbacks": cnt("engine.preempt_rollbacks"),
+            "prio_victims_evicted": cnt("engine.victims_evicted"),
+            "prio_budget_deferred": cnt("engine.preempt_budget_deferred"),
+            "prio_preempt_scan_dispatches":
+                cnt("engine.preempt_scan_dispatch"),
+            "prio_preempt_latency_p50_ms":
+                round(float(np.percentile(lats, 50)) * 1e3, 3)
+                if n_commit else None,
+            "prio_preempt_latency_p99_ms":
+                round(float(np.percentile(lats, 99)) * 1e3, 3)
+                if n_commit else None,
+            "prio_victims_per_preemption":
+                round(float(vics.mean()), 3) if n_commit else None,
+            "prio_budget_window_peak": int(window_peak),
+            "prio_budget_max_per_min": int(max_evictions_per_min),
+            "prio_injected_evict_failures": int(
+                api.injected_evict_failures),
+            "prio_injected_evict_timeouts": int(
+                api.injected_evict_timeouts),
+            "prio_duplicate_binds": int(dup),
+            "prio_double_evictions": len(over_evicted),
+            "prio_ghost_discrepancies": len(ghosts),
+            "prio_degraded_steps": int(agg["degraded_steps"]),
+        }
+    finally:
+        features.DEFAULT_FEATURE_GATE.reset()
+
+
 def measure_extender_latency(n_nodes: int, rounds: int = 20):
     """Real HTTP /filter + /prioritize latency against the TPU backend at
     n_nodes (the 5s extender budget of core/extender.go:36, measured on
@@ -2602,6 +2816,31 @@ def main():
             import sys
             print(f"bench: churn measurement failed: {e}", file=sys.stderr)
 
+    # priority / preemption scenario (ISSUE 14): overcommitted cluster,
+    # mixed Borg-style bands, wave-path atomic preemption under injected
+    # eviction faults — hard-fails on any duplicate bind, double
+    # eviction, ghost victim, or disruption-budget breach
+    # (BENCH_PRIORITY=0 to skip; BENCH_PRIO_* knobs)
+    priority_churn = None
+    if os.environ.get("BENCH_PRIORITY", "1") != "0":
+        try:
+            priority_churn = measure_priority_churn(
+                n_nodes=int(os.environ.get("BENCH_PRIO_NODES", 240)),
+                rate=float(os.environ.get("BENCH_PRIO_RATE", 2000)),
+                duration_s=float(
+                    os.environ.get("BENCH_PRIO_SECONDS", 4.0)),
+                budget_ms=arrival_budget,
+                evict_fail_rate=float(
+                    os.environ.get("BENCH_PRIO_EVICT_FAIL", 0.02)),
+                evict_timeout_rate=float(
+                    os.environ.get("BENCH_PRIO_EVICT_TIMEOUT", 0.01)),
+                max_evictions_per_min=int(
+                    os.environ.get("BENCH_PRIO_EVICT_PER_MIN", 6000)))
+        except Exception as e:
+            import sys
+            print(f"bench: priority_churn measurement failed: {e}",
+                  file=sys.stderr)
+
     # multi-frontend fleet (ISSUE 9): N concurrent compat scheduleOne
     # loops on ONE sidecar over HTTP — coalesced dispatch, Omega fence,
     # exactly-once binds under injected faults, store-truth audited
@@ -2814,7 +3053,8 @@ def main():
         "scale_sweep": scale_sweep,
         "scale_sharded_equals_unsharded": scale_sweep.get(
             "sharded_equals_unsharded_all") if scale_sweep else None,
-    }, **(churn or {}), **(mixed or {}), **(gangmix or {}))
+    }, **(churn or {}), **(priority_churn or {}), **(mixed or {}),
+        **(gangmix or {}))
     print(json.dumps(out))
 
     # resume the bench trajectory: persist this round's numbers as the
@@ -2823,7 +3063,7 @@ def main():
     # working. BENCH_ARTIFACT= (empty) disables, or names another round;
     # the default is pinned to THIS round so a bench run can never
     # rewrite a prior round's file as commit noise (ISSUE 11 satellite).
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r15.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r16.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

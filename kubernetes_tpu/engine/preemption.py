@@ -180,15 +180,23 @@ class PreemptionState:
 
 
 def _select_victims(pod: Pod, info: NodeInfo,
-                    ctx=None) -> Optional[List[Pod]]:
+                    ctx=None, evictable=None) -> Optional[List[Pod]]:
     """selectVictimsOnNode: start from all lower-priority pods evicted;
     if the preemptor fits, reprieve highest-priority victims first while
     it keeps fitting. Returns the minimal victim set, or None if the
-    node is infeasible even with everything gone."""
-    potential = [p for p in info.pods if p.priority < pod.priority]
+    node is infeasible even with everything gone.
+
+    ``evictable`` (ISSUE 14): optional predicate narrowing the potential
+    victim set — the wave path passes a store-confirmed-bound filter so
+    an assumed-but-unconfirmed pod (unbound at the store; its eviction
+    write would abort the atomic preempt commit) is never planned as a
+    victim. None keeps the classic all-lower-priority semantics."""
+    potential = [p for p in info.pods if p.priority < pod.priority
+                 and (evictable is None or evictable(p))]
     if not potential:
         return None
-    keep = [p for p in info.pods if p.priority >= pod.priority]
+    pot_keys = {p.key() for p in potential}
+    keep = [p for p in info.pods if p.key() not in pot_keys]
     base = NodeInfo(info.node)
     for p in keep:
         base.add_pod(p)

@@ -49,6 +49,15 @@ class PodBackoff:
 
 
 class SchedulingQueue:
+    # starvation guard (ISSUE 14): a pod that has waited this long pops
+    # AHEAD of the priority order — under a sustained high-priority
+    # offered stream, a preempted low-priority victim would otherwise
+    # never reach the head of a priority-sorted queue (Tiresias' aging
+    # discipline, PAPERS.md §Tiresias). The stamp survives backoff
+    # requeues (waiting is cumulative from first admission) and clears
+    # on terminal removal (bind confirmation, deletion).
+    AGING_THRESHOLD_S = 30.0
+
     def __init__(self, now: Callable[[], float] = time.monotonic):
         self._now = now
         self._lock = threading.Condition()
@@ -56,6 +65,8 @@ class SchedulingQueue:
         self._keys: Dict[str, Pod] = {}
         self._deferred: List = []  # heap of (ready_time, seq, pod)
         self._seq = 0
+        self._queued_at: Dict[str, float] = {}  # first-admission stamp
+        self.aging_threshold_s = self.AGING_THRESHOLD_S
         self.backoff = PodBackoff(now=now)
 
     def add(self, pod: Pod) -> None:
@@ -63,6 +74,7 @@ class SchedulingQueue:
             key = pod.key()
             if key in self._keys:
                 return
+            self._queued_at.setdefault(key, self._now())
             self._keys[key] = pod
             self._fifo.append(pod)
             self._lock.notify_all()
@@ -75,10 +87,13 @@ class SchedulingQueue:
         with self._lock:
             keys = self._keys
             fifo = self._fifo
+            now = self._now()
+            stamps = self._queued_at
             for pod in pods:
                 key = pod.key()
                 if key in keys:
                     continue
+                stamps.setdefault(key, now)
                 keys[key] = pod
                 fifo.append(pod)
             self._lock.notify_all()
@@ -89,6 +104,7 @@ class SchedulingQueue:
             key = pod.key()
             if key in self._keys:
                 return 0.0
+            self._queued_at.setdefault(key, self._now())
             delay = self.backoff.next_delay(key)
             self._keys[key] = pod
             self._seq += 1
@@ -99,6 +115,7 @@ class SchedulingQueue:
     def remove(self, pod_key: str) -> None:
         """Drop a pod (deleted / scheduled by someone else)."""
         with self._lock:
+            self._queued_at.pop(pod_key, None)  # terminal: stamp clears
             if self._keys.pop(pod_key, None) is not None:
                 self._fifo = [p for p in self._fifo if p.key() != pod_key]
                 self._deferred = [(t, s, p) for (t, s, p) in self._deferred
@@ -111,6 +128,9 @@ class SchedulingQueue:
         popped before binding), so absence costs one set probe per key and
         the list rebuilds happen at most once per batch."""
         with self._lock:
+            stamps = self._queued_at
+            for k in pod_keys:
+                stamps.pop(k, None)  # terminal: bind confirmed
             present = {k for k in pod_keys if k in self._keys}
             if not present:
                 return
@@ -133,8 +153,20 @@ class SchedulingQueue:
                         # priority queue semantics (1.8's podqueue
                         # heap ordered by priority): higher priority
                         # pops first; stable sort keeps FIFO order
-                        # within a priority band
-                        self._fifo.sort(key=lambda p: -p.priority)
+                        # within a priority band. AGED pods lead the
+                        # whole order (ISSUE 14 starvation guard): a
+                        # preempted victim that has waited past the
+                        # aging threshold pops before fresh
+                        # high-priority arrivals, so it rebinds the
+                        # moment capacity frees instead of starving
+                        # behind a sustained high-band stream.
+                        now = self._now()
+                        age = self.aging_threshold_s
+                        stamps = self._queued_at
+                        self._fifo.sort(
+                            key=lambda p:
+                            (0 if now - stamps.get(p.key(), now) >= age
+                             else 1, -p.priority))
                     n = len(self._fifo) if max_n == 0 else min(max_n, len(self._fifo))
                     out = self._fifo[:n]
                     self._fifo = self._fifo[n:]

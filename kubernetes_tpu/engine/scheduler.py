@@ -61,6 +61,10 @@ from typing import Dict, List, Optional, Tuple
 from kubernetes_tpu.api.types import Binding, Event, Node, Pod
 from kubernetes_tpu.api.workloads import to_workload_object
 from kubernetes_tpu.engine import gang as gangmod
+from kubernetes_tpu.engine.preempt_wave import (
+    DisruptionBudget,
+    plan_wave_preemptions,
+)
 from kubernetes_tpu.engine.queue import SchedulingQueue
 from kubernetes_tpu.engine.scheduler_engine import (
     PlacementResult,
@@ -152,6 +156,21 @@ class Scheduler:
         # (bench.measure_gang_mix flips this attribute for the
         # gangmix_flush_elapsed_s measurement).
         self.gang_pipeline = True
+        # wave-path preemption (ISSUE 14): with the PodPriority gate on,
+        # a harvest's unschedulable preemptors plan displacements against
+        # the snapshot's priority bands and commit through the store's
+        # ATOMIC evict+bind — the pipeline never flushes for priority.
+        # False keeps the classic nominate-then-reschedule rounds as the
+        # only preemption path (and run_until_drained's auto-select
+        # still routes PodPriority drains classic regardless).
+        self.wave_preemption = True
+        # PodDisruptionBudget-shaped eviction rate limit: sliding
+        # max-evictions-per-minute window plus optional per-band floors;
+        # denied plans count budget_deferred and wait out their backoff.
+        self.disruption_budget = DisruptionBudget(now=now)
+        # bench hook: preempt_observer(commit_monotonic, latency_s,
+        # victim_count) after every committed preemption. None = off.
+        self.preempt_observer = None
         self.metrics = SchedulerMetrics()
         # unified telemetry (ISSUE 13): this scheduler's histograms +
         # counters in the one labeled namespace; a live ScheduleLoop
@@ -779,6 +798,8 @@ class Scheduler:
         columnar. This is the work wave k+1's device time hides."""
         res = self.engine.harvest_waves(handle)
         out = {"popped": 0, "bound": 0, "bind_errors": 0, "preemptions": 0,
+               "preempt_rollbacks": 0, "victims_evicted": 0,
+               "budget_deferred": 0,
                "unschedulable": len(res.unschedulable),
                "fence_requeued": len(res.conflicts),
                "gang_requeued": len(res.gang_requeued),
@@ -816,6 +837,7 @@ class Scheduler:
             self.queue.add_backoff(pod)
         for pod in res.conflicts:
             self.queue.add(pod)  # node_name never set on a fenced pod
+        preemptors = None
         if res.unschedulable:
             self.metrics.failed.inc(len(res.unschedulable))
             for pod, fcnt in res.unschedulable:
@@ -825,7 +847,19 @@ class Scheduler:
                         f"0/{len(self.engine.snapshot.node_names)} nodes "
                         f"available (fit_count={fcnt})")
                 self.queue.add_backoff(pod)
+            # wave-path preemption (ISSUE 14): the harvest's unschedulable
+            # preemptors displace lower bands WITHOUT flushing the
+            # pipeline — planned below, AFTER this wave's binding pass,
+            # so a victim choice can never race a not-yet-posted bind
+            # (the classic round's ordering, kept)
+            if self.wave_preemption and features.enabled("PodPriority") \
+                    and any(p.priority > 0 for p, _f in res.unschedulable):
+                preemptors = [p for p, _f in res.unschedulable]
         if not res.bound:
+            if preemptors:
+                for k, v in self._preempt_wave(preemptors,
+                                               handle.wave_id).items():
+                    out[k] = out.get(k, 0) + v
             return out
         tb0 = time.monotonic()
         errs = self._bind_bulk(res.bound)
@@ -855,6 +889,127 @@ class Scheduler:
             [bind_done - fq_pop(k, pop_ts) for k in keys])
         if self.wave_observer is not None:
             self.wave_observer(bind_done, keys)
+        if preemptors:
+            for k, v in self._preempt_wave(preemptors,
+                                           handle.wave_id).items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def _preempt_wave(self, preemptors: List[Pod],
+                      wave_id: int = -1) -> Dict[str, int]:
+        """One wave-path preemption round (ISSUE 14): plan displacements
+        for this harvest's unschedulable preemptors (device victim scan +
+        exact verification, engine/preempt_wave.py), rate-limit them
+        through the disruption budget, and COMMIT each survivor through
+        the store's atomic evict+bind:
+
+        - success: victims leave the cache immediately (their watch
+          MODIFIED-unbound events re-enter them as ordinary arrivals the
+          streaming loop absorbs), the preemptor assumes + finishes
+          binding exactly like a fenced wave placement — either EVERY
+          victim eviction landed AND the preemptor bound, or nothing did;
+        - error: rollback — the preemptor stays on the backoff requeue
+          _complete_wave already gave it, local state untouched. If the
+          error hid a landed commit (the at-most-once ambiguity the
+          injected eviction TIMEOUT reproduces), the watch stream heals:
+          sync() runs before every pop, so the preemptor's confirmation
+          removes it from the queue before any retry could double-bind.
+
+        Victims are restricted to store-confirmed bound pods (an assumed
+        claim is unbound at the store; planning it would abort commits)."""
+        from kubernetes_tpu.utils.trace import COUNTERS
+
+        out = {"preemptions": 0, "preempt_rollbacks": 0,
+               "victims_evicted": 0, "budget_deferred": 0}
+        api_op = getattr(self.api, "preempt_pods_bulk", None)
+        if api_op is None:
+            return out  # store cannot commit atomically: no wave path
+        t_plan = time.monotonic()
+        pods_map = self._pods
+
+        def _evictable(p: Pod) -> bool:
+            q = pods_map.get(p.key())
+            return q is not None and bool(q.node_name)
+
+        plans = plan_wave_preemptions(
+            self.engine, preemptors, evictable=_evictable,
+            workloads=self.engine.workloads_provider())
+        if RECORDER.enabled:
+            RECORDER.record(flightrec.PREEMPT_PROPOSE, wave=wave_id,
+                            t0=t_plan, dur=time.monotonic() - t_plan,
+                            a=len(preemptors), b=len(plans))
+        if not plans:
+            return out
+        budget = self.disruption_budget
+        band_counts = self.engine.snapshot.band_bound_counts() \
+            if budget.band_floor else None
+        record = self.record_events
+        snap_index = self.engine.snapshot.node_index
+        for plan in plans:
+            pod = plan.pod
+            if not budget.admit(plan.victims, band_counts):
+                out["budget_deferred"] += 1
+                COUNTERS.inc("engine.preempt_budget_deferred")
+                if record:
+                    self._event(pod, "Normal", "PreemptionDeferred",
+                                "disruption budget exhausted")
+                continue
+            err = api_op(plan.victims,
+                         Binding(pod.name, pod.namespace, pod.uid,
+                                 plan.node_name))
+            if err is not None:
+                out["preempt_rollbacks"] += 1
+                COUNTERS.inc("engine.preempt_rollbacks")
+                if record:
+                    self._event(pod, "Warning", "FailedPreemption", err)
+                if RECORDER.enabled:
+                    RECORDER.record(flightrec.PREEMPT_ROLLBACK,
+                                    wave=wave_id, a=len(plan.victims),
+                                    b=int("landed" in err))
+                continue
+            bind_done = time.monotonic()
+            key = pod.key()
+            # victims leave the cache NOW — the store op landed, and
+            # phantom occupancy would hide the freed hole from the next
+            # wave; the watch handlers re-apply both sides idempotently
+            for vic in plan.victims:
+                self.cache.remove_pod(vic)
+                if record:
+                    self._event(vic, "Normal", "Preempted",
+                                f"by {key} on node {plan.node_name}")
+            self.queue.remove(key)  # it was backoff-requeued above
+            pod.node_name = plan.node_name
+            self.cache.assume_pod(pod)
+            self.cache.finish_binding(pod)
+            self.engine.note_node_dirty(plan.node_name)
+            self.metrics.scheduled.inc(1)
+            self.metrics.create_to_bound.observe_batch(
+                [bind_done - self._first_queued.pop(key, t_plan)])
+            if self.wave_observer is not None:
+                self.wave_observer(bind_done, [key])
+            out["preemptions"] += 1
+            out["victims_evicted"] += len(plan.victims)
+            COUNTERS.inc("engine.preempt_commits")
+            COUNTERS.inc("engine.victims_evicted", len(plan.victims))
+            if record:
+                self._event(pod, "Normal", "TriggeredPreemption",
+                            f"{len(plan.victims)} lower-priority pod(s) "
+                            f"on {plan.node_name} evicted")
+            if self.preempt_observer is not None:
+                self.preempt_observer(bind_done, bind_done - t_plan,
+                                      len(plan.victims))
+            if RECORDER.enabled:
+                RECORDER.record(flightrec.PREEMPT_COMMIT, wave=wave_id,
+                                t0=t_plan, dur=bind_done - t_plan,
+                                a=len(plan.victims),
+                                b=snap_index.get(plan.node_name, -1))
+                RECORDER.record(flightrec.VICTIM_REQUEUE, wave=wave_id,
+                                a=len(plan.victims),
+                                b=min(v.priority for v in plan.victims))
+            if band_counts is not None:
+                for v in plan.victims:
+                    band_counts[v.priority] = \
+                        band_counts.get(v.priority, 1) - 1
         return out
 
     def pipeline(self, chunk: int = 0, overlap: bool = True):

@@ -53,6 +53,8 @@ from kubernetes_tpu.state.cache import SchedulerCache
 from kubernetes_tpu.state.classes import ClassBatch
 from kubernetes_tpu.state.snapshot import (
     ClusterSnapshot,
+    R_CPU,
+    R_MEM,
     R_OVERLAY,
     R_SCRATCH,
 )
@@ -877,7 +879,7 @@ class _WaveEncoding:
     __slots__ = ("vocab_gen", "labels_gen", "key_index", "reps", "cls_arr",
                  "num_classes",
                  "c_pad", "req_rows", "special", "derived", "ports_max",
-                 "raw_rows", "delta_ok", "adata", "wave_strict",
+                 "raw_rows", "delta_ok", "cls_prio", "adata", "wave_strict",
                  "has_aff_pod", "fits_on", "prio_on", "aff_seq",
                  "committed_nodes", "key_node", "static_forbid_hit",
                  "tail_cols", "aff_wave_dev", "aff_tail_dev",
@@ -951,6 +953,11 @@ class _WaveEncoding:
                                 req.storage_scratch, req.storage_overlay,
                                 ncpu, nmem)
             self.delta_ok[c] = not (ports or req.extended or special[c])
+        # per-class PRIORITY column (ISSUE 14): rides the raw-delta fold
+        # into the snapshot's band aggregates — class keys include
+        # priority (state/classes.py), so this is exact per class
+        self.cls_prio = np.fromiter((rep.priority for rep in reps),
+                                    dtype=np.int64, count=num_classes)
 
 
 class WaveHandle:
@@ -1072,6 +1079,12 @@ class SchedulingEngine:
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self._device_nodes = None
         self._device_version = -1
+        # priority-band device bundle for the wave-path victim scan
+        # (ISSUE 14): uploaded on demand, keyed on snapshot.version —
+        # preemption rounds are rare next to waves, so this stays out of
+        # _nodes_on_device and its upload counters entirely
+        self._prio_dev = None
+        self._prio_dev_version = -1
         # targeted-refresh bookkeeping: when the OWNER (one Scheduler that
         # routes every cache mutation through note_node_dirty/
         # note_full_refresh) sets track_dirty, _refresh() passes the dirty
@@ -1524,6 +1537,97 @@ class SchedulingEngine:
             snap.dirty_rows = set()  # arm row tracking for the next sync
         self._device_version = snap.version
         return self._device_nodes
+
+    # ------------------------------------------- wave-path preemption
+
+    def _prio_on_device(self):
+        """Device bundle for the victim scan: spare capacity columns plus
+        the priority-band aggregates, quantized at upload (band sums
+        CEIL, need floors — the over-approximation direction
+        ops/preempt.py documents). Re-uploaded whenever the snapshot
+        version moved; ~[N, B] int32s, a fraction of one wave upload."""
+        snap = self.snapshot
+        if self._prio_dev is not None \
+                and self._prio_dev_version == snap.version:
+            return self._prio_dev
+        shift = snap.mem_shift
+        host = {
+            "spare_cpu": (snap.alloc[:, R_CPU].astype(np.int64)
+                          - snap.requested[:, R_CPU]).astype(np.int32),
+            "spare_mem": (snap.alloc[:, R_MEM].astype(np.int64)
+                          - snap.requested[:, R_MEM]).astype(np.int32),
+            "pod_count": snap.pod_count,
+            "allowed": snap.allowed_pods,
+            "band_cpu": snap.band_cpu.astype(np.int32),
+            "band_mem": (-((-snap.band_mem) >> shift)).astype(np.int32),
+            "band_count": snap.band_count,
+            "band_prio": np.clip(snap.band_prio_host, -(2 ** 31) + 1,
+                                 2 ** 31 - 1).astype(np.int32),
+        }
+        # COPY, never alias: pod_count/allowed/band_* are live snapshot
+        # arrays mutated in place between preemption rounds (refresh
+        # deltas, apply_assume_delta band folds)
+        self._prio_dev = {
+            k: sanitize.upload_copied(v)  # graftlint: copy-required
+            for k, v in host.items()}
+        self._prio_dev_version = snap.version
+        return self._prio_dev
+
+    def preempt_scan(self, pods: Sequence[Pod]):
+        """ONE fused [C, N] victim pre-filter for a round of preemptors
+        (ISSUE 14): returns (candidate [C, N] bool, bound [C, N] int32,
+        class_of [len(pods)]) with C the padded unique-(need, priority)
+        class count — or None when the band vocab overflowed / priorities
+        exceed int32, routing the caller to the exact host pre-filter."""
+        from kubernetes_tpu.ops import preempt as preempt_ops
+        from kubernetes_tpu.utils.trace import COUNTERS
+
+        snap = self.snapshot
+        if snap.prio_band_overflow or not hasattr(snap, "band_cpu") \
+                or not pods:
+            return None
+        shift = snap.mem_shift
+        uniq: Dict[tuple, int] = {}
+        rows: List[tuple] = []
+        class_of: List[int] = []
+        for p in pods:
+            if not (-(2 ** 31) < p.priority < 2 ** 31):
+                return None
+            req = p.resource_request()
+            key = (req.milli_cpu, req.memory, p.priority)
+            c = uniq.get(key)
+            if c is None:
+                c = len(rows)
+                uniq[key] = c
+                # need: cpu exact, mem FLOOR-quantized (under-estimates
+                # need — the superset direction)
+                rows.append((req.milli_cpu, req.memory >> shift,
+                             p.priority))
+            class_of.append(c)
+        # pad the class axis to the bucket ladder (GL003: a ragged
+        # per-round preemptor count must never reach the jit); padding
+        # rows carry PAD_PRIO, below every band — no candidates
+        c_pad = bucket(len(rows), lo=4)
+        need_cpu = np.zeros(c_pad, dtype=np.int32)
+        need_mem = np.zeros(c_pad, dtype=np.int32)
+        prio = np.full(c_pad, preempt_ops.PAD_PRIO, dtype=np.int32)
+        for c, (cpu, mem_q, pr) in enumerate(rows):
+            need_cpu[c] = min(cpu, 2 ** 31 - 1)
+            need_mem[c] = min(mem_q, 2 ** 31 - 1)
+            prio[c] = pr
+        dev = self._prio_on_device()
+        COUNTERS.inc("engine.preempt_scan_dispatch")
+        cand_d, bound_d = preempt_ops.victim_scan_jit(
+            jnp.asarray(need_cpu), jnp.asarray(need_mem),
+            jnp.asarray(prio), dev["spare_cpu"], dev["spare_mem"],
+            dev["pod_count"], dev["allowed"], dev["band_cpu"],
+            dev["band_mem"], dev["band_count"], dev["band_prio"])
+        # the scan's one result fetch: the host planner consumes the
+        # candidate rows NOW — a preemption round is synchronous by
+        # contract (it runs inside the harvest tail)
+        cand = np.asarray(cand_d)  # graftlint: sync-ok
+        bound = np.asarray(bound_d)  # graftlint: sync-ok (same fetch)
+        return cand, bound, class_of
 
     # ------------------------------------------------- pipelined drain
 
@@ -2329,7 +2433,8 @@ class SchedulingEngine:
                         acc_node[dok], enc.raw_rows[acc_cls[dok]],
                         [(nm, info) for nm, info in
                          infos_touched.items()
-                         if nm not in dirty_names])
+                         if nm not in dirty_names],
+                        prio_rows=enc.cls_prio[acc_cls[dok]])
                 if dirty_names:
                     self._touch(dirty_names)
                 blind_names = [nm for nm in infos_touched

@@ -215,8 +215,14 @@ class ScheduleLoop:
             return
         requeues = (stats.get("fence_requeued", 0)
                     + stats.get("liveness_requeued", 0)
-                    + stats.get("gang_requeued", 0))
-        attempts = stats.get("bound", 0) + requeues
+                    + stats.get("gang_requeued", 0)
+                    # sustained preemption-fence rollbacks (ISSUE 14): a
+                    # store that keeps refusing atomic evict+bind commits
+                    # is the same signal class as fence churn — the
+                    # optimistic wave path is losing, drop to classic
+                    + stats.get("preempt_rollbacks", 0))
+        attempts = (stats.get("bound", 0) + requeues
+                    + stats.get("preemptions", 0))
         if self.degraded:
             if attempts > 0:
                 self._degraded_left -= 1

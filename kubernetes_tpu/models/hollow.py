@@ -277,6 +277,55 @@ def churn_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
     return out
 
 
+# Borg-shaped priority bands (ISSUE 14): free/best-effort, batch,
+# prod, system — the four-tier shape PAPERS.md §Borg describes. Values
+# spread far apart so the bands are unambiguous in audits.
+PRIORITY_BANDS = {"free": 0, "batch": 100, "prod": 1000, "system": 10000}
+
+
+def priority_churn_pods(n: int, seed: int = 0,
+                        namespace: str = "bench") -> List[Pod]:
+    """ISSUE 14 overcommit mix: the arrival stream that makes
+    displacement load-bearing. Offered against a deliberately
+    UNDERSIZED cluster, the low bands fill it first and the high bands
+    can only land by evicting — every preemption path (device victim
+    scan, atomic evict+bind, victim requeue-and-age) runs at rate.
+
+      45%  free (priority 0)      — the evictable floor; 200m/256Mi
+      30%  batch (priority 100)   — evicts free when the cluster fills
+      20%  prod (priority 1000)   — evicts batch and free
+       5%  system (priority 10000) — evicts everything below
+
+    Interleaved by index so bands arrive MIXED (a high-band pod is
+    always chasing capacity the earlier low-band stream consumed).
+    Columnar like every other profile: one template per band, shallow
+    copies, priorities part of the spec class key."""
+    templates = {}
+    for band, prio in PRIORITY_BANDS.items():
+        t = make_pod(f"prio-{band}-0", namespace=namespace, cpu=200,
+                     memory=256 * Mi)
+        t.priority = prio
+        t.priority_class = band
+        templates[band] = t
+    prefix = namespace + "/"
+    out: List[Pod] = []
+    cc = copy.copy
+    for i in range(n):
+        r = i % 100
+        if r < 45:
+            band = "free"
+        elif r < 75:
+            band = "batch"
+        elif r < 95:
+            band = "prod"
+        else:
+            band = "system"
+        p = _stamp(cc(templates[band]), f"prio-{band}-{i}", prefix,
+                   {"band": band})
+        out.append(p)
+    return out
+
+
 def hetero_gpu_pods(n: int, seed: int = 0, namespace: str = "bench") -> List[Pod]:
     """Config 5: GPU/extended-resource requests + tolerations on 10k
     heterogeneous nodes."""
@@ -374,6 +423,7 @@ PROFILES = {
     "affinity": affinity_pods,
     "mixed_affinity": mixed_affinity_pods,
     "churn": churn_pods,
+    "priority_churn": priority_churn_pods,
     "hetero": hetero_gpu_pods,
     "gang": gang_pods,
     "gang_mix": gang_mix_pods,

@@ -34,9 +34,10 @@ PID = 1
 TID_HOST = 1
 TID_DEVICE = 2
 TID_FENCE = 3
+TID_PREEMPT = 4
 
 _THREADS = ((TID_HOST, "host"), (TID_DEVICE, "device"),
-            (TID_FENCE, "fence"))
+            (TID_FENCE, "fence"), (TID_PREEMPT, "preempt"))
 
 
 def build_chrome_trace(events: List[Dict]) -> Dict:
@@ -104,6 +105,31 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
                         "name": "churn:" + rec.CHURN_OP_NAMES.get(
                             e["a"], str(e["a"])),
                         "ts": us(e["t"]), "args": {}})
+        elif kind == "preempt_propose":
+            # victim selection as a SPAN on its own lane (ISSUE 14): the
+            # device scan + exact verify shows on the timeline next to
+            # the harvest that surfaced the preemptors
+            out.append({"ph": "X", "pid": PID, "tid": TID_PREEMPT,
+                        "name": f"victim-select w{e['wave']}",
+                        "ts": us(e["t"]), "dur": round(e["dur"] * 1e6, 1),
+                        "args": {"preemptors": e["a"], "plans": e["b"]}})
+        elif kind == "preempt_commit":
+            out.append({"ph": "X", "pid": PID, "tid": TID_PREEMPT,
+                        "name": f"preempt-commit w{e['wave']}",
+                        "ts": us(e["t"]), "dur": round(e["dur"] * 1e6, 1),
+                        "args": {"victims": e["a"], "node_row": e["b"]}})
+        elif kind == "preempt_rollback":
+            out.append({"ph": "i", "pid": PID, "tid": TID_PREEMPT,
+                        "s": "t", "name": f"preempt-rollback w{e['wave']}",
+                        "ts": us(e["t"]),
+                        "args": {"victims_planned": e["a"],
+                                 "landed_timeout": e["b"]}})
+        elif kind == "victim_requeue":
+            out.append({"ph": "i", "pid": PID, "tid": TID_PREEMPT,
+                        "s": "t", "name": f"victim-requeue w{e['wave']}",
+                        "ts": us(e["t"]),
+                        "args": {"victims": e["a"],
+                                 "lowest_priority": e["b"]}})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 

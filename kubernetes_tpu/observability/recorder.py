@@ -47,6 +47,19 @@ Event kinds (the per-wave vocabulary of the pipelined engine):
                 b=breach streak at the flip.
     CHURN_OP    one injected churn op applied (testing/churn.py).
                 a=op-kind code (CHURN_OP_CODES), b=1.
+    PREEMPT_PROPOSE  one wave-path preemption round planned (ISSUE 14).
+                wave=the harvested wave that surfaced the preemptors,
+                a=preemptors considered, b=plans produced; dur=the
+                planning span (device victim scan + exact verify).
+    PREEMPT_COMMIT   one plan committed atomically at the store.
+                wave=id, a=victims evicted, b=node row of the bind;
+                dur=propose -> commit-complete (the preemption latency
+                sample the bench percentiles).
+    PREEMPT_ROLLBACK one plan refused/errored — nothing of it binds.
+                wave=id, a=victims planned, b=1 when the error was the
+                landed-timeout ambiguity's injected shape (0 plain).
+    VICTIM_REQUEUE   a commit's victims re-entered the pending pool.
+                wave=id, a=victim count, b=lowest victim priority.
 """
 
 from __future__ import annotations
@@ -67,9 +80,14 @@ PATCH = 3
 BIND_FLUSH = 4
 DEGRADED = 5
 CHURN_OP = 6
+PREEMPT_PROPOSE = 7
+PREEMPT_COMMIT = 8
+PREEMPT_ROLLBACK = 9
+VICTIM_REQUEUE = 10
 
 KIND_NAMES = ("dispatch", "harvest", "fence_requeue", "patch",
-              "bind_flush", "degraded", "churn_op")
+              "bind_flush", "degraded", "churn_op", "preempt_propose",
+              "preempt_commit", "preempt_rollback", "victim_requeue")
 
 # churn-op kind -> small int for the CHURN_OP event's `a` field
 CHURN_OP_CODES = {"kill": 0, "respawn": 1, "flap_down": 2, "flap_up": 3,
@@ -187,4 +205,6 @@ if os.environ.get("GRAFT_FLIGHT_RECORDER", "0") == "1":
 
 __all__ = ["BIND_FLUSH", "CHURN_OP", "CHURN_OP_CODES", "CHURN_OP_NAMES",
            "DEGRADED", "DISPATCH", "FENCE_REQUEUE", "FlightRecorder",
-           "HARVEST", "KIND_NAMES", "PATCH", "RECORDER"]
+           "HARVEST", "KIND_NAMES", "PATCH", "PREEMPT_COMMIT",
+           "PREEMPT_PROPOSE", "PREEMPT_ROLLBACK", "RECORDER",
+           "VICTIM_REQUEUE"]

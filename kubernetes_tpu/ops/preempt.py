@@ -1,0 +1,90 @@
+"""Device-side victim selection for wave-path preemption (ISSUE 14).
+
+The classic preemption pre-filter (engine/preemption.py candidate_mask /
+tight_bounds) builds O(total pods) host arrays per round — exactly the
+serial residue the wave path exists to kill. This module is its tensor
+form: the snapshot maintains per-node PRIORITY-BAND aggregates
+(band_cpu / band_mem / band_count, [N, B] with B a small interned vocab
+of distinct pod priorities — Borg's bands, PAPERS.md §Borg), and ONE
+fused dispatch answers, for every pending preemptor class at once:
+
+  - candidate[c, n]: could evicting some set of strictly-lower-priority
+    pods on node n free enough room for class c? (the masked score over
+    the same [C, N] shape every other wave kernel speaks)
+  - bound[c, n]: the minimal highest-victim-priority that frees enough —
+    the exact band form of tight_bounds (evicting whole bands ascending
+    by priority stops at the same band as the per-pod prefix, since the
+    per-pod prefix that crossed into band v already contains every pod
+    below v). Used to rank candidates when the exact host verification
+    must be truncated.
+
+Over-approximation contract (the snapshot-kernel pattern, SURVEY §7(e)):
+the mask may only ever INCLUDE too much, never exclude a node the exact
+oracle would accept — memory is quantized (alloc floors, requested and
+band sums ceil), so the comparison carries a +2-quantum slack; assumed
+pods ride the bands like bound ones (the host pass filters victims to
+store-confirmed pods). False positives cost one exact `_select_victims`
+verification each and return None there; a false negative would change
+a scheduling outcome, which is why the fuzz A/B in
+tests/test_preempt_wave.py pins wave plans == classic plans.
+
+Class-axis shapes are padded to the bucket ladder by the caller
+(engine.preempt_scan) — a ragged per-round preemptor count sliced into
+this jit would be the GL003 recompile storm.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# padding rows use this priority: no band can sit strictly below it, so
+# a padding class has no candidates and commits nothing
+PAD_PRIO = -(2 ** 31)
+# unused band slots carry this priority: never strictly below any real
+# preemptor, so they can't widen a threshold (their sums are zero anyway)
+UNUSED_BAND_PRIO = 2 ** 31 - 1
+INFEASIBLE = 2 ** 31 - 1
+# quantization slack for the memory comparison: alloc floors, requested
+# ceils, band sums ceil — raw-feasible can lose at most 2 quanta here
+MEM_SLACK = 2
+
+
+def _victim_scan(need_cpu, need_mem, prio, spare_cpu, spare_mem,
+                 pod_count, allowed, band_cpu, band_mem, band_count,
+                 band_prio):
+    """One fused [C, N] victim pre-filter.
+
+    need_cpu/need_mem [C] int32 (mem floor-quantized), prio [C] int32;
+    spare_cpu/spare_mem [N] int32 (alloc - requested, snapshot columns);
+    pod_count/allowed [N] int32; band_* [N, B] int32 (mem ceil-quantized);
+    band_prio [B] int32. Returns (candidate [C, N] bool, bound [C, N]
+    int32 with INFEASIBLE where no threshold works)."""
+    # prefix sums over priority thresholds: cum[n, t] = total over bands
+    # whose priority <= band_prio[t] — the "evict every band up to t" form
+    le = (band_prio[None, :] <= band_prio[:, None]).astype(jnp.int32)
+    cum_cpu = jnp.matmul(band_cpu, le.T, preferred_element_type=jnp.int32)
+    cum_mem = jnp.matmul(band_mem, le.T, preferred_element_type=jnp.int32)
+    cum_cnt = jnp.matmul(band_count, le.T, preferred_element_type=jnp.int32)
+    # thresholds a class may use: strictly below its own priority
+    thr_ok = band_prio[None, :] < prio[:, None]               # [C, B]
+    ok_cpu = (spare_cpu[None, :, None] + cum_cpu[None, :, :]
+              >= need_cpu[:, None, None])                     # [C, N, B]
+    ok_mem = (spare_mem[None, :, None] + cum_mem[None, :, :] + MEM_SLACK
+              >= need_mem[:, None, None])
+    ok_cnt = (pod_count[None, :, None] - cum_cnt[None, :, :] + 1
+              <= allowed[None, :, None])
+    has_victim = cum_cnt[None, :, :] > 0
+    ok = (ok_cpu & ok_mem & ok_cnt & has_victim
+          & thr_ok[:, None, :])                               # [C, N, B]
+    candidate = ok.any(axis=-1)
+    bound = jnp.min(jnp.where(ok, band_prio[None, None, :], INFEASIBLE),
+                    axis=-1)
+    return candidate, bound
+
+
+victim_scan_jit = jax.jit(_victim_scan)
+
+
+__all__ = ["INFEASIBLE", "MEM_SLACK", "PAD_PRIO", "UNUSED_BAND_PRIO",
+           "victim_scan_jit"]

@@ -215,6 +215,74 @@ class ApiServerLite:
             self._lock.notify_all()
         return out
 
+    def preempt_pods_bulk(self, victims: List[Pod],
+                          binding: Binding) -> Optional[str]:
+        """Atomic preemption commit (ISSUE 14): evict every victim
+        (spec.nodeName cleared — the pod re-enters the pending pool, it
+        is NOT deleted) AND bind the preemptor, all-or-nothing under one
+        lock. Validation runs first; any refusal aborts the whole op
+        with NOTHING applied — zero partial preemptions by construction,
+        which is the property the scheduler's fault handling (and the
+        churn harness's injected eviction faults) leans on.
+
+        Replay convergence (the at-most-once ambiguity): a victim
+        already unbound with the same uid counts as already-evicted (the
+        prior attempt's write landed; skipped, no second event), and a
+        preemptor already bound to the SAME node heals to success — a
+        retry of a landed-but-timed-out commit converges instead of
+        erroring. A victim bound to a DIFFERENT node, or a preemptor
+        bound elsewhere, aborts: the cluster moved and the plan is
+        stale. Returns None on success, else the error string."""
+        with self._lock:
+            evict: List[Tuple[_KEY, Pod]] = []
+            for vic in victims:
+                key = ("Pod", vic.namespace, vic.name)
+                cur = self._objects.get(key)
+                if cur is None:
+                    return f"preempt: victim not found: {vic.key()}"
+                if vic.uid and cur.uid and cur.uid != vic.uid:
+                    return f"preempt: victim uid moved: {vic.key()}"
+                if not cur.node_name:
+                    continue  # already evicted (landed replay): skip
+                if vic.node_name and cur.node_name != vic.node_name:
+                    return (f"preempt: victim {vic.key()} moved to node "
+                            f"{cur.node_name}")
+                evict.append((key, cur))
+            bkey = ("Pod", binding.pod_namespace, binding.pod_name)
+            target = self._objects.get(bkey)
+            if target is None:
+                return (f"preempt: preemptor not found: "
+                        f"{binding.pod_namespace}/{binding.pod_name}")
+            bind_needed = True
+            if target.node_name:
+                if target.node_name == binding.node_name:
+                    bind_needed = False  # landed replay: heal to success
+                else:
+                    return (f"preempt: pod {target.key()} is already "
+                            f"assigned to node {target.node_name}")
+            # validated — apply all (no fallible step below this line)
+            mk = object.__new__
+            for key, cur in evict:
+                new = mk(Pod)
+                new.__dict__.update(cur.__dict__)
+                new.node_name = ""
+                self._rv += 1
+                new.resource_version = self._rv
+                self._objects[key] = new
+                self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
+                self._persist_put(key, new)
+            if bind_needed:
+                new = mk(Pod)
+                new.__dict__.update(target.__dict__)
+                new.node_name = binding.node_name
+                self._rv += 1
+                new.resource_version = self._rv
+                self._objects[bkey] = new
+                self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
+                self._persist_put(bkey, new)
+            self._lock.notify_all()
+            return None
+
     def _bind_locked(self, binding: Binding) -> int:
         key = ("Pod", binding.pod_namespace, binding.pod_name)
         pod: Optional[Pod] = self._objects.get(key)

@@ -28,6 +28,7 @@ class NodeInfo:
         "nonzero_mem",
         "used_ports",
         "vol_count",
+        "prio_usage",
         "generation",
         "spec_generation",
         "ports_generation",
@@ -48,6 +49,12 @@ class NodeInfo:
         # columnar dynamic-row writer can skip the per-pod volume walk on
         # the (overwhelmingly common) volume-free node
         self.vol_count = 0
+        # per-PRIORITY resource aggregate: priority -> [milli_cpu, memory,
+        # count] over this node's pods (assumed included). The snapshot's
+        # priority-band columns read this per dirty row, so the wave-path
+        # victim scan (ISSUE 14) never walks pod lists; one dict op per
+        # add/remove keeps it exact.
+        self.prio_usage: Dict[int, list] = {}
         # generation: any mutation; spec_generation: node object (labels,
         # taints, allocatable, conditions) changed; ports_generation: the
         # used-ports set changed. The snapshot diffs each independently so a
@@ -76,6 +83,13 @@ class NodeInfo:
             self.ports_generation += 1
         if pod.volumes:
             self.vol_count += 1
+        u = self.prio_usage.get(pod.priority)
+        if u is None:
+            self.prio_usage[pod.priority] = [req.milli_cpu, req.memory, 1]
+        else:
+            u[0] += req.milli_cpu
+            u[1] += req.memory
+            u[2] += 1
         self.pods.append(pod)
         if pod.affinity is not None and (pod.affinity.pod_affinity is not None
                                          or pod.affinity.pod_anti_affinity is not None):
@@ -110,6 +124,13 @@ class NodeInfo:
             self.ports_generation += 1
         if pods[0].volumes:
             self.vol_count += n
+        u = self.prio_usage.get(p_prio := pods[0].priority)
+        if u is None:
+            self.prio_usage[p_prio] = [req.milli_cpu * n, req.memory * n, n]
+        else:
+            u[0] += req.milli_cpu * n
+            u[1] += req.memory * n
+            u[2] += n
         self.pods.extend(pods)
         p0 = pods[0]
         if p0.affinity is not None and (p0.affinity.pod_affinity is not None
@@ -128,6 +149,13 @@ class NodeInfo:
                 self.requested.sub(req)
                 if p.volumes:
                     self.vol_count -= 1
+                u = self.prio_usage.get(p.priority)
+                if u is not None:
+                    u[0] -= req.milli_cpu
+                    u[1] -= req.memory
+                    u[2] -= 1
+                    if u[2] <= 0:
+                        del self.prio_usage[p.priority]
                 ncpu, nmem = p.nonzero_request()
                 self.nonzero_cpu -= ncpu
                 self.nonzero_mem -= nmem
@@ -162,6 +190,7 @@ class NodeInfo:
         out.nonzero_mem = self.nonzero_mem
         out.used_ports = set(self.used_ports)
         out.vol_count = self.vol_count
+        out.prio_usage = {k: list(v) for k, v in self.prio_usage.items()}
         out.generation = self.generation
         out.spec_generation = self.spec_generation
         out.ports_generation = self.ports_generation

@@ -137,6 +137,13 @@ class ClusterSnapshot:
     """
 
     DYNAMIC = ("requested", "nonzero", "pod_count")
+    # priority-band axis width (ISSUE 14): distinct pod PRIORITY values
+    # intern into band columns first-seen; a cluster with more distinct
+    # priorities than this sets prio_band_overflow and the wave-path
+    # victim scan falls back to the host pre-filter (the same
+    # over-width -> exact-path routing every other vocab uses)
+    PRIO_BANDS = 16
+    BAND_UNUSED_PRIO = 2 ** 62
     STATIC = ("alloc", "allowed_pods", "schedulable", "mem_pressure",
               "disk_pressure", "labels", "taints_sched", "taints_pref", "valid",
               "avoid", "image_sizes", "has_zone")
@@ -181,6 +188,16 @@ class ClusterSnapshot:
         # call _note_rows; writers that rewrite wholesale call
         # _note_rows(None).
         self.dirty_rows = None
+        # priority-band vocab (ISSUE 14): pod priority value -> band
+        # column. Band growth never bumps vocab_gen — the bands are
+        # preemption-only state no pod encoding reads — and the band
+        # arrays live beside the dynamic rows (maintained by the same
+        # writers, folded by apply_assume_delta), so the victim scan
+        # reads per-node evictable aggregates without any pod walk.
+        self.prio_bands: Dict[int, int] = {}
+        self.band_prio_host = np.full(self.PRIO_BANDS,
+                                      self.BAND_UNUSED_PRIO, dtype=np.int64)
+        self.prio_band_overflow = False
         self._label_index: Dict[str, set] = {}  # key -> values across nodes
         self._row_labels: List[Dict[str, str]] = []  # per-row node label maps
         self._labels_width = _pad(0)
@@ -505,8 +522,49 @@ class ClusterSnapshot:
             self.version += 1
         return True
 
+    # ------------------------------------------------- priority bands
+
+    def band_of(self, prio: int, intern: bool = True) -> int:
+        """Band column of a pod priority value; interns on first sight.
+        Returns -1 (and sets prio_band_overflow when interning) once the
+        band axis is full — the caller's cue to take the exact host
+        pre-filter instead of the device victim scan."""
+        b = self.prio_bands.get(prio)
+        if b is not None:
+            return b
+        if not intern:
+            return -1
+        if len(self.prio_bands) >= self.PRIO_BANDS:
+            self.prio_band_overflow = True
+            return -1
+        b = len(self.prio_bands)
+        self.prio_bands[prio] = b
+        self.band_prio_host[b] = prio
+        return b
+
+    def _write_band_row(self, i: int, info: NodeInfo) -> None:
+        """Recompute one node's band columns from the NodeInfo's
+        incremental per-priority aggregate (O(distinct priorities on the
+        node), no pod walk)."""
+        self.band_cpu[i] = 0
+        self.band_mem[i] = 0
+        self.band_count[i] = 0
+        for prio, u in info.prio_usage.items():
+            b = self.band_of(prio)
+            if b < 0:
+                continue  # overflow: the scan is gated off; best-effort
+            self.band_cpu[i, b] = u[0]
+            self.band_mem[i, b] = u[1]
+            self.band_count[i, b] = u[2]
+
+    def band_bound_counts(self) -> Dict[int, int]:
+        """Cluster-wide pod count per priority value (assumed included) —
+        the disruption budget's per-band floor reads this."""
+        return {prio: int(self.band_count[:, b].sum())
+                for prio, b in self.prio_bands.items()}
+
     def apply_assume_delta(self, rows: np.ndarray, delta: np.ndarray,
-                           gen_items) -> None:
+                           gen_items, prio_rows=None) -> None:
         """Fold a wave of assumes into the dynamic arrays WITHOUT touching
         the NodeInfos: the caller (the pipelined harvest) knows the exact
         per-placement raw delta (class request + nonzero rows), so the
@@ -528,6 +586,18 @@ class ClusterSnapshot:
         COUNTERS.inc("snapshot.assume_delta_rows", len(rows))
         np.add.at(self._raw_dyn, rows, delta)
         np.add.at(self.pod_count, rows, 1)
+        if prio_rows is not None and len(rows):
+            # fold the placements into the priority-band aggregates too
+            # (ISSUE 14): per-row band index from the vocab, interning
+            # unseen priorities (band growth invalidates nothing)
+            bands = np.fromiter((self.band_of(int(p)) for p in prio_rows),
+                                dtype=np.int64, count=len(rows))
+            okb = bands >= 0  # overflow rows: scan is gated off anyway
+            if okb.any():
+                rb, bb = rows[okb], bands[okb]
+                np.add.at(self.band_cpu, (rb, bb), delta[okb, 0])
+                np.add.at(self.band_mem, (rb, bb), delta[okb, 1])
+                np.add.at(self.band_count, (rb, bb), 1)
         touched = np.unique(rows)
         raw = self._raw_dyn[touched]
         shift = self.mem_shift
@@ -578,6 +648,7 @@ class ClusterSnapshot:
                            req.storage_scratch, req.storage_overlay)
                 nz[j] = (info.nonzero_cpu, info.nonzero_mem)
                 cnt[j] = len(info.pods)
+                self._write_band_row(i, info)
             shift = self.mem_shift
             requested = self.requested
             requested[idx, R_CPU] = self._i32(base[:, 0])
@@ -625,6 +696,12 @@ class ClusterSnapshot:
         # stays bit-identical to a full rewrite (ceil of the TOTAL, not a
         # sum of per-pod ceils)
         self._raw_dyn = np.zeros((n, 7), dtype=np.int64)
+        # priority-band aggregates (ISSUE 14): raw int64 per (node, band)
+        # sums — quantization happens at upload, so incremental folds and
+        # full row rewrites agree bit-exactly
+        self.band_cpu = np.zeros((n, self.PRIO_BANDS), dtype=np.int64)
+        self.band_mem = np.zeros((n, self.PRIO_BANDS), dtype=np.int64)
+        self.band_count = np.zeros((n, self.PRIO_BANDS), dtype=np.int32)
         self.pod_count = np.zeros(n, dtype=np.int32)
         self.allowed_pods = np.zeros(n, dtype=np.int32)
         self.schedulable = np.zeros(n, dtype=bool)
@@ -675,6 +752,7 @@ class ClusterSnapshot:
                           req.storage_scratch, req.storage_overlay)
             nonzero[i] = (info.nonzero_cpu, info.nonzero_mem)
             self.pod_count[i] = len(info.pods)
+            self._write_band_row(i, info)
             node = info.node
             if node is None:
                 self.schedulable[i] = False
@@ -820,6 +898,7 @@ class ClusterSnapshot:
         self.nonzero[i, 0] = info.nonzero_cpu
         self.nonzero[i, 1] = self.quant_mem(info.nonzero_mem, up=True)
         self.pod_count[i] = len(info.pods)
+        self._write_band_row(i, info)
         # volume aggregates over the node's (bound+assumed) pods; volume
         # arrays are dirtied only when the node's volume set actually moved,
         # so volume-less churn keeps steady-state uploads tiny

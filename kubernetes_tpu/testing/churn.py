@@ -60,16 +60,37 @@ class FaultyBindApi:
     timeout_rate: probability a binding errors but DID land (the
                   at-most-once ambiguity: the caller cannot distinguish a
                   lost request from a lost response).
+
+    The VICTIM-DELETE seam (ISSUE 14): ``preempt_pods_bulk`` — the
+    store's atomic evict+bind — gets the same two fault shapes, drawn
+    PER VICTIM: any victim drawing a FAILURE aborts the whole commit
+    with nothing landed (the store op is all-or-nothing, so a per-victim
+    wire fault manifests as the batch erroring before application); any
+    drawing a TIMEOUT lets the whole commit land and then loses the
+    response — the scheduler must treat it as rolled back while the
+    watch stream heals the divergence. Both shapes preserve zero
+    partial preemptions by construction.
+
+    evict_fail_rate:    per-victim probability the preempt commit errors
+                        WITHOUT landing.
+    evict_timeout_rate: per-victim probability the preempt commit LANDS
+                        (evictions AND the bind) but errors anyway.
     """
 
     def __init__(self, api: ApiServerLite, fail_rate: float = 0.0,
-                 timeout_rate: float = 0.0, seed: int = 0):
+                 timeout_rate: float = 0.0, seed: int = 0,
+                 evict_fail_rate: float = 0.0,
+                 evict_timeout_rate: float = 0.0):
         self._api = api
         self._rng = random.Random(seed)
         self.fail_rate = fail_rate
         self.timeout_rate = timeout_rate
+        self.evict_fail_rate = evict_fail_rate
+        self.evict_timeout_rate = evict_timeout_rate
         self.injected_failures = 0
         self.injected_timeouts = 0
+        self.injected_evict_failures = 0
+        self.injected_evict_timeouts = 0
 
     def __getattr__(self, name):
         return getattr(self._api, name)
@@ -104,6 +125,27 @@ class FaultyBindApi:
 
     def bind_many(self, bindings) -> List[Optional[str]]:
         return self._bind_with_faults(bindings, self._api.bind_many)
+
+    def preempt_pods_bulk(self, victims, binding) -> Optional[str]:
+        """Atomic evict+bind with per-victim fault draws (class
+        docstring): FAILURE wins over TIMEOUT, either yields ONE error
+        for the whole commit — failure before the store op (nothing
+        lands), timeout after it (everything lands, response lost)."""
+        fail = timeout = False
+        for _ in range(max(len(victims), 1)):
+            r = self._rng.random()
+            if r < self.evict_fail_rate:
+                fail = True
+            elif r < self.evict_fail_rate + self.evict_timeout_rate:
+                timeout = True
+        if fail:
+            self.injected_evict_failures += 1
+            return "injected: evict unavailable"
+        err = self._api.preempt_pods_bulk(victims, binding)
+        if err is None and timeout:
+            self.injected_evict_timeouts += 1
+            return "injected: evict timeout (landed)"
+        return err
 
 
 def extender_store_binder(api):
@@ -347,5 +389,66 @@ class ChurnInjector:
         return th
 
 
+# ----------------------------------------------------- store-truth audits
+
+
+def audit_store_transitions(api) -> Dict[str, Dict[str, int]]:
+    """Walk the store's retained event log and count per-pod BINDS
+    (unbound -> bound transitions, preloaded-bound ADDs included) and
+    EVICTIONS (bound -> unbound). The log orders transitions, so 'one
+    bound node per preemptor ever' and 'every victim evicted at most
+    once' are direct assertions over these counts — the exactly-once
+    audit extended to the victim seam (ISSUE 14). Callers must size the
+    store's max_log to retain the whole scenario."""
+    binds: Dict[str, int] = {}
+    evicts: Dict[str, int] = {}
+    state: Dict[str, str] = {}
+    for ev in list(getattr(api, "_log")):
+        if ev.kind != "Pod":
+            continue
+        key = ev.obj.key()
+        node = ev.obj.node_name or ""
+        if ev.type == "DELETED":
+            state.pop(key, None)
+            continue
+        prev = state.get(key, "")
+        if node and not prev:
+            binds[key] = binds.get(key, 0) + 1
+        elif prev and not node:
+            evicts[key] = evicts.get(key, 0) + 1
+        state[key] = node
+    return {"binds": binds, "evicts": evicts}
+
+
+def audit_cache_vs_store(sched, api) -> List[str]:
+    """Ghost-capacity audit (ISSUE 14): after quiesce, every pod the
+    scheduler cache counts against a node must be bound there at the
+    store, and vice versa — an evicted victim still resident in a
+    NodeInfo would be phantom occupancy 'freeing' capacity that is not
+    free. Assumed (in-flight optimistic) claims are exempt. Returns the
+    discrepancy list (empty = clean)."""
+    store_bound = {p.key(): p.node_name
+                   for p in api.list("Pod")[0] if p.node_name}
+    with sched.cache._lock:
+        assumed = {k for k, st in sched.cache._pod_states.items()
+                   if st.assumed}
+        cache_bound = {p.key(): name
+                       for name, info in sched.cache._nodes.items()
+                       for p in info.pods}
+    problems: List[str] = []
+    for k, n in cache_bound.items():
+        if k in assumed:
+            continue
+        if store_bound.get(k) != n:
+            problems.append(
+                f"cache counts {k} on {n}; store says "
+                f"{store_bound.get(k, '<unbound>')}")
+    for k in store_bound:
+        if k not in cache_bound:
+            problems.append(f"store-bound {k} missing from cache")
+    return problems
+
+
 __all__ = ["ChurnConfig", "ChurnInjector", "ChurnOp", "FaultyBindApi",
+           "audit_cache_vs_store", "audit_store_transitions",
            "extender_store_binder", "make_churn_schedule", "ZONES"]

@@ -480,6 +480,94 @@ def test_gl003_fires_on_ragged_micro_wave_pop(tmp_path):
                 and "bucketed_pump" in f.path], findings
 
 
+def test_gl002_registry_covers_victim_scan_seam(tmp_path):
+    """ISSUE 14: wave-path preemption adds a jitted entry point
+    (ops/preempt.victim_scan_jit — the [C, N] victim pre-filter) — the
+    project-wide registry must pick it up from the REAL source so GL002
+    taint extends to consumers: an unblessed fetch of the candidate
+    rows would stall the harvest tail once per preemption round."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    pre_py = os.path.join(PKG_DIR, "ops", "preempt.py")
+    with open(pre_py, "r", encoding="utf-8") as fh:
+        index = ProjectIndex()
+        index.scan(ast.parse(fh.read()))
+    assert "victim_scan_jit" in index.jitted_names
+    fixture = tmp_path / "victim_select.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.ops.preempt import victim_scan_jit
+
+        def select_victims(need_cpu, need_mem, prio, dev):
+            cand, bound = victim_scan_jit(need_cpu, need_mem, prio,
+                                          dev, dev, dev, dev, dev, dev,
+                                          dev, dev)
+            return np.asarray(cand)
+    """))
+    findings, _sup, errors = run_paths([pre_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "select_victims" in f.context
+               for f in findings), findings
+    # the blessed fetch (the scan's documented synchronous consume)
+    fixture.write_text(fixture.read_text().replace(
+        "return np.asarray(cand)",
+        "return np.asarray(cand)  # graftlint: sync-ok"))
+    findings, _sup, errors = run_paths([pre_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "select_victims" in f.context], \
+        findings
+
+
+def test_gl003_fires_on_ragged_victim_set(tmp_path):
+    """ISSUE 14: a preemption round's preemptor count is data-dependent —
+    slicing the need arrays to it before the victim-scan jit would mint
+    one XLA compile per distinct round size (the GL003 storm); the
+    pad-to-bucket idiom engine.preempt_scan actually uses stays silent."""
+    pre_py = os.path.join(PKG_DIR, "ops", "preempt.py")
+    bad = tmp_path / "ragged_scan.py"
+    bad.write_text(textwrap.dedent("""
+        from kubernetes_tpu.ops.preempt import victim_scan_jit
+
+        def scan_rounds(rounds, need_cpu, need_mem, prio, dev):
+            out = []
+            while rounds:
+                n = rounds.pop()
+                out.append(victim_scan_jit(need_cpu[:n], need_mem[:n],
+                                           prio[:n], dev, dev, dev, dev,
+                                           dev, dev, dev, dev))
+            return out
+    """))
+    findings, _sup, errors = run_paths([pre_py, str(bad)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert any(f.rule == "GL003" and "scan_rounds" in f.context
+               for f in findings), findings
+    good = tmp_path / "bucketed_scan.py"
+    good.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.ops.preempt import victim_scan_jit
+
+        def scan_rounds(rounds, need_cpu, need_mem, prio, dev, pad):
+            out = []
+            while rounds:
+                n = rounds.pop()
+                nc = np.zeros(pad, dtype=np.int32)
+                nc[:n] = need_cpu[:n]
+                out.append(victim_scan_jit(nc, nc, nc, dev, dev, dev,
+                                           dev, dev, dev, dev, dev))
+            return out
+    """))
+    findings, _sup, errors = run_paths([pre_py, str(good)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert not [f for f in findings if f.rule == "GL003"
+                and "bucketed_scan" in f.path], findings
+
+
 def test_gl002_fires_on_device_handle_field(tmp_path):
     fs = lint_src(tmp_path, """
         import numpy as np

@@ -128,7 +128,7 @@ def test_perfetto_export_lanes_and_overlap(flight, tmp_path):
     assert loaded["traceEvents"]
     lanes = {m["args"]["name"] for m in loaded["traceEvents"]
              if m.get("ph") == "M" and m["name"] == "thread_name"}
-    assert lanes == {"host", "device", "fence"}
+    assert lanes == {"host", "device", "fence", "preempt"}
     tids = {"host": None, "device": None}
     for m in trace["traceEvents"]:
         if m.get("ph") == "M" and m["name"] == "thread_name" \

@@ -1,0 +1,591 @@
+"""Wave-path preemption (ISSUE 14): priority bands + displacement on the
+always-on pipeline.
+
+Pinned here:
+- FUZZ ORACLE: plan_wave_preemptions (device victim scan + exact
+  verification over a copy-on-write overlay) produces byte-identical
+  plans to the classic round's pick_preemption/PreemptionState loop —
+  node choice ordering, the reprieve loop, infeasible nodes, multi-
+  preemptor reservation effects, and the affinity-gated memo path.
+- ATOMICITY: the store's evict+bind is all-or-nothing; injected eviction
+  FAILURES roll back with zero residue on either side, injected
+  landed-but-timed-out evictions heal through the watch stream with
+  exactly-once binds audited against store truth.
+- DISRUPTION BUDGET: sliding-window rate limit + per-band floors
+  (FakeClock unit) and the e2e budget_deferred path.
+- STARVATION GUARD: queue aging pops a long-waiting victim ahead of a
+  sustained high-priority stream the moment capacity frees.
+- CRASH-MID-PREEMPTION: a relisted replacement scheduler converges with
+  one bound node per preemptor ever and every victim evicted at most
+  once.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import make_node, make_pod
+from kubernetes_tpu.engine.preempt_wave import (
+    DisruptionBudget,
+    plan_wave_preemptions,
+)
+from kubernetes_tpu.engine.preemption import PreemptionState, pick_preemption
+from kubernetes_tpu.engine.queue import SchedulingQueue
+from kubernetes_tpu.engine.scheduler import Scheduler
+from kubernetes_tpu.engine.scheduler_engine import SchedulingEngine
+from kubernetes_tpu.models.hollow import load_cluster
+from kubernetes_tpu.server.apiserver_lite import ApiServerLite
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.testing.churn import (
+    FaultyBindApi,
+    audit_cache_vs_store,
+    audit_store_transitions,
+)
+from kubernetes_tpu.utils import features
+from kubernetes_tpu.utils.trace import COUNTERS
+from tests.test_nodes import FakeClock
+
+Mi = 1 << 20
+Gi = 1 << 30
+
+
+@pytest.fixture()
+def pod_priority():
+    features.DEFAULT_FEATURE_GATE.set("PodPriority", True)
+    yield
+    features.DEFAULT_FEATURE_GATE.reset()
+
+
+def prio_pod(name, priority, cpu=200, mem=256 * Mi, node_name=""):
+    p = make_pod(name, cpu=cpu, memory=mem, node_name=node_name)
+    p.priority = priority
+    return p
+
+
+# --------------------------------------------------------- fuzz oracle
+
+
+def _classic_plans(cache, preemptors):
+    """The classic `_preempt_round` planning loop, side effects stripped:
+    pick_preemption + PreemptionState over snapshot_infos clones with the
+    nominated-pod reservation — the oracle the wave path must match."""
+    from kubernetes_tpu.ops.oracle_ext import SchedulingContext
+    from kubernetes_tpu.state.volumes import VolumeContext
+
+    infos = cache.snapshot_infos()
+    ctx = SchedulingContext(infos, [], hard_pod_affinity_weight=1,
+                            volume_ctx=VolumeContext(), policy_algos=None)
+    state = None
+    out = []
+    for pod in sorted(preemptors, key=lambda p: -p.priority):
+        if pod.priority <= 0:
+            break
+        if state is None:
+            state = PreemptionState(infos)
+        plan = pick_preemption(pod, infos, ctx=ctx, state=state)
+        if plan is None:
+            continue
+        for vic in plan.victims:
+            info = infos.get(plan.node_name)
+            if info is not None:
+                info.remove_pod(vic)
+        info = infos.get(plan.node_name)
+        if info is not None:
+            info.add_pod(pod)
+        state.apply_plan(plan, pod)
+        ctx.infos = infos
+        ctx.invalidate()
+        out.append((pod.key(), plan.node_name,
+                    sorted(v.key() for v in plan.victims)))
+    return out
+
+
+def _fuzz_cluster(seed):
+    rng = random.Random(seed)
+    cache = SchedulerCache()
+    n_nodes = rng.randint(4, 10)
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"n{i:02d}",
+                                 cpu=rng.choice([1000, 1600, 2400]),
+                                 memory=rng.choice([4, 8]) * Gi,
+                                 pods=rng.choice([6, 10, 110])))
+    k = 0
+    for i in range(n_nodes):
+        for _ in range(rng.randint(0, 6)):
+            p = prio_pod(f"b{k:03d}", rng.choice([0, 0, 1, 2, 5, 10]),
+                         cpu=rng.choice([100, 200, 400, 700]),
+                         mem=rng.choice([128, 256, 512]) * Mi,
+                         node_name=f"n{i:02d}")
+            cache.add_pod(p)
+            k += 1
+    pre = []
+    for j in range(rng.randint(1, 5)):
+        pre.append(prio_pod(
+            f"pre{j}", rng.choice([1, 3, 5, 8, 20]),
+            cpu=rng.choice([300, 600, 900, 1500, 50_000]),
+            mem=rng.choice([256, 512, 1024]) * Mi))
+    return cache, pre
+
+
+def test_fuzz_wave_plans_equal_classic():
+    """Node choice ordering, reprieve loop, infeasible nodes, and
+    multi-preemptor reservation effects — wave == classic, many seeds."""
+    mismatches = []
+    for seed in range(24):
+        cache, pre = _fuzz_cluster(seed)
+        engine = SchedulingEngine(cache)
+        engine._refresh()
+        wave = [(pl.pod.key(), pl.node_name,
+                 sorted(v.key() for v in pl.victims))
+                for pl in plan_wave_preemptions(engine, pre)]
+        classic = _classic_plans(cache, pre)
+        if wave != classic:
+            mismatches.append((seed, wave, classic))
+    assert not mismatches, mismatches[:2]
+
+
+def test_fuzz_equal_with_affinity_residents():
+    """Affinity-carrying residents couple nodes, which gates the
+    same-class verification memo OFF — plans must still equal classic."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+    )
+    for seed in (3, 7, 11):
+        cache, pre = _fuzz_cluster(seed)
+        aff = Affinity(pod_anti_affinity=PodAffinity(
+            required_terms=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": "x"}),
+                namespaces=[], topology_key="kubernetes.io/hostname")]))
+        carrier = prio_pod(f"carrier-{seed}", 0, cpu=100,
+                           node_name="n00")
+        carrier.labels = {"app": "x"}
+        carrier.affinity = aff
+        cache.add_pod(carrier)
+        engine = SchedulingEngine(cache)
+        engine._refresh()
+        wave = [(pl.pod.key(), pl.node_name,
+                 sorted(v.key() for v in pl.victims))
+                for pl in plan_wave_preemptions(engine, pre)]
+        assert wave == _classic_plans(cache, pre), seed
+
+
+def test_band_overflow_falls_back_to_host_prefilter():
+    """More distinct priorities than band columns: the device scan bows
+    out, the host pre-filter serves the round, plans still == classic."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("n00", cpu=2000, memory=8 * Gi))
+    for j in range(20):  # 20 distinct priorities > PRIO_BANDS (16)
+        cache.add_pod(prio_pod(f"b{j}", j, cpu=90, node_name="n00"))
+    engine = SchedulingEngine(cache)
+    engine._refresh()
+    assert engine.snapshot.prio_band_overflow
+    assert engine.preempt_scan([prio_pod("pre", 50, cpu=500)]) is None
+    c0 = COUNTERS.snapshot().get("engine.preempt_scan_host_fallback",
+                                 (0, 0))[0]
+    pre = [prio_pod("pre", 50, cpu=500)]
+    wave = [(pl.pod.key(), pl.node_name,
+             sorted(v.key() for v in pl.victims))
+            for pl in plan_wave_preemptions(engine, pre)]
+    assert wave == _classic_plans(cache, pre)
+    assert COUNTERS.snapshot()["engine.preempt_scan_host_fallback"][0] \
+        == c0 + 1
+
+
+# -------------------------------------------- snapshot band consistency
+
+
+def test_band_columns_incremental_equals_rebuild():
+    """The raw-delta band fold (apply_assume_delta prio_rows) must agree
+    with a from-scratch rebuild — compared as priority -> per-node sums
+    (band COLUMN order is first-seen and may differ)."""
+    api = ApiServerLite()
+    nodes = [make_node(f"n{i:02d}", cpu=4000, memory=16 * Gi, pods=110)
+             for i in range(4)]
+    load_cluster(api, nodes, [])
+    s = Scheduler(api, record_events=False)
+    s.start()
+    for i in range(40):
+        api.create("Pod", prio_pod(f"p{i:02d}", [0, 100, 1000][i % 3]))
+    loop = s.pipeline(chunk=16)
+    while True:
+        st = loop.step()
+        if st["popped"] == 0 and loop.idle and s.sync() == 0 \
+                and s.queue.ready_count() == 0:
+            break
+    loop.close()
+    snap = s.engine.snapshot
+
+    def by_prio(sn):
+        return {prio: (sn.band_cpu[:, b].copy(), sn.band_mem[:, b].copy(),
+                       sn.band_count[:, b].copy())
+                for prio, b in sn.prio_bands.items()}
+    live = by_prio(snap)
+    from kubernetes_tpu.state.snapshot import ClusterSnapshot
+    fresh = ClusterSnapshot()
+    fresh.refresh(s.cache.node_infos())
+    ref = by_prio(fresh)
+    assert set(live) == set(ref)
+    for prio in ref:
+        for a, b in zip(live[prio], ref[prio]):
+            assert np.array_equal(a, b), prio
+
+
+# ------------------------------------------------- atomic commit paths
+
+
+def _full_cluster(n_nodes=2, slots=4, evict_fail=0.0, evict_timeout=0.0,
+                  clock=None):
+    """A cluster preloaded FULL of bound low-priority pods, wrapped in
+    the eviction-fault proxy, plus a streaming scheduler."""
+    api = ApiServerLite()
+    nodes = [make_node(f"n{i:02d}", cpu=slots * 200, memory=16 * Gi,
+                       pods=slots) for i in range(n_nodes)]
+    pods = []
+    k = 0
+    for i in range(n_nodes):
+        for _ in range(slots):
+            pods.append(prio_pod(f"low-{k:02d}", 0,
+                                 node_name=f"n{i:02d}"))
+            k += 1
+    load_cluster(api, nodes, pods)
+    fapi = FaultyBindApi(api, evict_fail_rate=evict_fail,
+                         evict_timeout_rate=evict_timeout)
+    kw = {"record_events": False}
+    if clock is not None:
+        kw["now"] = clock
+    s = Scheduler(fapi, **kw)
+    s.start()
+    return api, fapi, s
+
+
+def test_preempt_commit_binds_preemptor_and_requeues_victims(pod_priority):
+    api, fapi, s = _full_cluster()
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    api.create("Pod", prio_pod("hi", 1000))
+    total = {}
+    for _ in range(6):
+        for k, v in loop.step().items():
+            total[k] = total.get(k, 0) + v
+        if total.get("preemptions", 0):
+            break
+    assert total.get("preemptions", 0) == 1, total
+    assert total.get("victims_evicted", 0) == 1
+    hi = api.get("Pod", "default", "hi")
+    assert hi.node_name  # bound atomically with the eviction
+    unbound = [p for p in api.list("Pod")[0]
+               if not p.node_name and p.name != "hi"]
+    assert len(unbound) == 1  # exactly one victim displaced
+    # the victim re-entered the pending pool as an ordinary arrival (a
+    # few steps in it has been retried against the full cluster and
+    # parked on backoff — still pending, never lost)
+    for _ in range(3):
+        loop.step()
+    loop.flush()
+    assert unbound[0].key() in s.queue._keys
+    assert not audit_cache_vs_store(s, api)
+    loop.close()
+
+
+def test_injected_evict_failure_rolls_back_zero_residue(pod_priority):
+    clock = FakeClock()
+    api, fapi, s = _full_cluster(evict_fail=1.0, clock=clock)
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    loop.degrade_window = 99  # keep the wave path under the fault storm
+    api.create("Pod", prio_pod("hi", 1000))
+    total = {}
+    for _ in range(4):
+        for k, v in loop.step().items():
+            total[k] = total.get(k, 0) + v
+        clock.t += 3.0  # the preemptor's backoff elapses between steps
+    assert total.get("preempt_rollbacks", 0) >= 1, total
+    assert total.get("preemptions", 0) == 0
+    # ZERO residue: store untouched, nothing assumed, preemptor pending
+    assert not api.get("Pod", "default", "hi").node_name
+    assert all(p.node_name for p in api.list("Pod")[0]
+               if p.name != "hi")
+    assert not s.cache.is_assumed("default/hi")
+    assert "default/hi" in s.queue._keys
+    assert not audit_cache_vs_store(s, api)
+    # faults healed: the SAME pending preemptor commits cleanly
+    fapi.evict_fail_rate = 0.0
+    clock.t += 3.0
+    for _ in range(4):
+        for k, v in loop.step().items():
+            total[k] = total.get(k, 0) + v
+        clock.t += 3.0
+        if total.get("preemptions", 0):
+            break
+    assert total.get("preemptions", 0) == 1, total
+    assert api.get("Pod", "default", "hi").node_name
+    tr = audit_store_transitions(api)
+    assert tr["binds"]["default/hi"] == 1
+    assert all(c == 1 for k, c in tr["evicts"].items()), tr["evicts"]
+    loop.close()
+
+
+def test_landed_timeout_heals_exactly_once(pod_priority):
+    """The at-most-once ambiguity on the victim-delete seam: the commit
+    LANDS but errors — the scheduler rolls back, the watch stream heals,
+    and the store shows exactly one bind ever for the preemptor."""
+    clock = FakeClock()
+    api, fapi, s = _full_cluster(evict_timeout=1.0, clock=clock)
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    loop.degrade_window = 99
+    api.create("Pod", prio_pod("hi", 1000))
+    total = {}
+    for _ in range(6):
+        for k, v in loop.step().items():
+            total[k] = total.get(k, 0) + v
+        clock.t += 3.0
+        if api.get("Pod", "default", "hi").node_name \
+                and "default/hi" not in s.queue._keys:
+            break
+    assert total.get("preempt_rollbacks", 0) >= 1, total
+    hi = api.get("Pod", "default", "hi")
+    assert hi.node_name  # the "failed" commit had landed
+    # healed through sync: confirmed bound, out of the queue, cache truth
+    assert "default/hi" not in s.queue._keys
+    assert not s.cache.is_assumed("default/hi")
+    tr = audit_store_transitions(api)
+    assert tr["binds"]["default/hi"] == 1  # never double-bound
+    assert all(c == 1 for c in tr["evicts"].values()), tr["evicts"]
+    assert not audit_cache_vs_store(s, api)
+    loop.close()
+
+
+def test_crash_mid_preemption_relist_audit(pod_priority):
+    """Crash after a landed-but-unacknowledged commit: a replacement
+    scheduler relists and converges — one bound node per preemptor ever,
+    every victim evicted at most once, no ghost capacity."""
+    clock = FakeClock()
+    api, fapi, s = _full_cluster(evict_timeout=1.0, clock=clock)
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    loop.degrade_window = 99
+    api.create("Pod", prio_pod("hi", 1000))
+    total = {}
+    for _ in range(3):
+        for k, v in loop.step().items():
+            total[k] = total.get(k, 0) + v
+        clock.t += 3.0
+        if total.get("preempt_rollbacks", 0):
+            break
+    assert total.get("preempt_rollbacks", 0) >= 1
+    # CRASH: abandon the first scheduler before any watch healing
+    s2 = Scheduler(fapi, record_events=False, now=clock)
+    s2.start()
+    loop2 = s2.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    loop2.degrade_window = 99
+    for _ in range(4):
+        loop2.step()
+        clock.t += 3.0
+    tr = audit_store_transitions(api)
+    assert tr["binds"].get("default/hi", 0) == 1
+    assert all(c == 1 for c in tr["evicts"].values()), tr["evicts"]
+    assert not audit_cache_vs_store(s2, api)
+    loop2.close()
+
+
+# ------------------------------------------------- disruption budgets
+
+
+def test_disruption_budget_sliding_window_fakeclock():
+    clock = FakeClock()
+    b = DisruptionBudget(max_evictions_per_min=3, now=clock)
+    vics = [prio_pod(f"v{i}", 0) for i in range(2)]
+    assert b.admit(vics)
+    assert b.admit([vics[0]])
+    assert not b.admit([vics[1]])  # 3 consumed, window full
+    assert b.window_evictions() == 3
+    clock.t += 61.0
+    assert b.admit(vics)  # the window slid
+    assert b.window_evictions() == 2
+
+
+def test_disruption_budget_band_floor():
+    b = DisruptionBudget(max_evictions_per_min=100, band_floor={0: 5})
+    vics = [prio_pod(f"v{i}", 0) for i in range(3)]
+    assert not b.admit(vics, band_counts={0: 7})  # 7 - 3 < floor 5
+    assert b.admit(vics, band_counts={0: 9})      # 9 - 3 >= 5
+    assert b.admit([prio_pod("x", 100)], band_counts={0: 5, 100: 99})
+
+
+def test_budget_deferred_blocks_eviction_e2e(pod_priority):
+    api, fapi, s = _full_cluster()
+    s.disruption_budget = DisruptionBudget(max_evictions_per_min=0)
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    api.create("Pod", prio_pod("hi", 1000))
+    total = {}
+    for _ in range(4):
+        for k, v in loop.step().items():
+            total[k] = total.get(k, 0) + v
+    assert total.get("budget_deferred", 0) >= 1, total
+    assert total.get("preemptions", 0) == 0
+    assert all(p.node_name for p in api.list("Pod")[0]
+               if p.name != "hi")  # nothing was evicted
+    assert not api.get("Pod", "default", "hi").node_name
+    loop.close()
+
+
+# ------------------------------------------------- starvation guard
+
+
+def test_queue_aging_promotes_starved_victim(pod_priority):
+    clock = FakeClock()
+    q = SchedulingQueue(now=clock)
+    q.aging_threshold_s = 5.0
+    q.add(prio_pod("victim", 0))
+    clock.t += 6.0  # past the aging threshold
+    q.add(prio_pod("fresh-hi", 1000))
+    out = q.pop_batch()
+    assert [p.name for p in out] == ["victim", "fresh-hi"]
+    # un-aged: priority order holds
+    q.add(prio_pod("lo2", 0))
+    q.add(prio_pod("hi2", 1000))
+    assert [p.name for p in q.pop_batch()] == ["hi2", "lo2"]
+
+
+def test_no_permanent_starvation_under_high_band_stream(pod_priority):
+    """A preempted low-priority victim must rebind once capacity frees,
+    even while high-priority pods keep arriving: with a 1-pod admission
+    quantum only the queue HEAD gets tried each step, so without aging
+    the victim would sit behind the ever-growing high-band queue
+    forever. The offered high pods are infeasible (bigger than the
+    node), so the freed capacity is genuinely the victim's to take."""
+    clock = FakeClock()
+    api, fapi, s = _full_cluster(n_nodes=1, slots=3, clock=clock)
+    s.queue.aging_threshold_s = 5.0
+    loop = s.stream(budget_s=30.0, min_quantum=1, max_quantum=1)
+    api.create("Pod", prio_pod("hi-0", 1000))
+    for _ in range(4):
+        loop.step()
+        clock.t += 3.0
+    victim = next(p for p in api.list("Pod")[0] if not p.node_name)
+    assert victim.priority == 0  # a low-band pod was displaced
+    # sustained high-priority offered stream, each pod larger than the
+    # whole node: unschedulable forever, but they keep outranking the
+    # victim at the head of a priority-ordered queue
+    hi_seq = [1]
+
+    def offer_hi():
+        api.create("Pod", prio_pod(f"hi-{hi_seq[0]}", 1000, cpu=700))
+        hi_seq[0] += 1
+
+    for _ in range(3):
+        offer_hi()
+        loop.step()
+        clock.t += 0.4
+    assert not api.get("Pod", victim.namespace, victim.name).node_name
+    clock.t += 10.0  # victim ages past the threshold
+    # capacity frees: the bound high pod leaves — one 200m slot opens
+    api.delete("Pod", "default", "hi-0")
+    for _ in range(10):
+        offer_hi()
+        loop.step()
+        clock.t += 0.4
+        if api.get("Pod", victim.namespace, victim.name).node_name:
+            break
+    assert api.get("Pod", victim.namespace, victim.name).node_name, \
+        "aged victim never rebound — permanent starvation"
+    loop.close()
+
+
+# --------------------------------------- wave path stays on the waves
+
+
+def test_preemption_rides_wave_path_without_flush(pod_priority):
+    """Preemption must not drag the stream through the classic round:
+    victims are UNBOUND (not deleted), the scan dispatches on device,
+    and the loop reports the commit through wave-path stats."""
+    api, fapi, s = _full_cluster(n_nodes=3, slots=4)
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    c0 = {k: v[0] for k, v in COUNTERS.snapshot().items()}
+    api.create("Pod", prio_pod("hi", 1000))
+    total = {}
+    for _ in range(6):
+        for k, v in loop.step().items():
+            total[k] = total.get(k, 0) + v
+        if total.get("preemptions", 0):
+            break
+    c1 = {k: v[0] for k, v in COUNTERS.snapshot().items()}
+    assert total.get("preemptions", 0) == 1
+    assert c1.get("engine.preempt_scan_dispatch", 0) \
+        > c0.get("engine.preempt_scan_dispatch", 0)
+    assert c1.get("engine.preempt_commits", 0) \
+        == c0.get("engine.preempt_commits", 0) + 1
+    # victims are unbound, never deleted: the store still has every pod
+    assert len(api.list("Pod")[0]) == 3 * 4 + 1
+    assert not loop.degraded
+    loop.close()
+
+
+def test_sustained_preempt_rollbacks_trip_degraded_mode(pod_priority):
+    """The new failure class feeds the existing hysteresis: a store that
+    keeps refusing atomic commits drops the loop to the classic round."""
+    clock = FakeClock()
+    api, fapi, s = _full_cluster(evict_fail=1.0, clock=clock)
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    loop.degrade_window = 3
+    api.create("Pod", prio_pod("hi", 1000))
+    for _ in range(8):
+        loop.step()
+        clock.t += 3.0
+        if loop.degraded:
+            break
+    assert loop.degraded
+    loop.close()
+
+
+# --------------------------------------------------- observability
+
+
+def test_preempt_counters_and_recorder_lane(pod_priority):
+    from kubernetes_tpu.observability.perfetto import build_chrome_trace
+    from kubernetes_tpu.observability.recorder import RECORDER
+    from kubernetes_tpu.observability.registry import TelemetryRegistry
+
+    api, fapi, s = _full_cluster()
+    loop = s.stream(budget_s=30.0, min_quantum=16, max_quantum=16)
+    RECORDER.clear()
+    RECORDER.enable()
+    try:
+        api.create("Pod", prio_pod("hi", 1000))
+        total = {}
+        for _ in range(6):
+            for k, v in loop.step().items():
+                total[k] = total.get(k, 0) + v
+            if total.get("preemptions", 0):
+                break
+    finally:
+        RECORDER.disable()
+    assert total.get("preemptions", 0) == 1
+    events = RECORDER.snapshot()
+    kinds = {e["kind"] for e in events}
+    assert {"preempt_propose", "preempt_commit",
+            "victim_requeue"} <= kinds, kinds
+    trace = build_chrome_trace(events)
+    lanes = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "thread_name"}
+    assert "preempt" in lanes
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any(n.startswith("victim-select") for n in names), names
+    assert any(n.startswith("preempt-commit") for n in names), names
+    # counters land in the unified registry namespace — the identical
+    # snapshot every transport (/debug/vars, STATS verb, debug_snapshot)
+    # serves; transport parity itself is pinned by test_observability
+    snap = TelemetryRegistry().snapshot()
+    assert snap.get("span.engine.preempt_commits.count", 0) >= 1
+    assert "span.engine.victims_evicted.count" in snap
+    # ... and through a live transport surface: VerdictService's
+    # debug_snapshot (the embedded twin of /debug/vars and STATS) serves
+    # the same registry fold, so the preemption counters are visible on
+    # every introspection transport
+    from kubernetes_tpu.server.embedded import VerdictService
+    from kubernetes_tpu.server.extender import TPUExtenderBackend
+    dv = VerdictService(TPUExtenderBackend()).debug_snapshot()["vars"]
+    assert dv.get("span.engine.preempt_commits.count", 0) >= 1
+    assert "span.engine.preempt_scan_dispatch.count" in dv
+    loop.close()
