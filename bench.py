@@ -23,7 +23,17 @@ BENCH_ARRIVAL_SWEEP (comma rates; "" disables), BENCH_ARRIVAL_SAT=0 to skip
 the saturation search, BENCH_RECORDER_AB=0 to skip the flight-recorder
 on/off A/B (ISSUE 13: the headline re-run with the recorder armed,
 interleaved trials with per-arm medians — BENCH_RECORDER_AB_TRIALS,
-default 2; telemetry_overhead_pct travels in the artifact). Churn
+default 2; telemetry_overhead_pct travels in the artifact).
+Pod-level black box (ISSUE 15): BENCH_PODTRACE_AB=0 skips the
+podtrace+SLO on/off A/B (same interleaved-medians methodology,
+BENCH_PODTRACE_AB_TRIALS default 2, sampling at the tracer's default
+1-in-64 rate); the ON arm's artifact carries the tail-forensics demo —
+the slowest-K exemplar timelines of the 20k/s headline with per-phase
+attribution summing to each pod's create->bound (attribution_exact is
+asserted per exemplar). `python bench.py --trend` renders the
+BENCH_r01..r17 trajectory + PROGRESS.jsonl and exits nonzero on a
+headline regression past the ±30% box-noise band (CI contract;
+observability/trend.py). Churn
 scenario (ISSUE 8): BENCH_CHURN=0 to skip,
 BENCH_CHURN_RATE (offered rate; default the arrival rate),
 BENCH_CHURN_SEED, BENCH_CHURN_NODE_PCT_MIN (node churn fraction/min,
@@ -1430,7 +1440,7 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
                 min_quantum: int = 256, max_quantum: int = 16384,
                 interval_s: float = 0.0, warm: bool = False,
                 churn_cfg=None, mesh_devices: int = 0,
-                recorder: bool = False):
+                recorder: bool = False, podtrace: bool = False):
     """THE headline scenario (ISSUE 7): pods are CREATED at a configured
     rate while the ALWAYS-ON loop runs — the reference's density suite
     semantics (test/integration/scheduler_perf/scheduler_test.go:34-39
@@ -1572,6 +1582,22 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
         _flight.enable()
     else:
         _flight.disable()
+    # pod-level black box (ISSUE 15): the podtrace+SLO arm of ITS on/off
+    # A/B — armed for the measured window only (warm/prime pods never
+    # enter a timeline), force-disabled on the off arm so an env-armed
+    # tracer cannot turn the A/B into on-vs-on
+    from kubernetes_tpu.observability.podtrace import TRACER as _tracer
+    from kubernetes_tpu.observability.slo import SLO as _slo
+    _tracer_was = _tracer.enabled
+    _slo_was = _slo.enabled
+    if podtrace:
+        _tracer.clear()
+        _tracer.enable()
+        _slo.clear()
+        _slo.enable()
+    else:
+        _tracer.disable()
+        _slo.disable()
     created = [0]
     create_ts = np.full(total, -1.0)   # per-pod create instant, rel. t0
     create_log = []                    # (t_rel, batch_size) per burst
@@ -1689,6 +1715,8 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
         # recorder (GRAFT_FLIGHT_RECORDER=1) stays armed for whatever
         # runs next in this process
         _flight.enabled = _flight_was
+        _tracer.enabled = _tracer_was
+        _slo.enabled = _slo_was
         if churn_stop is not None:
             churn_stop.set()
     creator_thread.join(timeout=10)
@@ -1798,6 +1826,30 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
     if recorder:
         out["recorder_events"] = int(_flight.stats()["events"])
         out["recorder_dropped"] = int(_flight.stats()["dropped"])
+    if podtrace:
+        # tail-forensics demo (ISSUE 15 acceptance): slowest-K exemplar
+        # timelines of THIS offered stream, each with its per-phase
+        # attribution and the telescoping check (phase sums == the
+        # pod's create->bound span within stamp resolution)
+        psnap = _tracer.snapshot()
+        exemplars = []
+        for ex in psnap["exemplars"]:
+            ssum = sum(ex["phases_ms"].values())
+            exemplars.append({
+                "key": ex["key"],
+                "create_to_bound_ms": ex["span_ms"],
+                "phases_ms": ex["phases_ms"],
+                "phase_sum_ms": round(ssum, 6),
+                "attribution_exact":
+                    bool(abs(ssum - ex["span_ms"]) < 1e-3),
+                "events": [e["kind"] for e in ex["events"]],
+            })
+        out["podtrace"] = {
+            "stats": psnap["stats"],
+            "phases": psnap["phases"],
+            "tail_exemplars": exemplars,
+            "slo": _slo.snapshot(),
+        }
     if injector is not None:
         out.update({
             "churn_ops_applied": dict(injector.applied),
@@ -2629,6 +2681,13 @@ def lint_gate_or_die():
 
 def main():
     import sys
+    if "--trend" in sys.argv[1:]:
+        # trajectory reader (ISSUE 15): no drain, no device — render the
+        # BENCH_r*.json trend and exit nonzero on a regression past the
+        # box-noise band (the CI contract; observability/trend.py)
+        from kubernetes_tpu.observability.trend import main as trend_main
+        raise SystemExit(trend_main(
+            [a for a in sys.argv[1:] if a != "--trend"]))
     if "--lint-gate" in sys.argv[1:] \
             or os.environ.get("BENCH_LINT_GATE", "0") == "1":
         lint_gate_or_die()
@@ -2772,6 +2831,62 @@ def main():
         except Exception as e:
             import sys
             print(f"bench: recorder A/B failed: {e}", file=sys.stderr)
+
+    # podtrace+SLO on/off A/B (ISSUE 15): the arrival headline re-run
+    # with the pod-level black box armed at the DEFAULT sample rate —
+    # same interleaved-medians methodology as the recorder A/B (a 2%
+    # bar cannot be resolved by one pair on a ±30% box). The ON arm's
+    # result carries the tail-forensics demo into the artifact.
+    # BENCH_PODTRACE_AB=0 to skip, BENCH_PODTRACE_AB_TRIALS per arm.
+    podtrace_ab = None
+    arrival_podtrace = None
+    if arrival is not None \
+            and os.environ.get("BENCH_PODTRACE_AB", "1") != "0":
+        import statistics
+        trials = max(int(os.environ.get("BENCH_PODTRACE_AB_TRIALS",
+                                        "2")), 1)
+        offs = [arrival["sustained_pods_s"]]
+        ons, on_p99s = [], []
+        try:
+            def _pleg(trace_on):
+                return run_arrival(
+                    n_nodes, rate=arrival_rate,
+                    duration_s=arrival_duration, profile=arrival_profile,
+                    budget_ms=arrival_budget,
+                    max_burst=int(os.environ.get("BENCH_ARRIVAL_BURST",
+                                                 0)),
+                    warm=warmup, podtrace=trace_on)
+
+            for _i in range(trials):
+                r_on = _pleg(True)
+                ons.append(r_on["sustained_pods_s"])
+                if r_on["p99_ms"] is not None:
+                    on_p99s.append(r_on["p99_ms"])
+                arrival_podtrace = r_on["podtrace"]
+                if len(offs) < trials:
+                    offs.append(_pleg(False)["sustained_pods_s"])
+            off_s = statistics.median(offs)
+            on_s = statistics.median(ons)
+            exemplars = (arrival_podtrace or {}).get("tail_exemplars", [])
+            podtrace_ab = {
+                "podtrace_off_sustained_pods_s": round(off_s, 1),
+                "podtrace_on_sustained_pods_s": round(on_s, 1),
+                "podtrace_off_trials": offs,
+                "podtrace_on_trials": ons,
+                "podtrace_on_p99_ms": round(statistics.median(on_p99s),
+                                            3) if on_p99s else None,
+                "podtrace_sample_rate": (arrival_podtrace or {}).get(
+                    "stats", {}).get("sample_rate"),
+                "podtrace_overhead_pct": round(
+                    (off_s - on_s) / off_s * 100.0, 2) if off_s else None,
+                # acceptance: every exemplar's phase attribution
+                # telescopes to its create->bound exactly
+                "tail_attribution_exact_all": bool(exemplars) and all(
+                    e["attribution_exact"] for e in exemplars),
+            }
+        except Exception as e:
+            import sys
+            print(f"bench: podtrace A/B failed: {e}", file=sys.stderr)
 
     # offered-rate sweep + saturation search (BENCH_ARRIVAL_SWEEP=""
     # disables the sweep, BENCH_ARRIVAL_SAT=0 the search)
@@ -2999,6 +3114,14 @@ def main():
         "arrival_recorder_ab": recorder_ab,
         "telemetry_overhead_pct": recorder_ab["telemetry_overhead_pct"]
         if recorder_ab else None,
+        # pod-level black box (ISSUE 15): sampled-tracing overhead A/B +
+        # the tail-forensics demo (slowest-K exemplar timelines with
+        # exact per-phase attribution) and the SLO engine's view of the
+        # measured window
+        "arrival_podtrace_ab": podtrace_ab,
+        "podtrace_overhead_pct": podtrace_ab["podtrace_overhead_pct"]
+        if podtrace_ab else None,
+        "arrival_podtrace": arrival_podtrace,
         # offered sweeps + saturation search: the max offered rate the
         # engine sustains with p99 create->bound under the budget
         "arrival_sweeps": sweeps,
@@ -3063,7 +3186,7 @@ def main():
     # working. BENCH_ARTIFACT= (empty) disables, or names another round;
     # the default is pinned to THIS round so a bench run can never
     # rewrite a prior round's file as commit noise (ISSUE 11 satellite).
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r16.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r17.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

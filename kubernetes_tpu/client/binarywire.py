@@ -130,13 +130,18 @@ class BinaryWireClient:
 
     def filter_fused(self, pod, top_k: int = 32, deadline_ms: int = 0,
                      compact: bool = True,
-                     pod_blob: Optional[bytes] = None) -> FilterVerdict:
-        verb, payload = self._roundtrip(
-            framing.FILTER,
-            framing.encode_filter_request(pod, top_k=top_k,
-                                          deadline_ms=deadline_ms,
-                                          pod_blob=pod_blob),
-            flags=framing.FLAG_COMPACT if compact else 0)
+                     pod_blob: Optional[bytes] = None,
+                     trace_ctx: Optional[str] = None) -> FilterVerdict:
+        body = framing.encode_filter_request(pod, top_k=top_k,
+                                             deadline_ms=deadline_ms,
+                                             pod_blob=pod_blob)
+        flags = framing.FLAG_COMPACT if compact else 0
+        if trace_ctx:
+            # pod-trace context (ISSUE 15): this hop joins the pod's
+            # timeline server-side
+            body = framing.wrap_trace(body, trace_ctx)
+            flags |= framing.FLAG_TRACE
+        verb, payload = self._roundtrip(framing.FILTER, body, flags=flags)
         if verb != framing.VERDICT:
             raise WireError(f"unexpected verb 0x{verb:02x} to FILTER")
         d = framing.decode_verdict(payload)
@@ -151,13 +156,17 @@ class BinaryWireClient:
     def bind(self, pod_name: str, namespace: str, uid: str, node: str,
              snapshot_gen: Optional[int] = None, idem_key: str = "",
              deadline_ms: int = 0, pod=None,
-             pod_blob: Optional[bytes] = None) -> BindResult:
-        verb, payload = self._roundtrip(
-            framing.BIND,
-            framing.encode_bind_request(
-                pod_name, namespace, uid, node, snapshot_gen=snapshot_gen,
-                idem_key=idem_key, deadline_ms=deadline_ms, pod=pod,
-                pod_blob=pod_blob))
+             pod_blob: Optional[bytes] = None,
+             trace_ctx: Optional[str] = None) -> BindResult:
+        body = framing.encode_bind_request(
+            pod_name, namespace, uid, node, snapshot_gen=snapshot_gen,
+            idem_key=idem_key, deadline_ms=deadline_ms, pod=pod,
+            pod_blob=pod_blob)
+        flags = 0
+        if trace_ctx:
+            body = framing.wrap_trace(body, trace_ctx)
+            flags |= framing.FLAG_TRACE
+        verb, payload = self._roundtrip(framing.BIND, body, flags=flags)
         if verb != framing.BIND_RESULT:
             raise WireError(f"unexpected verb 0x{verb:02x} to BIND")
         d = framing.decode_bind_result(payload)

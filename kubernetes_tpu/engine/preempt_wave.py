@@ -53,6 +53,8 @@ from kubernetes_tpu.engine.preemption import (
     PreemptionState,
     _select_victims,
 )
+from kubernetes_tpu.observability import podtrace
+from kubernetes_tpu.observability.podtrace import TRACER
 
 
 @dataclass
@@ -226,6 +228,13 @@ def plan_wave_preemptions(engine, preemptors: List[Pod], *,
                 PreemptionPlan(node_name=name, victims=victims), pod)
         plans.append(WavePreemption(pod=pod, node_name=name,
                                     victims=victims))
+        if TRACER.enabled and victims:
+            # pod-level black box (ISSUE 15): a planned victim visible
+            # mid-requeue gets its PREEMPT_VICTIM stamp (host ints only;
+            # the node row is the snapshot index already in hand)
+            TRACER.batch_event(podtrace.PREEMPT_VICTIM,
+                               [vic.key() for vic in victims],
+                               a=name_index.get(name, -1))
     return plans
 
 

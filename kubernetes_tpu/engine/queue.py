@@ -17,6 +17,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.observability.podtrace import TRACER
 from kubernetes_tpu.utils import features
 
 INITIAL_BACKOFF = 1.0
@@ -78,17 +79,24 @@ class SchedulingQueue:
             self._keys[key] = pod
             self._fifo.append(pod)
             self._lock.notify_all()
+        if TRACER.enabled:
+            # pod-level black box (ISSUE 15): the queue-admission stamp
+            # — head-sampling decides here, everything later is a probe
+            TRACER.begin_batch((key,))
 
     def add_many(self, pods: List[Pod]) -> None:
         """add() for a batch under ONE lock with ONE waiter wakeup — the
         arrival-storm admission path (ISSUE 7): at 20k+ creates/s the
         per-pod lock acquire + notify_all of add() is a measurable slice
         of the scheduler core the stream is trying to keep on waves."""
+        admitted = None
         with self._lock:
             keys = self._keys
             fifo = self._fifo
             now = self._now()
             stamps = self._queued_at
+            if TRACER.enabled:
+                admitted = []
             for pod in pods:
                 key = pod.key()
                 if key in keys:
@@ -96,7 +104,11 @@ class SchedulingQueue:
                 stamps.setdefault(key, now)
                 keys[key] = pod
                 fifo.append(pod)
+                if admitted is not None:
+                    admitted.append(key)
             self._lock.notify_all()
+        if admitted:
+            TRACER.begin_batch(admitted)
 
     def add_backoff(self, pod: Pod) -> float:
         """Requeue after the pod's current backoff delay; returns the delay."""
@@ -110,7 +122,9 @@ class SchedulingQueue:
             self._seq += 1
             heapq.heappush(self._deferred, (self._now() + delay, self._seq, pod))
             self._lock.notify_all()
-            return delay
+        if TRACER.enabled:
+            TRACER.begin_batch((key,), backoff=True)
+        return delay
 
     def remove(self, pod_key: str) -> None:
         """Drop a pod (deleted / scheduled by someone else)."""
@@ -172,6 +186,11 @@ class SchedulingQueue:
                     self._fifo = self._fifo[n:]
                     for p in out:
                         self._keys.pop(p.key(), None)
+                    if TRACER.enabled and out:
+                        # POPPED carries the realized admission size (=
+                        # the quantum that popped it) and the pod's own
+                        # pop round — requeue loops made visible
+                        TRACER.pop_batch([p.key() for p in out])
                     return out
                 if deadline is None:
                     return []

@@ -71,9 +71,12 @@ from kubernetes_tpu.engine.scheduler_engine import (
     SchedulingEngine,
 )
 from kubernetes_tpu.engine.streaming import ScheduleLoop
+from kubernetes_tpu.observability import podtrace
 from kubernetes_tpu.observability import recorder as flightrec
+from kubernetes_tpu.observability.podtrace import TRACER
 from kubernetes_tpu.observability.recorder import RECORDER
 from kubernetes_tpu.observability.registry import TelemetryRegistry
+from kubernetes_tpu.observability.slo import SLO
 from kubernetes_tpu.ops import priorities as prio
 from kubernetes_tpu.server.apiserver_lite import (
     ApiServerLite,
@@ -556,9 +559,16 @@ class Scheduler:
         self.metrics.e2e_latency.observe_many(bind_done - pop_ts, n)
         # per-pod create->bound, queue wait + backoff rounds included:
         # distinct value per pod, the distribution the SLO check reads
-        self.metrics.create_to_bound.observe_batch(
-            [bind_done - self._first_queued.pop(p.key(), pop_ts)
-             for p in bound_pods])
+        lats = [bind_done - self._first_queued.pop(p.key(), pop_ts)
+                for p in bound_pods]
+        self.metrics.create_to_bound.observe_batch(lats)
+        if SLO.enabled and lats:
+            # the SLO engine sees EVERY bound pod (not the tracer's
+            # sampled subset) — burn-rate math over the full population
+            SLO.observe_batch(lats, t=bind_done)
+        if TRACER.enabled and bound_pods:
+            TRACER.bound_batch([p.key() for p in bound_pods],
+                               t0=bind_done)
         if self.wave_observer is not None and bound_pods:
             self.wave_observer(bind_done, [p.key() for p in bound_pods])
         self._idle_gc()
@@ -592,6 +602,12 @@ class Scheduler:
                 ready.append((gname, list(waiting.values()), quorum))
                 del self._gang_waiting[gname]
                 self._gang_parked_at.pop(gname, None)
+            elif TRACER.enabled:
+                # parked below quorum: the wait shows on the timeline as
+                # gang_wait instead of vanishing into queue time
+                TRACER.batch_event(podtrace.GANG_GATED,
+                                   [m.key() for m in members],
+                                   a=len(waiting))
         return ready
 
     def _sweep_parked_gangs(self, gangs) -> None:
@@ -676,6 +692,8 @@ class Scheduler:
                                               state=state)
             if plan is None:
                 continue
+            if TRACER.enabled and plan.victims:
+                TRACER.evicted_batch([v.key() for v in plan.victims])
             for vic in plan.victims:
                 try:
                     self.api.delete("Pod", vic.namespace, vic.name)
@@ -885,8 +903,12 @@ class Scheduler:
         self.metrics.e2e_latency.observe_many(bind_done - handle.pop_ts, n)
         fq_pop = self._first_queued.pop
         pop_ts = handle.pop_ts
-        self.metrics.create_to_bound.observe_batch(
-            [bind_done - fq_pop(k, pop_ts) for k in keys])
+        lats = [bind_done - fq_pop(k, pop_ts) for k in keys]
+        self.metrics.create_to_bound.observe_batch(lats)
+        if SLO.enabled:
+            SLO.observe_batch(lats, t=bind_done)
+        if TRACER.enabled:
+            TRACER.bound_batch(keys, t0=bind_done)
         if self.wave_observer is not None:
             self.wave_observer(bind_done, keys)
         if preemptors:
@@ -977,14 +999,21 @@ class Scheduler:
                 if record:
                     self._event(vic, "Normal", "Preempted",
                                 f"by {key} on node {plan.node_name}")
+            if TRACER.enabled:
+                TRACER.evicted_batch([v.key() for v in plan.victims],
+                                     t0=bind_done)
             self.queue.remove(key)  # it was backoff-requeued above
             pod.node_name = plan.node_name
             self.cache.assume_pod(pod)
             self.cache.finish_binding(pod)
             self.engine.note_node_dirty(plan.node_name)
             self.metrics.scheduled.inc(1)
-            self.metrics.create_to_bound.observe_batch(
-                [bind_done - self._first_queued.pop(key, t_plan)])
+            lat = bind_done - self._first_queued.pop(key, t_plan)
+            self.metrics.create_to_bound.observe_batch([lat])
+            if SLO.enabled:
+                SLO.observe_batch([lat], t=bind_done)
+            if TRACER.enabled:
+                TRACER.bound_batch([key], t0=bind_done)
             if self.wave_observer is not None:
                 self.wave_observer(bind_done, [key])
             out["preemptions"] += 1

@@ -44,7 +44,9 @@ from kubernetes_tpu.analysis import sanitize
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.engine.batch import NodeState, gather_place_batch
 from kubernetes_tpu.engine import waves
+from kubernetes_tpu.observability import podtrace
 from kubernetes_tpu.observability import recorder as flightrec
+from kubernetes_tpu.observability.podtrace import TRACER
 from kubernetes_tpu.observability.recorder import RECORDER
 from kubernetes_tpu.ops import oracle
 from kubernetes_tpu.ops import priorities as prio
@@ -1016,11 +1018,12 @@ class WaveHarvest:
     unit, exactly the below-quorum rollback of the classic round)."""
 
     __slots__ = ("bound", "conflicts", "unschedulable", "t_block",
-                 "gang_committed", "gang_requeued", "liveness_requeued")
+                 "gang_committed", "gang_requeued", "liveness_requeued",
+                 "conflict_reasons")
 
     def __init__(self, bound, conflicts, unschedulable, t_block,
                  gang_committed=None, gang_requeued=None,
-                 liveness_requeued=None):
+                 liveness_requeued=None, conflict_reasons=None):
         self.bound = bound
         self.conflicts = conflicts
         self.unschedulable = unschedulable
@@ -1030,6 +1033,10 @@ class WaveHarvest:
         # rows whose target node died / was cordoned mid-flight (ISSUE 8):
         # requeue WITH backoff — not a capacity race, not unschedulability
         self.liveness_requeued = liveness_requeued or []
+        # typed requeue attribution (ISSUE 15): podtrace.REASON_* code
+        # per entry of `conflicts`, parallel — capacity races vs topology
+        # vs stale encodings stop folding into one count
+        self.conflict_reasons = conflict_reasons or []
 
 
 class SchedulingEngine:
@@ -2176,12 +2183,19 @@ class SchedulingEngine:
             if gangs:
                 COUNTERS.inc("engine.gang_wave_dispatch", len(gangs))
             wave_id = -1
-            if _rec_t0 and RECORDER.enabled:
+            if RECORDER.enabled or TRACER.enabled:
+                # one wave-id sequence for BOTH observers, so a pod's
+                # WAVE_DISPATCHED joins the ring's dispatch/harvest
+                # events on the exported timeline
                 wave_id = RECORDER.next_wave()
+            if _rec_t0 and RECORDER.enabled:
                 RECORDER.record(flightrec.DISPATCH, wave=wave_id,
                                 t0=_rec_t0,
                                 dur=_time.monotonic() - _rec_t0,
                                 a=n, b=len(gangs) if gangs else 0)
+            if TRACER.enabled:
+                TRACER.batch_event(podtrace.WAVE_DISPATCHED,
+                                   [p.key() for p in pods], a=wave_id)
             return WaveHandle(list(pods), pc, enc, packed, state_out,
                               counter_out, nodes, blind, pop_ts,
                               _time.monotonic(), self.wave_pad_floor,
@@ -2350,11 +2364,12 @@ class SchedulingEngine:
         acc_node = np.empty(0, dtype=np.int64)
         acc_cls = np.empty(0, dtype=np.int32)
         conflict_idx: List[int] = []
+        conflict_codes: List[int] = []
         liveness_idx: List[int] = []
         if placed_idx.size:
             with timed_span("pipeline.fence"):
-                acc_idx, acc_node, acc_cls, conflict_idx, liveness_idx = \
-                    self._fence(handle, sel, placed_idx)
+                (acc_idx, acc_node, acc_cls, conflict_idx, liveness_idx,
+                 conflict_codes) = self._fence(handle, sel, placed_idx)
         # the GANG FENCE (ISSUE 5): all-or-nothing atomicity for gangs that
         # rode this wave as ordinary batches. A gang COMMITS when >= quorum
         # members survived placement AND the capacity/topology fence; below
@@ -2377,6 +2392,7 @@ class SchedulingEngine:
                     gang_committed.append(gname)
                     continue
                 COUNTERS.inc("engine.gang_fence_rollbacks")
+                COUNTERS.inc("engine.fence_reason_gang", len(ia))
                 drop[ia] = True
                 reason = (f"gang {gname}: only {ok_n}/{len(ia)} members "
                           f"placeable past the wave fence (quorum {quorum})")
@@ -2392,10 +2408,18 @@ class SchedulingEngine:
                          for i in np.nonzero(sel < 0)[0].tolist()
                          if i not in strag and (drop is None or not drop[i])]
         bound: List[Pod] = []
-        conflicts: List[Pod] = [pods[i] for i in straggler_idx.tolist()
-                                if drop is None or not drop[i]]
-        conflicts += [pods[i] for i in conflict_idx
-                      if drop is None or not drop[i]]
+        # conflicts + their typed reason codes, parallel (ISSUE 15):
+        # max-waves stragglers are an affinity-routing verdict
+        conflicts: List[Pod] = []
+        conflict_reasons: List[int] = []
+        for i in straggler_idx.tolist():
+            if drop is None or not drop[i]:
+                conflicts.append(pods[i])
+                conflict_reasons.append(podtrace.REASON_AFFINITY)
+        for i, code in zip(conflict_idx, conflict_codes):
+            if drop is None or not drop[i]:
+                conflicts.append(pods[i])
+                conflict_reasons.append(code)
         # liveness rejects (ISSUE 8): the target node died / was cordoned
         # mid-flight — requeue WITH backoff (the caller's contract): the
         # node is not coming back on a capacity-race timescale, and a
@@ -2465,10 +2489,32 @@ class SchedulingEngine:
                 RECORDER.record(flightrec.FENCE_REQUEUE,
                                 wave=handle.wave_id,
                                 a=len(conflicts), b=len(liveness))
+        if TRACER.enabled:
+            # per-pod harvest/fence stamps (ISSUE 15): survivors get
+            # HARVESTED (the device phase's right edge on their
+            # timeline), losers a FENCE_REQUEUED carrying the typed
+            # reason — host ints only, the sync above already happened
+            t_h = _time.monotonic()
+            if bound:
+                TRACER.batch_event(podtrace.HARVESTED,
+                                   [p.key() for p in bound],
+                                   a=handle.wave_id, t0=t_h)
+            for p, code in zip(conflicts, conflict_reasons):
+                TRACER.event(p.key(), podtrace.FENCE_REQUEUED, a=code,
+                             b=handle.wave_id, t0=t_h)
+            for p in liveness:
+                TRACER.event(p.key(), podtrace.FENCE_REQUEUED,
+                             a=podtrace.REASON_LIVENESS,
+                             b=handle.wave_id, t0=t_h)
+            for p, _why in gang_requeued:
+                TRACER.event(p.key(), podtrace.FENCE_REQUEUED,
+                             a=podtrace.REASON_GANG,
+                             b=handle.wave_id, t0=t_h)
         return WaveHarvest(bound, conflicts, unschedulable, t_block,
                            gang_committed=gang_committed,
                            gang_requeued=gang_requeued,
-                           liveness_requeued=liveness)
+                           liveness_requeued=liveness,
+                           conflict_reasons=conflict_reasons)
 
     def _fence(self, handle: WaveHandle, sel: np.ndarray,
                placed_idx: np.ndarray):
@@ -2479,7 +2525,9 @@ class SchedulingEngine:
         post-k commdom and requeue conservatively instead of colliding.
         Returns (accepted original indices grouped by (node, class) with
         FIFO order inside each node, their node indices, their class
-        indices, conflict original indices in FIFO order)."""
+        indices, conflict original indices in FIFO order, liveness
+        original indices, typed podtrace.REASON_* codes parallel to the
+        conflict list)."""
         from kubernetes_tpu.utils.trace import COUNTERS
 
         snap = self.snapshot
@@ -2532,12 +2580,22 @@ class SchedulingEngine:
                 if i >= 0:
                     bl[i] = True
             ok &= ~(spc & bl[gnode])
+        # typed requeue attribution (ISSUE 15): one reason code per
+        # rejected row, first-cause ordering (capacity checks ran first,
+        # affinity only re-colors rows capacity passed). The ports/
+        # volume conservative requeue above is a capacity-class verdict.
+        reason = np.full(m, -1, dtype=np.int8)
+        reason[~ok] = podtrace.REASON_CAPACITY
         if enc.fits_on and enc.adata is not None:
-            aff_bad = self._fence_affinity(enc, cls_rows, gnode)
-            if aff_bad is not None:
+            aff_out = self._fence_affinity(enc, cls_rows, gnode)
+            if aff_out is not None:
+                aff_bad, aff_stale = aff_out
                 n_rej = int((aff_bad & ok).sum())
                 if n_rej:
                     COUNTERS.inc("engine.affinity_fence_requeues", n_rej)
+                reason[aff_bad & (reason < 0)] = \
+                    podtrace.REASON_STALE if aff_stale \
+                    else podtrace.REASON_AFFINITY
                 ok &= ~aff_bad
         # liveness re-validation (ISSUE 8): a row targeting a node the
         # owner declared dying (watch event seen, not yet applied — the
@@ -2554,10 +2612,22 @@ class SchedulingEngine:
         if live_bad.any():
             COUNTERS.inc("engine.liveness_fence_requeues",
                          int(live_bad.sum()))
+            COUNTERS.inc("engine.fence_reason_liveness",
+                         int(live_bad.sum()))
             ok &= ~live_bad
+        conflict_mask = ~ok & ~live_bad
+        for code in (podtrace.REASON_CAPACITY, podtrace.REASON_AFFINITY,
+                     podtrace.REASON_STALE):
+            n_r = int(((reason == code) & conflict_mask).sum())
+            if n_r:
+                COUNTERS.inc("engine.fence_reason_"
+                             + podtrace.REASON_NAMES[code], n_r)
+        conf_pairs = sorted(zip(gidx[conflict_mask].tolist(),
+                                reason[conflict_mask].tolist()))
         return (gidx[ok], gnode[ok], cls_rows[ok],
-                sorted(gidx[~ok & ~live_bad].tolist()),
-                sorted(gidx[live_bad].tolist()))
+                [i for i, _r in conf_pairs],
+                sorted(gidx[live_bad].tolist()),
+                [int(r) for _i, r in conf_pairs])
 
     def _fence_affinity(self, enc: "_WaveEncoding", cls_rows: np.ndarray,
                         gnode: np.ndarray) -> Optional[np.ndarray]:
@@ -2566,18 +2636,21 @@ class SchedulingEngine:
         occupancy (every prior harvest folded). Exactly mirrors the device
         mask (waves._wave_aff_mask) plus the allow side for strict-tail
         classes; in-harvest interactions need no re-check — they ran inside
-        one device program against a shared carry. Returns a bool [m] "must
-        requeue" mask, or None when no placement is affinity-relevant. A
-        STALE encoding (foreign affinity churn since dispatch, detected via
-        cache.aff_seq) conservatively requeues every relevant placement —
-        the retry re-dispatches against a rebuilt encoding."""
+        one device program against a shared carry. Returns a (bool [m]
+        "must requeue" mask, stale flag) pair, or None when no placement
+        is affinity-relevant. A STALE encoding (foreign affinity churn
+        since dispatch, detected via cache.aff_seq) conservatively
+        requeues every relevant placement — the retry re-dispatches
+        against a rebuilt encoding; the stale flag types those requeues
+        distinctly (ISSUE 15: stale-encoding is an operability story —
+        churn outran the patch path — not a capacity race)."""
         ad = enc.adata
         rel = ad.wave_relevant[cls_rows]
         if not rel.any():
             return None
         if enc is not self._wave_enc or enc.aff_seq != self.cache.aff_seq \
                 or enc.labels_gen != self.snapshot.labels_gen:
-            return rel.copy()
+            return rel.copy(), True
         snap = self.snapshot
         cn = enc.committed_nodes.astype(np.float64)           # [C, N]
         C_, A_ = ad.m_anti.shape[:2]
@@ -2643,4 +2716,4 @@ class SchedulingEngine:
             boot = ad.aff_self & ~ad.aff_has_static & (dyn_total == 0)
             ok_terms = (~ad.aff_active[c_r]) | stat | dyn | boot[c_r]
             aff_bad[own_rows] |= ~ok_terms.all(axis=1)
-        return aff_bad & rel
+        return aff_bad & rel, False

@@ -49,6 +49,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from kubernetes_tpu.observability import recorder as flightrec
+from kubernetes_tpu.observability.podtrace import TRACER
 from kubernetes_tpu.observability.recorder import RECORDER
 from kubernetes_tpu.ops.predicates import bucket
 from kubernetes_tpu.utils.trace import COUNTERS, Trace
@@ -341,6 +342,16 @@ class ScheduleLoop:
             # idle tick dumping its (empty) breakdown would be noise
             trace.field("bound", stats["bound"])
             trace.field("degraded", int(self.degraded))
+            if TRACER.enabled and trace.total() >= self.trace_threshold_s:
+                # the pod-level black box joins the step forensics
+                # (ISSUE 15): a breaching step's dump names the window's
+                # slowest exemplar so the per-pod timeline is one
+                # /debug/pods lookup away
+                ex = TRACER.snapshot()["exemplars"]
+                if ex:
+                    trace.field("slowest_pod", ex[0]["key"])
+                    trace.field("slowest_span_ms",
+                                round(ex[0]["span_ms"], 1))
             trace.log_if_long(self.trace_threshold_s)
         return stats
 

@@ -22,9 +22,29 @@ hidden sync):
   attribution profile_bench.py approximates becomes a loadable
   timeline (``python -m kubernetes_tpu.observability --trace out.json``
   then chrome://tracing or ui.perfetto.dev).
+
+Pod-level black box (ISSUE 15), same host-pure discipline:
+
+- ``podtrace``: head-sampled per-pod lifecycle timelines stamped at
+  the queue/dispatch/harvest/fence/bind/preempt seams and joined
+  across transports by a trace context; completion feeds a telescoping
+  critical-path decomposition (phase sums == create->bound exactly)
+  and a slowest-K tail-exemplar reservoir per window.
+- ``slo``: the multiwindow burn-rate SLO engine over every bound pod's
+  create->bound span — rolling p99, fast/slow burn gauges, alert flips
+  on the flight-recorder ring. Both serve identically on every
+  transport (HTTP /debug/pods + /debug/slo, the binary STATS verb,
+  VerdictService.debug_snapshot) and fold into every registry
+  snapshot.
+- ``trend``: the BENCH_r*.json trajectory reader behind
+  ``bench.py --trend`` (regression flags past the box-noise band,
+  nonzero exit for CI).
 """
 
+from kubernetes_tpu.observability.podtrace import TRACER, PodTracer
 from kubernetes_tpu.observability.recorder import RECORDER, FlightRecorder
 from kubernetes_tpu.observability.registry import TelemetryRegistry
+from kubernetes_tpu.observability.slo import SLO, SLOMonitor
 
-__all__ = ["FlightRecorder", "RECORDER", "TelemetryRegistry"]
+__all__ = ["FlightRecorder", "PodTracer", "RECORDER", "SLO",
+           "SLOMonitor", "TRACER", "TelemetryRegistry"]

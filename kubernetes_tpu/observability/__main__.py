@@ -5,13 +5,19 @@
         --nodes 5000 --pods 30000 --profile density
     python -m kubernetes_tpu.observability --events raw.json --last 200
     python -m kubernetes_tpu.observability --vars
+    python -m kubernetes_tpu.observability --trend [--band 0.30]
 
 --trace runs the pipelined drain (warmup pass first so compiles never
 pollute the window), records every wave, and writes the Chrome
 trace-event JSON — load it in chrome://tracing or ui.perfetto.dev to
-see the host-tail / device-eval overlap as lanes. --events dumps the
-raw recorder ring instead; --vars prints a telemetry-registry snapshot
-of the recorded run. Exit 0 on success, 2 on usage errors.
+see the host-tail / device-eval overlap as lanes; with GRAFT_PODTRACE=1
+the tracer's tail-exemplar pods render as additional per-pod phase
+lanes. --events dumps the raw recorder ring instead; --vars prints a
+telemetry-registry snapshot of the recorded run. --trend renders the
+BENCH_r*.json headline trajectory and exits nonzero on a regression
+past the box-noise band (observability/trend.py — the CI contract;
+pure stdlib, runs without jax). Exit 0 on success, 1 on a trend
+regression, 2 on usage errors.
 """
 
 from __future__ import annotations
@@ -89,11 +95,27 @@ def main(argv=None) -> int:
     ap.add_argument("--no-warm", action="store_true",
                     help="skip the warmup drain (compiles land in the "
                          "recorded window)")
+    ap.add_argument("--trend", action="store_true",
+                    help="render the BENCH_r*.json headline trend and "
+                         "exit nonzero on a regression (no jax, no "
+                         "drain)")
+    ap.add_argument("--root", default=None,
+                    help="trend: directory holding the artifacts")
+    ap.add_argument("--band", type=float, default=None,
+                    help="trend: relative noise band (default 0.30)")
     args = ap.parse_args(argv)
+    if args.trend:
+        from kubernetes_tpu.observability import trend
+        targv = []
+        if args.root:
+            targv += ["--root", args.root]
+        if args.band is not None:
+            targv += ["--band", str(args.band)]
+        return trend.main(targv)
     if not (args.trace or args.events or args.vars):
         ap.print_usage(sys.stderr)
-        print("nothing to do: pass --trace, --events and/or --vars",
-              file=sys.stderr)
+        print("nothing to do: pass --trace, --events and/or --vars, "
+              "or --trend", file=sys.stderr)
         return 2
 
     events, elapsed, totals, sched = _record_drain(
@@ -107,13 +129,29 @@ def main(argv=None) -> int:
           file=sys.stderr)
     if args.trace:
         from kubernetes_tpu.observability.perfetto import (
-            export_chrome_trace,
+            add_pod_lanes,
+            build_chrome_trace,
             overlap_seconds,
         )
-        trace = export_chrome_trace(events, args.trace)
+        from kubernetes_tpu.observability.podtrace import TRACER
+        trace = build_chrome_trace(events)
+        n_pods = 0
+        if TRACER.enabled:
+            # tail-exemplar pod lanes (ISSUE 15): the slowest sampled
+            # pods of the recorded drain, phase-decomposed, aligned to
+            # the ring's time base so each pod overlays the waves it
+            # actually crossed
+            exemplars = TRACER.snapshot()["exemplars"]
+            t_base = min((e["t"] for e in events), default=None)
+            add_pod_lanes(trace, exemplars, t_base=t_base)
+            n_pods = len(exemplars)
+        with open(args.trace, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+            f.write("\n")
         hidden = overlap_seconds(events)
         print(f"wrote {args.trace}: {len(trace['traceEvents'])} trace "
-              f"events, {hidden * 1e3:.1f}ms of host work hidden under "
+              f"events ({n_pods} exemplar pod lanes), "
+              f"{hidden * 1e3:.1f}ms of host work hidden under "
               f"device-eval windows", file=sys.stderr)
     if args.events:
         with open(args.events, "w", encoding="utf-8") as f:

@@ -14,8 +14,20 @@ becomes a picture you load in chrome://tracing or ui.perfetto.dev:
   host lane; in `overlap=False` debug mode the lanes serialize. That
   picture IS the r14 overlap attribution, automated.
 - **fence** lane: instant markers for fence requeues, Protean patches,
-  degraded-mode transitions and churn ops — the churn story lands on
-  the same time axis as the waves it perturbed.
+  degraded-mode transitions, churn ops and SLO-alert flips — the churn
+  story lands on the same time axis as the waves it perturbed.
+
+Flow arrows (ISSUE 15 satellite): every wave's dispatch → device-eval →
+bind-flush chain carries Chrome flow events (``ph`` s/t/f with the wave
+id), so following one wave across the host and device lanes is a click,
+not a visual scan; the span events carry ``span_ms`` args alongside
+their pod counts.
+
+Pod lanes (ISSUE 15): ``add_pod_lanes`` renders the tracer's slowest-K
+tail exemplars as one lane per pod — each consecutive-event delta drawn
+as a phase span (the SAME labels as podtrace.decompose, so the picture
+and the window aggregate can never disagree), wire hops and fence
+requeues as instants.
 
 Format: the Chrome trace-event JSON object form ({"traceEvents": [...]})
 with "X" complete events for spans, "i" instants for markers, and "M"
@@ -56,8 +68,11 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
     def us(t: float) -> float:
         return round((t - t_base) * 1e6, 1)
 
-    # device lane windows need the dispatch/harvest pair per wave id
+    # device lane windows need the dispatch/harvest pair per wave id;
+    # the flow arrows (dispatch → device-eval → bind-flush of one wave)
+    # need an anchor instant inside each span
     dispatch_end: Dict[int, float] = {}
+    flow_anchor: Dict[int, List] = {}  # wave -> [(tid, ts_us), ...]
     for e in events:
         kind = e["kind"]
         if kind == "dispatch":
@@ -65,7 +80,10 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
             out.append({"ph": "X", "pid": PID, "tid": TID_HOST,
                         "name": f"dispatch w{e['wave']}",
                         "ts": us(e["t"]), "dur": round(e["dur"] * 1e6, 1),
-                        "args": {"pods": e["a"], "gangs": e["b"]}})
+                        "args": {"pods": e["a"], "gangs": e["b"],
+                                 "span_ms": round(e["dur"] * 1e3, 3)}})
+            flow_anchor.setdefault(e["wave"], []).append(
+                (TID_HOST, us(e["t"])))
         elif kind == "harvest":
             block_end = e["t"] + e["dur"]
             start = dispatch_end.get(e["wave"], e["t"])
@@ -75,14 +93,22 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
                         "dur": max(round((block_end - start) * 1e6, 1),
                                    0.1),
                         "args": {"bound": e["a"], "fenced": e["b"],
+                                 "span_ms": round((block_end - start)
+                                                  * 1e3, 3),
                                  "residual_block_ms":
                                      round(e["dur"] * 1e3, 3)}})
+            flow_anchor.setdefault(e["wave"], []).append(
+                (TID_DEVICE, us(start)))
         elif kind == "bind_flush":
             out.append({"ph": "X", "pid": PID, "tid": TID_HOST,
                         "name": f"bind-flush w{e['wave']}"
                         if e["wave"] >= 0 else "bind-flush (classic)",
                         "ts": us(e["t"]), "dur": round(e["dur"] * 1e6, 1),
-                        "args": {"bound": e["a"], "bind_errors": e["b"]}})
+                        "args": {"bound": e["a"], "bind_errors": e["b"],
+                                 "span_ms": round(e["dur"] * 1e3, 3)}})
+            if e["wave"] >= 0:
+                flow_anchor.setdefault(e["wave"], []).append(
+                    (TID_HOST, us(e["t"])))
         elif kind == "fence_requeue":
             out.append({"ph": "i", "pid": PID, "tid": TID_FENCE, "s": "t",
                         "name": f"fence-requeue w{e['wave']}",
@@ -130,7 +156,79 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
                         "ts": us(e["t"]),
                         "args": {"victims": e["a"],
                                  "lowest_priority": e["b"]}})
+        elif kind == "slo_alert":
+            out.append({"ph": "i", "pid": PID, "tid": TID_FENCE, "s": "p",
+                        "name": "slo-alert-enter" if e["a"]
+                        else "slo-alert-exit",
+                        "ts": us(e["t"]),
+                        "args": {"burn_fast_x100": e["b"]}})
+    # flow arrows: one chain per wave through its recorded stages, in
+    # stage order (dispatch → device-eval → bind-flush). Chrome binds a
+    # flow event to the slice enclosing (tid, ts), so each anchor is the
+    # span's own start instant.
+    for wave, anchors in sorted(flow_anchor.items()):
+        if len(anchors) < 2:
+            continue
+        for i, (tid, ts) in enumerate(anchors):
+            ph = "s" if i == 0 else ("f" if i == len(anchors) - 1
+                                     else "t")
+            ev = {"ph": ph, "pid": PID, "tid": tid, "cat": "wave",
+                  "id": wave, "name": f"wave w{wave}", "ts": ts}
+            if ph == "f":
+                ev["bp"] = "e"
+            out.append(ev)
     return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# pod-exemplar lane tids start far above the fixed lanes
+TID_POD_BASE = 16
+
+
+def add_pod_lanes(trace: Dict, exemplars: List[Dict],
+                  base_tid: int = TID_POD_BASE,
+                  t_base: Optional[float] = None) -> Dict:
+    """Append one lane per tail-exemplar pod (podtrace snapshot
+    ``exemplars`` entries) to a built trace: consecutive-event deltas as
+    phase spans labeled EXACTLY like podtrace.decompose, instants for
+    the zero-width stamps. ``t_base`` is the RING's time origin (the
+    min event t the main lanes were rendered against) so a pod's lane
+    aligns with the waves it actually crossed; without it the lanes
+    align against the earliest exemplar instead (self-consistent, but
+    not wave-aligned). Returns the trace for chaining."""
+    from kubernetes_tpu.observability import podtrace as pt
+    kind_code = {nm: i for i, nm in enumerate(pt.KIND_NAMES)}
+    out = trace["traceEvents"]
+    if t_base is None:
+        t_base = min((ex.get("t0", 0.0) for ex in exemplars),
+                     default=0.0)
+    for lane, ex in enumerate(exemplars):
+        tid = base_tid + lane
+        off_us = round((ex.get("t0", t_base) - t_base) * 1e6, 1)
+        out.append({"ph": "M", "pid": PID, "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": f"pod {ex['key']} "
+                                     f"({ex['span_ms']:.1f}ms)"}})
+        evs = ex["events"]
+        requeued = False
+        for i in range(1, len(evs)):
+            prev, cur = evs[i - 1], evs[i]
+            pk = kind_code.get(prev["kind"], -1)
+            ck = kind_code.get(cur["kind"], -1)
+            ph = pt.phase_of(pk, ck, requeued)
+            if ck == pt.FENCE_REQUEUED:
+                requeued = True
+            out.append({"ph": "X", "pid": PID, "tid": tid, "name": ph,
+                        "ts": round(off_us + prev["t_ms"] * 1e3, 1),
+                        "dur": max(round((cur["t_ms"] - prev["t_ms"])
+                                         * 1e3, 1), 0.1),
+                        "args": {"to": cur["kind"], "a": cur["a"],
+                                 "b": cur["b"]}})
+        for ev in evs:
+            out.append({"ph": "i", "pid": PID, "tid": tid, "s": "t",
+                        "name": ev["kind"],
+                        "ts": round(off_us + ev["t_ms"] * 1e3, 1),
+                        "args": {"a": ev["a"], "b": ev["b"]}})
+    return trace
 
 
 def export_chrome_trace(events: List[Dict], path: str) -> Dict:
@@ -201,4 +299,5 @@ def overlap_seconds(events: List[Dict]) -> float:
     return total
 
 
-__all__ = ["build_chrome_trace", "export_chrome_trace", "overlap_seconds"]
+__all__ = ["add_pod_lanes", "build_chrome_trace", "export_chrome_trace",
+           "overlap_seconds"]

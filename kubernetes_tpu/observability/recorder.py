@@ -60,6 +60,10 @@ Event kinds (the per-wave vocabulary of the pipelined engine):
                 landed-timeout ambiguity's injected shape (0 plain).
     VICTIM_REQUEUE   a commit's victims re-entered the pending pool.
                 wave=id, a=victim count, b=lowest victim priority.
+    SLO_ALERT   the SLO engine's multiwindow burn-rate alert flipped
+                (ISSUE 15). a=1 enter / 0 exit, b=fast-window burn rate
+                x100 at the flip — the page lands on the same timeline
+                as the waves that caused it.
 """
 
 from __future__ import annotations
@@ -84,10 +88,12 @@ PREEMPT_PROPOSE = 7
 PREEMPT_COMMIT = 8
 PREEMPT_ROLLBACK = 9
 VICTIM_REQUEUE = 10
+SLO_ALERT = 11
 
 KIND_NAMES = ("dispatch", "harvest", "fence_requeue", "patch",
               "bind_flush", "degraded", "churn_op", "preempt_propose",
-              "preempt_commit", "preempt_rollback", "victim_requeue")
+              "preempt_commit", "preempt_rollback", "victim_requeue",
+              "slo_alert")
 
 # churn-op kind -> small int for the CHURN_OP event's `a` field
 CHURN_OP_CODES = {"kill": 0, "respawn": 1, "flap_down": 2, "flap_up": 3,
@@ -207,4 +213,4 @@ __all__ = ["BIND_FLUSH", "CHURN_OP", "CHURN_OP_CODES", "CHURN_OP_NAMES",
            "DEGRADED", "DISPATCH", "FENCE_REQUEUE", "FlightRecorder",
            "HARVEST", "KIND_NAMES", "PATCH", "PREEMPT_COMMIT",
            "PREEMPT_PROPOSE", "PREEMPT_ROLLBACK", "RECORDER",
-           "VICTIM_REQUEUE"]
+           "SLO_ALERT", "VICTIM_REQUEUE"]

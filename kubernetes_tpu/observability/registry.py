@@ -41,7 +41,9 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from kubernetes_tpu.observability.podtrace import TRACER
 from kubernetes_tpu.observability.recorder import RECORDER
+from kubernetes_tpu.observability.slo import SLO
 from kubernetes_tpu.utils.trace import COUNTERS
 
 
@@ -49,9 +51,16 @@ class TelemetryRegistry:
     """One process-local fold over span counters, SchedulerMetrics,
     counter dicts, and gauge providers."""
 
-    def __init__(self, spans=COUNTERS, recorder=RECORDER):
+    def __init__(self, spans=COUNTERS, recorder=RECORDER, tracer=TRACER,
+                 slo=SLO):
         self._spans = spans
         self._recorder = recorder
+        # pod-level black box (ISSUE 15): the tracer's bound accounting
+        # + per-window phase aggregate and the SLO gauges fold in beside
+        # the recorder, so "why is p99 moving" is one scrape on any
+        # transport
+        self._tracer = tracer
+        self._slo = slo
         # keyed sources; insertion-ordered so renders are stable. The
         # registration lock guards the MAPS only (a ScheduleLoop swap
         # races a scrape's iteration — dict-changed-size mid-snapshot);
@@ -149,6 +158,10 @@ class TelemetryRegistry:
                 out[f"gauge.{k}"] = v
         for k, v in self._recorder.stats().items():
             out[f"recorder.{k}"] = v
+        for k, v in self._tracer.stats().items():
+            out[f"podtrace.{k}"] = v
+        for k, v in self._slo.snapshot().items():
+            out[f"slo.{k}"] = v
         return out
 
     # --------------------------------------------------------- Prometheus
@@ -184,6 +197,24 @@ class TelemetryRegistry:
             name = f"tpu_flight_recorder_{k}"
             kind = "counter" if k in ("events", "dropped") else "gauge"
             lines.append(f"# TYPE {name} {kind}\n{name} {rec[k]}")
+        # pod tracer + SLO families (ISSUE 15): dots in the phase keys
+        # become underscores (Prometheus name grammar)
+        trc = self._tracer.stats()
+        for k in sorted(trc):
+            name = "tpu_podtrace_" + k.replace(".", "_")
+            # phase.* values reset per window — gauges, not counters
+            # (a counter TYPE would make rate()/increase() misread every
+            # rotation as a reset)
+            kind = "counter" if (("total" in k or "dropped" in k
+                                  or "duplicate" in k or "abandoned" in k)
+                                 and not k.startswith("phase.")) \
+                else "gauge"
+            lines.append(f"# TYPE {name} {kind}\n{name} {trc[k]}")
+        slo = self._slo.snapshot()
+        for k in sorted(slo):
+            name = f"tpu_slo_{k}"
+            kind = "counter" if k == "alerts_total" else "gauge"
+            lines.append(f"# TYPE {name} {kind}\n{name} {slo[k]}")
         return "\n".join(lines)
 
 

@@ -1,0 +1,206 @@
+"""Bench-trajectory trend reader (ISSUE 15 satellite): the BENCH_r*.json
+artifacts become a queryable trajectory instead of sixteen files a human
+diffs by hand.
+
+    python bench.py --trend
+    python -m kubernetes_tpu.observability --trend [--root DIR]
+                                                   [--band 0.30]
+
+Reads every BENCH_r*.json under the repo root (the driver-written
+{cmd, rc, parsed} shape and the bench's own artifacts alike) plus
+PROGRESS.jsonl, renders a headline-metric trend table, and flags
+regressions: the LATEST round's value against the nearest earlier round
+carrying the same metric, beyond the documented ±30% box-noise band
+(PROFILE_r10.md — the 2-core CI box moves knees ±30% run to run, so a
+smaller delta is noise, a larger one is a finding). Exit status is the
+CI contract: 0 clean, 1 when any headline metric regressed past the
+band, 2 on usage/IO errors.
+
+Pure stdlib — no jax import, safe to run anywhere (including the
+lint-gate CI leg).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# (artifact key, short label, direction) — direction "up" = bigger is
+# better, "down" = smaller is better, None = informational only (never
+# flags; overhead percentages swing sign with box noise)
+HEADLINE_METRICS: Tuple[Tuple[str, str, Optional[str]], ...] = (
+    ("value", "drain pods/s", "up"),
+    ("arrival_sustained_pods_s", "arrival sust/s", "up"),
+    ("arrival_p99_create_to_bound_ms", "arrival p99 ms", "down"),
+    ("multi_frontend_pods_s", "fleet inproc/s", "up"),
+    ("multi_frontend_binwire_pods_s", "fleet binwire/s", "up"),
+    ("churn_vs_quiet", "churn/quiet", "up"),
+    ("telemetry_overhead_pct", "recorder ovh %", None),
+    ("podtrace_overhead_pct", "podtrace ovh %", None),
+)
+
+NOISE_BAND = 0.30
+
+
+def load_rounds(root: str) -> List[Tuple[int, Dict]]:
+    """Every BENCH_r<NN>.json under root as (round, parsed) — tolerant
+    of both the driver shape ({"parsed": {...}}) and a bare dict."""
+    out: List[Tuple[int, Dict]] = []
+    for name in os.listdir(root):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(root, name), "r",
+                      encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed", doc) if isinstance(doc, dict) else None
+        if isinstance(parsed, dict):
+            out.append((int(m.group(1)), parsed))
+    out.sort()
+    return out
+
+
+def _metric(parsed: Dict, key: str) -> Optional[float]:
+    v = parsed.get(key)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def find_regressions(rounds: List[Tuple[int, Dict]],
+                     band: float = NOISE_BAND) -> List[Dict]:
+    """Latest round vs the nearest EARLIER round carrying each headline
+    metric; a delta past the band in the bad direction is a
+    regression."""
+    if len(rounds) < 2:
+        return []
+    latest_r, latest = rounds[-1]
+    regs: List[Dict] = []
+    for key, label, direction in HEADLINE_METRICS:
+        if direction is None:
+            continue
+        cur = _metric(latest, key)
+        if cur is None:
+            continue
+        prev = prev_r = None
+        for r, parsed in reversed(rounds[:-1]):
+            prev = _metric(parsed, key)
+            if prev is not None:
+                prev_r = r
+                break
+        if prev is None or prev == 0:
+            continue
+        bad = (cur < prev * (1.0 - band)) if direction == "up" \
+            else (cur > prev * (1.0 + band))
+        if bad:
+            regs.append({"metric": key, "label": label,
+                         "round": latest_r, "vs_round": prev_r,
+                         "current": cur, "previous": prev,
+                         "ratio": round(cur / prev, 3),
+                         "direction": direction})
+    return regs
+
+
+def render_table(rounds: List[Tuple[int, Dict]]) -> str:
+    cols = [k for k, _l, _d in HEADLINE_METRICS
+            if any(_metric(p, k) is not None for _r, p in rounds)]
+    labels = {k: l for k, l, _d in HEADLINE_METRICS}
+    head = ["round"] + [labels[k] for k in cols]
+    body: List[List[str]] = []
+    for r, parsed in rounds:
+        row = [f"r{r:02d}"]
+        for k in cols:
+            v = _metric(parsed, k)
+            row.append("-" if v is None else
+                       (f"{v:.2f}" if abs(v) < 100 else f"{v:.0f}"))
+        body.append(row)
+    widths = [max(len(head[i]), *(len(row[i]) for row in body))
+              for i in range(len(head))]
+    lines = ["  ".join(h.rjust(w) for h, w in zip(head, widths))]
+    for row in body:
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+def progress_summary(root: str) -> str:
+    """One line per driver round from PROGRESS.jsonl (last entry wins):
+    the repo-growth trajectory beside the perf one."""
+    path = os.path.join(root, "PROGRESS.jsonl")
+    if not os.path.exists(path):
+        return ""
+    last: Dict[int, Dict] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(e, dict) and "round" in e:
+                    last[int(e["round"])] = e
+    except OSError:
+        return ""
+    if not last:
+        return ""
+    lines = ["progress (PROGRESS.jsonl, last sample per round):"]
+    for r in sorted(last):
+        e = last[r]
+        lines.append(f"  round {r:2d}: loc={e.get('loc', '?')} "
+                     f"commits={e.get('commits', '?')} "
+                     f"turns={e.get('turns', '?')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench.py --trend",
+        description="render the BENCH_r*.json headline trend and flag "
+                    "regressions beyond the box-noise band (nonzero "
+                    "exit: CI contract)")
+    ap.add_argument("--root", default=None,
+                    help="directory holding BENCH_r*.json + "
+                         "PROGRESS.jsonl (default: the repo root)")
+    ap.add_argument("--band", type=float, default=NOISE_BAND,
+                    help="relative noise band (default 0.30 — the "
+                         "documented 2-core box swing)")
+    args = ap.parse_args(argv)
+    root = args.root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if not os.path.isdir(root):
+        print(f"trend: no such directory {root}", file=sys.stderr)
+        return 2
+    rounds = load_rounds(root)
+    if not rounds:
+        print(f"trend: no BENCH_r*.json under {root}", file=sys.stderr)
+        return 2
+    print(render_table(rounds))
+    prog = progress_summary(root)
+    if prog:
+        print(prog)
+    regs = find_regressions(rounds, band=args.band)
+    if regs:
+        print(f"\nREGRESSIONS past the ±{args.band:.0%} band:")
+        for g in regs:
+            arrow = "v" if g["direction"] == "up" else "^"
+            print(f"  {arrow} {g['label']} ({g['metric']}): "
+                  f"r{g['round']:02d}={g['current']:.2f} vs "
+                  f"r{g['vs_round']:02d}={g['previous']:.2f} "
+                  f"(x{g['ratio']})")
+        return 1
+    print(f"\nno regressions past the ±{args.band:.0%} band "
+          f"(latest r{rounds[-1][0]:02d} vs trajectory)")
+    return 0
+
+
+__all__ = ["HEADLINE_METRICS", "NOISE_BAND", "find_regressions",
+           "load_rounds", "main", "progress_summary", "render_table"]

@@ -79,14 +79,15 @@ class _Ticket:
 
 
 class _BindTicket:
-    __slots__ = ("args", "deadline_s", "blob", "arrival", "fut")
+    __slots__ = ("args", "deadline_s", "blob", "arrival", "fut", "tid")
 
-    def __init__(self, args, deadline_s, blob, arrival, fut):
+    def __init__(self, args, deadline_s, blob, arrival, fut, tid=None):
         self.args = args  # (name, ns, uid, node, gen, idem_key)
         self.deadline_s = deadline_s
         self.blob = blob
         self.arrival = arrival
         self.fut = fut
+        self.tid = tid  # pod-trace context (ISSUE 15), None untraced
 
 
 class AsyncBinaryServer:
@@ -209,6 +210,16 @@ class AsyncBinaryServer:
         # jittered so a fleet shed together does not return together
         return self._rng.randint(5, 40)
 
+    @staticmethod
+    def _trace_hop(trace_id: str, hop_verb: int) -> None:
+        """Pod-trace context honor (ISSUE 15): one WIRE_HOP stamp on the
+        pod's timeline — host-pure, one lock, safe on the event loop
+        (the tracer off is one attribute check)."""
+        from kubernetes_tpu.observability import podtrace
+        if podtrace.TRACER.enabled:
+            podtrace.TRACER.wire_hop(trace_id, podtrace.WIRE_BINARY,
+                                     hop_verb)
+
     def _decode_pod(self, blob: bytes):
         """Worker-side cached pod decode (constructor comment)."""
         if not blob:
@@ -294,6 +305,9 @@ class AsyncBinaryServer:
                 self._count("admission_shed")
                 return framing.OVERLOADED, framing.encode_overloaded(
                     self._retry_ms())
+            tid, payload = framing.unwrap_trace(payload, flags)
+            if tid is not None:
+                self._trace_hop(tid, 0)
             # LAZY parse: header fields only — the pod blob decodes on
             # the worker (cached), never on the event loop
             blob, top_k, deadline_ms = \
@@ -315,13 +329,16 @@ class AsyncBinaryServer:
                 self._count("admission_shed")
                 return framing.OVERLOADED, framing.encode_overloaded(
                     self._retry_ms())
+            tid, payload = framing.unwrap_trace(payload, flags)
+            if tid is not None:
+                self._trace_hop(tid, 1)
             (name, ns, uid, node, gen, idem_key, deadline_ms,
              blob) = framing.decode_bind_request_lazy(payload)
             fut = loop.create_future()
             self._bind_pend.append(_BindTicket(
                 (name, ns, uid, node, gen, idem_key),
                 deadline_ms / 1e3 if deadline_ms else None,
-                blob, loop.time(), fut))
+                blob, loop.time(), fut, tid=tid))
             if self._pump_task is None or self._pump_task.done():
                 self._pump_task = loop.create_task(self._pump())
             return await fut
@@ -401,8 +418,8 @@ class AsyncBinaryServer:
                 self._count("wire_batches")
                 self._count("wire_requests", len(live))
             items = [(t.blob, t.top_k, t.compact) for t in live]
-            bitems = [(t.args, t.deadline_s, t.blob, now - t.arrival)
-                      for t in live_b]
+            bitems = [(t.args, t.deadline_s, t.blob, now - t.arrival,
+                       t.tid) for t in live_b]
             self._inflight_tickets = live + live_b
             try:
                 results, bresults = await loop.run_in_executor(
@@ -431,8 +448,9 @@ class AsyncBinaryServer:
         worker round — co-located/in-process binders (the deployment
         this wire serves; a remote apiserver amortizes through
         bind_pods_bulk upstream) keep the round short."""
+        from kubernetes_tpu.server.embedded import VerdictService
         res: List[Tuple[int, bytes]] = []
-        for (args, deadline_s, blob, waited) in bitems:
+        for (args, deadline_s, blob, waited, tid) in bitems:
             name, ns, uid, node, gen, idem_key = args
             try:
                 remaining = None if deadline_s is None \
@@ -441,6 +459,11 @@ class AsyncBinaryServer:
                     name, ns, uid, node, snapshot_gen=gen,
                     idem_key=idem_key, deadline_s=remaining,
                     pod=self._decode_pod(blob))
+                if tid and r.kind == "ok":
+                    # complete the wire-path trace (embedded.py
+                    # trace_bound docstring): no scheduler bind path
+                    # exists here to terminate the timeline
+                    VerdictService.trace_bound(tid)
                 res.append((framing.BIND_RESULT, framing.encode_bind_result(
                     r.kind, max(int(r.retry_after_s * 1e3), 1)
                     if r.retry_after_s else 0, r.error)))

@@ -93,12 +93,17 @@ class VerdictService:
 
     def filter(self, pod, node_names: Optional[List[str]] = None,
                top_k: int = 0, deadline_s: Optional[float] = None,
-               compact: bool = False) -> FilterVerdict:
+               compact: bool = False,
+               trace_ctx: Optional[str] = None) -> FilterVerdict:
         """Fused filter(+topk) through the coalescing window. Raises the
         coalescer's Overloaded / DeadlineExceeded. ``node_names``
         restricts the candidate set (the HTTP args shape); compact
         elision only applies to the whole-cluster form — a restricted
-        verdict always echoes its survivors."""
+        verdict always echoes its survivors. ``trace_ctx`` stamps one
+        embedded WIRE_HOP on the pod-trace timeline (ISSUE 15) — the
+        in-process twin of the HTTP header / binary flag."""
+        if trace_ctx:
+            self._trace_hop(trace_ctx, 0)
         b = self.backend
         if top_k:
             passed, failed, top, gen = b.fused_verdict(
@@ -120,13 +125,37 @@ class VerdictService:
             passed=None if (compact and all_passed) else list(passed),
             failed=dict(failed), top_scores=top)
 
+    @staticmethod
+    def _trace_hop(trace_id: str, hop_verb: int) -> None:
+        from kubernetes_tpu.observability import podtrace
+        if podtrace.TRACER.enabled:
+            podtrace.TRACER.wire_hop(trace_id, podtrace.WIRE_EMBEDDED,
+                                     hop_verb)
+
+    @staticmethod
+    def trace_bound(trace_id: str) -> None:
+        """Terminal BOUND for a wire-path trace: the sidecar deployment
+        has no scheduler bind path to complete the timeline, so each
+        transport stamps completion when ITS bind verdict lands ok —
+        without this, wire timelines would pin live slots until the
+        window-abandonment sweep and /debug/pods would never show a
+        completed wire exemplar."""
+        from kubernetes_tpu.observability import podtrace
+        if podtrace.TRACER.enabled:
+            podtrace.TRACER.bound_batch([trace_id])
+
     def bind(self, pod_name: str, namespace: str, uid: str, node: str,
              snapshot_gen: Optional[int] = None,
              idem_key: Optional[str] = None,
-             deadline_s: Optional[float] = None, pod=None) -> BindResult:
+             deadline_s: Optional[float] = None, pod=None,
+             trace_ctx: Optional[str] = None) -> BindResult:
+        if trace_ctx:
+            self._trace_hop(trace_ctx, 1)
         err, kind, retry_s = self.backend.bind_verdict(
             pod_name, namespace, uid, node, snapshot_gen=snapshot_gen,
             idem_key=idem_key, deadline_s=deadline_s, pod_spec=pod)
+        if trace_ctx and kind == "ok":
+            self.trace_bound(trace_ctx)
         return BindResult(kind=kind, error=err, retry_after_s=retry_s)
 
     def sync_nodes(self, nodes) -> int:
@@ -141,16 +170,21 @@ class VerdictService:
         return self.backend.metrics_text()
 
     def debug_snapshot(self, last: int = 0) -> Dict:
-        """Live introspection (ISSUE 13): the unified telemetry-registry
-        snapshot plus the flight recorder's last ``last`` events —
-        IDENTICAL content to HTTP ``/debug/vars`` + ``/debug/trace`` and
-        the binary wire's STATS verb (transport parity is test-pinned;
-        the registry snapshots each source under its own lock, so a
-        mid-storm read never tears)."""
+        """Live introspection (ISSUE 13 + 15): the unified telemetry-
+        registry snapshot, the flight recorder's last ``last`` events,
+        the pod tracer's black box and the SLO engine's burn-rate view —
+        IDENTICAL content to HTTP ``/debug/vars`` + ``/debug/trace`` +
+        ``/debug/pods`` + ``/debug/slo`` and the binary wire's STATS
+        verb (transport parity is test-pinned; every source snapshots
+        under its own lock, so a mid-storm read never tears)."""
         dv = getattr(self.backend, "debug_vars", None)
         dt = getattr(self.backend, "debug_trace", None)
+        dp = getattr(self.backend, "debug_pods", None)
+        ds = getattr(self.backend, "debug_slo", None)
         return {"vars": dv() if dv is not None else {},
-                "trace": dt(last) if (last and dt is not None) else []}
+                "trace": dt(last) if (last and dt is not None) else [],
+                "pods": dp() if dp is not None else {},
+                "slo": ds() if ds is not None else {}}
 
     # ----------------------------------------------- batch seam (asyncwire)
 
@@ -238,10 +272,19 @@ class EmbeddedVerdictAPI(VerdictService):
             Overloaded,
         )
         rng = rng or random.Random()
+        # pod-trace context (ISSUE 15): a sampled pod's filter/bind hops
+        # join one timeline — the embedded twin of the wire contexts
+        from kubernetes_tpu.observability.podtrace import TRACER
+        trace_ctx = None
+        if TRACER.enabled:
+            key = f"{pod.namespace}/{pod.name}"
+            if TRACER.sampled(key):
+                TRACER.begin_forced(key)
+                trace_ctx = key
         for attempt in range(max_attempts):
             try:
                 v = self.filter(pod, top_k=top_k, deadline_s=deadline_s,
-                                compact=True)
+                                compact=True, trace_ctx=trace_ctx)
             except Overloaded as e:
                 time.sleep(e.retry_after_s * rng.uniform(0.5, 1.5))
                 continue
@@ -260,7 +303,8 @@ class EmbeddedVerdictAPI(VerdictService):
             res = self.bind(pod.name, pod.namespace, pod.uid, node,
                             snapshot_gen=v.snapshot_gen,
                             idem_key=f"{pod.namespace}/{pod.name}:{attempt}",
-                            deadline_s=deadline_s, pod=pod)
+                            deadline_s=deadline_s, pod=pod,
+                            trace_ctx=trace_ctx)
             if res.ok:
                 return node, attempt + 1
             if res.retryable:

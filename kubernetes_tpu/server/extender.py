@@ -15,6 +15,15 @@ kube-scheduler with `--policy-config-file` pointing at an ExtenderConfig
                             — identical content to the binary STATS verb
                             and the embedded debug_snapshot)
   GET  /debug/trace?last=N  the flight recorder's event tail
+  GET  /debug/pods          pod-level black box (ISSUE 15): the tracer's
+                            per-window critical-path aggregate + the
+                            slowest-K tail-exemplar timelines
+  GET  /debug/slo           the SLO engine's burn-rate/alert snapshot
+
+Trace context (ISSUE 15): a POST /filter or /bind carrying an
+``X-Pod-Trace: <id>`` header stamps one WIRE_HOP on that pod's podtrace
+timeline — the HTTP twin of the binary wire's FLAG_TRACE field and the
+embedded API's ``trace_ctx=``; header presence IS the sample decision.
 
 JSON keys: the reference posts the *internal* structs (no json tags ->
 capitalized keys: "Pod", "Nodes", "NodeNames"); Go's json.Unmarshal is
@@ -185,6 +194,21 @@ class ExtenderHTTPServer:
                         except ValueError:
                             last = 256
                         self._write_json(dt(last))
+                elif self.path == "/debug/pods":
+                    # pod-level black box (ISSUE 15) — identical content
+                    # to the binary STATS verb's "pods" key and the
+                    # embedded debug_snapshot, test-pinned
+                    dp = getattr(outer.backend, "debug_pods", None)
+                    if dp is None:
+                        self._write_json({"error": "not found"}, 404)
+                    else:
+                        self._write_json(dp())
+                elif self.path == "/debug/slo":
+                    ds = getattr(outer.backend, "debug_slo", None)
+                    if ds is None:
+                        self._write_json({"error": "not found"}, 404)
+                    else:
+                        self._write_json(ds())
                 else:
                     self._write_json({"error": "not found"}, 404)
 
@@ -240,6 +264,16 @@ class ExtenderHTTPServer:
                              "RetryAfterMs": random.randint(10, 80)},
                             429, headers={"Retry-After": "1"})
                         return
+                    tid = self.headers.get("X-Pod-Trace")
+                    if tid and path in ("/filter", "/bind"):
+                        # trace-context hop (ISSUE 15): header presence
+                        # is the client's head decision — honor it
+                        from kubernetes_tpu.observability import podtrace
+                        if podtrace.TRACER.enabled:
+                            podtrace.TRACER.wire_hop(
+                                tid, podtrace.WIRE_HTTP,
+                                podtrace.HOP_FILTER if path == "/filter"
+                                else podtrace.HOP_BIND)
                     try:
                         payload = json.loads(raw or b"{}")
                         if path == "/filter":
@@ -248,6 +282,15 @@ class ExtenderHTTPServer:
                             out, code = outer.handle_prioritize(payload), 200
                         else:
                             out, code = outer.handle_bind(payload)
+                            if tid and code == 200 \
+                                    and not out.get("Error"):
+                                # complete the wire-path trace: the
+                                # sidecar has no scheduler bind path to
+                                # terminate the timeline (embedded.py
+                                # trace_bound docstring)
+                                from kubernetes_tpu.server.embedded \
+                                    import VerdictService
+                                VerdictService.trace_bound(tid)
                         self._write_json(out, code)
                     finally:
                         outer._release()
@@ -560,6 +603,19 @@ class TPUExtenderBackend:
         /debug/vars as ``recorder.capacity``)."""
         from kubernetes_tpu.observability.recorder import RECORDER
         return RECORDER.snapshot(last) if last > 0 else []
+
+    def debug_pods(self):
+        """The pod tracer's /debug/pods payload (ISSUE 15) — per-window
+        critical-path aggregate + slowest-K exemplar timelines,
+        identical on every transport."""
+        from kubernetes_tpu.observability.podtrace import TRACER
+        return TRACER.snapshot()
+
+    def debug_slo(self):
+        """The SLO engine's /debug/slo payload (ISSUE 15), identical on
+        every transport."""
+        from kubernetes_tpu.observability.slo import SLO
+        return SLO.snapshot()
 
     # -- cache sync ---------------------------------------------------------
 

@@ -107,6 +107,12 @@ PONG = 0x89
 STATS_RESULT = 0x8A
 
 FLAG_COMPACT = 0x01
+# trace context on FILTER/BIND (ISSUE 15): when set, the payload is
+# PREFIXED with one str field — the pod-trace id — so a fleet
+# scheduleOne's filter->bind hops join one podtrace timeline across the
+# wire. Presence IS the sample decision (the client made the head call);
+# a server without the tracer armed skips the id in O(1).
+FLAG_TRACE = 0x02
 
 BIND_KINDS = ("ok", "conflict", "pending", "shed", "error")
 _BIND_KIND_CODE = {k: i for i, k in enumerate(BIND_KINDS)}
@@ -232,6 +238,25 @@ class Reader:
         if n > (len(self.buf) - self.pos) // 4 + 1:
             raise FrameError(f"corrupt list count {n}")
         return [self.str_() for _ in range(n)]
+
+
+# ---------------------------------------------------------- trace context
+
+
+def wrap_trace(payload: bytes, trace_id: str) -> bytes:
+    """Prefix a FILTER/BIND payload with the pod-trace id (the sender
+    also sets FLAG_TRACE on the frame)."""
+    return bytes(Writer().str_(trace_id).buf) + payload
+
+
+def unwrap_trace(payload: bytes, flags: int):
+    """(trace_id | None, payload rest): strips the FLAG_TRACE prefix
+    when present, returns the payload untouched otherwise."""
+    if not (flags & FLAG_TRACE):
+        return None, payload
+    r = Reader(payload)
+    tid = r.str_()
+    return tid, payload[r.pos:]
 
 
 # ----------------------------------------------------------------- frames
@@ -518,7 +543,8 @@ def decode_stats_result(payload: bytes) -> Dict:
 
 __all__ = [
     "BIND", "BIND_KINDS", "BIND_RESULT", "CODEC_JSON", "CODEC_PROTO",
-    "DEADLINE", "ERROR", "FILTER", "FLAG_COMPACT", "FrameDecoder",
+    "DEADLINE", "ERROR", "FILTER", "FLAG_COMPACT", "FLAG_TRACE",
+    "FrameDecoder",
     "FrameError", "HEADER_SIZE", "MAX_FRAME", "METRICS", "METRICS_TEXT",
     "OVERLOADED", "PING", "PONG", "Reader", "STATS", "STATS_RESULT",
     "SYNCED", "SYNC_NODES", "SYNC_PODS", "VERDICT", "Writer",
@@ -532,4 +558,5 @@ __all__ = [
     "encode_items_blob", "encode_metrics_text", "encode_overloaded",
     "encode_pod_blob", "encode_stats_request", "encode_stats_result",
     "encode_sync_request", "encode_synced", "encode_verdict",
+    "unwrap_trace", "wrap_trace",
 ]

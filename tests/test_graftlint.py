@@ -1206,3 +1206,83 @@ def test_gl002_flight_recorder_stays_host_pure(tmp_path):
     assert not errors, errors
     assert not [f for f in findings if "good_recorder_emit" in f.path], \
         findings
+
+def test_gl002_podtrace_slo_seams_stay_host_pure(tmp_path):
+    """ISSUE 15: the pod tracer and SLO engine stamp TIMESTAMPS and host
+    ints already in hand — a per-pod trace that fetched a device value
+    to fill an event would serialize the pipeline at every sampled pod,
+    the exact GL002 hazard at per-pod (not per-wave) cadence. The
+    registry built over the REAL engine sources produces ZERO GL002
+    findings over podtrace/slo/trend; a trace consumer that fetches the
+    jitted packed result to stamp a timeline FIRES (the silence is the
+    tracer's purity, not the rule going blind)."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    eng_py = os.path.join(PKG_DIR, "engine", "scheduler_engine.py")
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    obs_files = [
+        os.path.join(PKG_DIR, "observability", "podtrace.py"),
+        os.path.join(PKG_DIR, "observability", "slo.py"),
+        os.path.join(PKG_DIR, "observability", "trend.py"),
+    ]
+    # scan sanity: an empty jit registry would pass vacuously
+    index = ProjectIndex()
+    for src in (eng_py, waves_py):
+        with open(src, "r", encoding="utf-8") as fh:
+            index.scan(ast.parse(fh.read()))
+    assert "waves_loop" in index.jitted_names
+    findings, _sup, errors = run_paths([eng_py, waves_py] + obs_files,
+                                       rules=["GL002"])
+    assert not errors, errors
+    tainted = [f for f in findings
+               if any(os.path.basename(o) in f.path for o in obs_files)]
+    assert not tainted, tainted
+    # negative control: a per-pod stamp that fetches the jitted packed
+    # result to populate its event fields fires GL002
+    bad = tmp_path / "bad_podtrace_emit.py"
+    bad.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.waves import waves_loop
+        from kubernetes_tpu.observability.podtrace import (
+            HARVESTED,
+            TRACER,
+        )
+
+        def trace_wave(cls_arr, nodes, state, pc, ctr, prios, keys):
+            packed, _st = waves_loop(cls_arr, nodes, state, pc, ctr,
+                                     prios)
+            fetched = np.asarray(packed)
+            TRACER.batch_event(HARVESTED, keys, a=int(fetched[0]))
+            return fetched
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(bad)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "trace_wave" in f.context
+               for f in findings), findings
+    # the shipped shape — keys + wave id + a host timestamp beside the
+    # same jitted call — is silent
+    good = tmp_path / "good_podtrace_emit.py"
+    good.write_text(textwrap.dedent("""
+        import time
+        from kubernetes_tpu.engine.waves import waves_loop
+        from kubernetes_tpu.observability.podtrace import (
+            WAVE_DISPATCHED,
+            TRACER,
+        )
+
+        def trace_wave(cls_arr, nodes, state, pc, ctr, prios, keys, wid):
+            packed, _st = waves_loop(cls_arr, nodes, state, pc, ctr,
+                                     prios)
+            if TRACER.enabled:
+                TRACER.batch_event(WAVE_DISPATCHED, keys, a=wid,
+                                   t0=time.monotonic())
+            return packed
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(good)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "good_podtrace_emit" in f.path], \
+        findings
