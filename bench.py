@@ -1380,6 +1380,72 @@ def measure_multi_frontend(n_nodes: int, clients_list=(1, 10, 100),
     return results
 
 
+def measure_multiproc(n_nodes: int = 64, workers_list=(1, 2),
+                      pods_per_worker: int = 96, overlaps=(0.5,),
+                      relist_every: int = 16) -> dict:
+    """Process-fleet scaling (ISSUE 16): M FULL scheduler processes —
+    own interpreter, own evaluator, own bounded-stale snapshot — over
+    one shared cell through the fenced binary wire (the paper's Omega
+    shape, not the thread fleets' GIL-shared approximation).
+
+    Two sweeps: (a) scheduleOnes/s vs process count on DISJOINT pending
+    pools (multiproc_N keys — the scaling headline: M=2 should beat
+    M=1 on a multi-core box because the decision path has no shared
+    interpreter); (b) conflict rate vs pending-pool OVERLAP at max M
+    (multiproc_N_overlapP keys — Omega's conflict economics: every
+    contested pod costs W-1 typed double-claim refusals, and the store
+    audit must stay at hard zero duplicates throughout)."""
+    from kubernetes_tpu.parallel.multiproc import run_process_fleet
+
+    def slim(agg: dict) -> dict:
+        return {
+            "workers": agg["workers"],
+            "pods_per_worker": agg["pods_per_worker"],
+            "overlap": agg["overlap"],
+            "pods_s": round(agg["scheduled_pods_s"], 1),
+            "binds": agg["binds"],
+            "wall_s": round(agg["wall_s"], 3),
+            "conflicts": agg["conflicts"],
+            "conflict_rate": round(agg["conflict_rate"], 4),
+            "double_claim": agg["double_claim"],
+            "stale_snapshot": agg["stale_snapshot"],
+            "relists": agg["relists"],
+            "gave_up": agg["gave_up"],
+            "server_bind_conflicts": agg["server_bind_conflicts"],
+            "server_conflict_reasons": agg["server_conflict_reasons"],
+            "duplicate_binds": agg["duplicate_binds"],
+            "worker_failures": agg["worker_failures"],
+            "missing_workers": agg["missing_workers"],
+        }
+
+    out: dict = {"cpus": os.cpu_count()}
+    for m in workers_list:
+        r = run_process_fleet(
+            int(m), pods_per_worker=pods_per_worker, overlap=0.0,
+            n_nodes=n_nodes, relist_every=relist_every,
+            pod_prefix=f"mpb{m}", timeout_s=420.0)
+        out[f"multiproc_{m}"] = slim(r["agg"])
+    m_max = max(int(m) for m in workers_list)
+    for ov in overlaps:
+        ov = float(ov)
+        if ov <= 0.0:
+            continue
+        r = run_process_fleet(
+            m_max, pods_per_worker=pods_per_worker, overlap=ov,
+            n_nodes=n_nodes, relist_every=relist_every,
+            pod_prefix=f"mpbo{int(ov * 100)}", timeout_s=420.0)
+        out[f"multiproc_{m_max}_overlap_{int(ov * 100)}"] = slim(r["agg"])
+    one = out.get("multiproc_1", {}).get("pods_s")
+    top = out.get(f"multiproc_{m_max}", {}).get("pods_s")
+    if one and top:
+        out["scaling_max_vs_1"] = round(top / one, 2)
+    out["duplicate_binds_max"] = max(
+        (v.get("duplicate_binds", 0) for k, v in out.items()
+         if isinstance(v, dict) and k.startswith("multiproc_")),
+        default=0)
+    return out
+
+
 def _ratio(results, a: str, b: str):
     """pods_s ratio between two fleet results, None when either is
     missing/errored (the A/B must never invent a number)."""
@@ -2976,6 +3042,27 @@ def main():
             print(f"bench: multi-frontend measurement failed: {e}",
                   file=sys.stderr)
 
+    # process fleet (ISSUE 16): M full scheduler PROCESSES over one
+    # shared cell through the fenced wire — scaling vs process count on
+    # disjoint pools, conflict rate vs pending-pool overlap
+    # (BENCH_MULTIPROC=0 to skip; BENCH_MP_WORKERS, BENCH_MP_NODES,
+    # BENCH_MP_PODS_PER_WORKER, BENCH_MP_OVERLAPS knobs)
+    multiproc = None
+    if os.environ.get("BENCH_MULTIPROC", "1") != "0":
+        try:
+            multiproc = measure_multiproc(
+                n_nodes=int(os.environ.get("BENCH_MP_NODES", 64)),
+                workers_list=tuple(int(w) for w in os.environ.get(
+                    "BENCH_MP_WORKERS", "1,2").split(",")),
+                pods_per_worker=int(os.environ.get(
+                    "BENCH_MP_PODS_PER_WORKER", 96)),
+                overlaps=tuple(float(o) for o in os.environ.get(
+                    "BENCH_MP_OVERLAPS", "0.5").split(",") if o))
+        except Exception as e:
+            import sys
+            print(f"bench: multiproc measurement failed: {e}",
+                  file=sys.stderr)
+
     # wire-wall calibration (ISSUE 11 satellite): the NO-OP transport
     # floors on THIS box — threaded HTTP vs async binary — so every
     # fleet number above ships with its platform wall attribution
@@ -3170,6 +3257,22 @@ def main():
         "binwire_vs_inproc": _ratio(multi_frontend, "binwire_100",
                                     "inproc")
         if multi_frontend else None,
+        # process fleet (ISSUE 16): the multiproc_N scenarios — M full
+        # scheduler processes racing one shared cell through the bind
+        # fence. `multiproc_pods_s` is the max-M aggregate on DISJOINT
+        # pools (the scaling headline the trend gate tracks from r18);
+        # the overlap keys carry Omega's conflict economics; the store
+        # audit (duplicate_binds) is the hard-zero acceptance bar.
+        "multiproc": multiproc,
+        "multiproc_pods_s": max(
+            (v.get("pods_s", 0) for k, v in multiproc.items()
+             if isinstance(v, dict) and k.startswith("multiproc_")
+             and "overlap" not in k), default=None)
+        if multiproc else None,
+        "multiproc_scaling": multiproc.get("scaling_max_vs_1")
+        if multiproc else None,
+        "multiproc_duplicate_binds": multiproc.get("duplicate_binds_max")
+        if multiproc else None,
         # scale sweep (ISSUE 12): node-axis scaling A/B — per-shape 1-vs-8
         # device walls, bit-identity verdicts, O(n_devices) reduce +
         # one-shard-per-node delta counters, 50k streaming leg
@@ -3186,7 +3289,7 @@ def main():
     # working. BENCH_ARTIFACT= (empty) disables, or names another round;
     # the default is pinned to THIS round so a bench run can never
     # rewrite a prior round's file as commit noise (ISSUE 11 satellite).
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r17.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r18.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

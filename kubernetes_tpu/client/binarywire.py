@@ -73,6 +73,14 @@ class BinaryWireClient:
     def close(self) -> None:
         if self._sock is not None:
             try:
+                # shutdown() before close() delivers EOF to the server's
+                # reader NOW: without it, a worker process exiting with
+                # a live connection leaves the server's per-connection
+                # reader task parked in read() until teardown cancels it
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._sock.close()
             except OSError:
                 pass
@@ -185,6 +193,16 @@ class BinaryWireClient:
         if rverb != framing.SYNCED:
             raise WireError(f"unexpected verb 0x{rverb:02x} to SYNC")
         return framing.decode_synced(payload)
+
+    def relist(self) -> Tuple[List, List]:
+        """Bounded-stale snapshot pull (ISSUE 16): (nodes, bound pods)
+        from the shared cell's commit truth — a spawned scheduler
+        process hydrates its local evaluator from this, then trues up
+        with periodic re-pulls (its staleness window)."""
+        verb, payload = self._roundtrip(framing.RELIST)
+        if verb != framing.RELIST_RESULT:
+            raise WireError(f"unexpected verb 0x{verb:02x} to RELIST")
+        return framing.decode_relist_result(payload)
 
     def metrics(self) -> str:
         verb, payload = self._roundtrip(framing.METRICS)

@@ -110,11 +110,25 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
                 flow_anchor.setdefault(e["wave"], []).append(
                     (TID_HOST, us(e["t"])))
         elif kind == "fence_requeue":
-            out.append({"ph": "i", "pid": PID, "tid": TID_FENCE, "s": "t",
-                        "name": f"fence-requeue w{e['wave']}",
-                        "ts": us(e["t"]),
-                        "args": {"conflicts": e["a"],
-                                 "liveness": e["b"]}})
+            if e["wave"] < 0:
+                # wire fence conflict (ISSUE 16): no wave owns it — a
+                # remote scheduler process raced the bind fence and
+                # lost; b carries the typed reason code
+                from kubernetes_tpu.observability import podtrace as pt
+                rn = pt.REASON_NAMES[e["b"]] \
+                    if 0 <= e["b"] < len(pt.REASON_NAMES) else str(e["b"])
+                out.append({"ph": "i", "pid": PID, "tid": TID_FENCE,
+                            "s": "t", "name": f"fence-conflict:{rn}",
+                            "ts": us(e["t"]),
+                            "args": {"conflicts": e["a"],
+                                     "reason": rn}})
+            else:
+                out.append({"ph": "i", "pid": PID, "tid": TID_FENCE,
+                            "s": "t",
+                            "name": f"fence-requeue w{e['wave']}",
+                            "ts": us(e["t"]),
+                            "args": {"conflicts": e["a"],
+                                     "liveness": e["b"]}})
         elif kind == "patch":
             out.append({"ph": "i", "pid": PID, "tid": TID_FENCE, "s": "t",
                         "name": "patch", "ts": us(e["t"]),
@@ -182,6 +196,57 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
 
 # pod-exemplar lane tids start far above the fixed lanes
 TID_POD_BASE = 16
+
+# scheduler-process lane tids: above the pod lanes (a trace with both
+# keeps 240 pod exemplars before the ranges could meet)
+TID_PROC_BASE = 256
+
+
+def add_process_lanes(trace: Dict, workers: List[Dict],
+                      base_tid: int = TID_PROC_BASE,
+                      t_base: Optional[float] = None) -> Dict:
+    """Append one lane per scheduler PROCESS (ISSUE 16) to a built
+    trace: a ``run_process_fleet`` worker result renders its binds and
+    relists as spans and its fence conflicts as instant markers.
+
+    ``t_base`` is the server RING's time origin (min event t of the
+    main lanes): worker event stamps are CLOCK_MONOTONIC, which is
+    system-wide on Linux, so with the ring's t_base each process lane
+    aligns with the fence-conflict instants the shared cell recorded
+    for it. Without it the lanes align against the earliest worker
+    event (self-consistent across processes, but not ring-aligned).
+    Returns the trace for chaining."""
+    out = trace["traceEvents"]
+    if t_base is None:
+        t_base = min((ev["t"] for w in workers
+                      for ev in w.get("events", ())), default=0.0)
+    for lane, w in enumerate(workers):
+        tid = base_tid + lane
+        wid = w.get("worker", lane)
+        c = w.get("counts", {})
+        out.append({"ph": "M", "pid": PID, "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": f"sched-proc {wid} "
+                                     f"({c.get('binds', 0)} binds, "
+                                     f"{c.get('conflicts', 0)} "
+                                     f"conflicts)"}})
+        for ev in w.get("events", ()):
+            ts = round((ev["t"] - t_base) * 1e6, 1)
+            if ev["kind"] == "conflict":
+                out.append({"ph": "i", "pid": PID, "tid": tid, "s": "t",
+                            "name": "fence-conflict:"
+                                    + ev.get("reason", "?"),
+                            "ts": ts,
+                            "args": {k: v for k, v in ev.items()
+                                     if k not in ("kind", "t", "dur")}})
+            else:  # bind / relist: work spans on the process timeline
+                out.append({"ph": "X", "pid": PID, "tid": tid,
+                            "name": ev["kind"], "ts": ts,
+                            "dur": max(round(ev.get("dur", 0.0) * 1e6,
+                                             1), 0.1),
+                            "args": {k: v for k, v in ev.items()
+                                     if k not in ("kind", "t", "dur")}})
+    return trace
 
 
 def add_pod_lanes(trace: Dict, exemplars: List[Dict],
@@ -299,5 +364,5 @@ def overlap_seconds(events: List[Dict]) -> float:
     return total
 
 
-__all__ = ["add_pod_lanes", "build_chrome_trace", "export_chrome_trace",
-           "overlap_seconds"]
+__all__ = ["add_pod_lanes", "add_process_lanes", "build_chrome_trace",
+           "export_chrome_trace", "overlap_seconds"]

@@ -113,9 +113,16 @@ REASON_AFFINITY = 1
 REASON_LIVENESS = 2
 REASON_GANG = 3
 REASON_STALE = 4
+# double_claim (ISSUE 16): the pod itself is already claimed — another
+# scheduler process committed it through the shared cell's fence. Only
+# the WIRE fence can attribute this reason (the wave engine owns its
+# pods exclusively); it shares this vocabulary so the wire's typed
+# bind_conflict_reason_* counters partition with the same names as the
+# engine's fence_reason_* requeues.
+REASON_DOUBLE_CLAIM = 5
 
 REASON_NAMES = ("capacity", "affinity", "liveness", "gang",
-                "stale_encoding")
+                "stale_encoding", "double_claim")
 
 # wire-hop codes
 WIRE_HTTP = 0
@@ -532,7 +539,8 @@ __all__ = ["BOUND", "CREATED", "ENQUEUED", "EVICTED", "FENCE_REQUEUED",
            "GANG_GATED", "HARVESTED", "HOP_BIND", "HOP_FILTER",
            "HOP_NAMES", "KIND_NAMES", "PHASE_NAMES", "POPPED",
            "PREEMPT_VICTIM", "PodTracer", "REASON_AFFINITY",
-           "REASON_CAPACITY", "REASON_GANG", "REASON_LIVENESS",
+           "REASON_CAPACITY", "REASON_DOUBLE_CLAIM", "REASON_GANG",
+           "REASON_LIVENESS",
            "REASON_NAMES", "REASON_STALE", "TRACER", "WAVE_DISPATCHED",
            "WIRE_BINARY", "WIRE_EMBEDDED", "WIRE_HOP", "WIRE_HTTP",
            "WIRE_NAMES", "decompose", "phase_of"]

@@ -39,6 +39,11 @@ HEADLINE_METRICS: Tuple[Tuple[str, str, Optional[str]], ...] = (
     ("multi_frontend_pods_s", "fleet inproc/s", "up"),
     ("multi_frontend_binwire_pods_s", "fleet binwire/s", "up"),
     ("churn_vs_quiet", "churn/quiet", "up"),
+    # ISSUE 16: aggregate scheduleOnes/s of the M-process fleet (the
+    # multiproc_N scenarios) — absent before r18, the gate tolerates
+    # missing history and starts enforcing from the first round it
+    # appears in
+    ("multiproc_pods_s", "multiproc agg/s", "up"),
     ("telemetry_overhead_pct", "recorder ovh %", None),
     ("podtrace_overhead_pct", "podtrace ovh %", None),
 )

@@ -134,6 +134,16 @@ class AsyncBinaryServer:
         self._pod_cache: "OrderedDict[bytes, object]" = OrderedDict()
         self._pod_cache_lock = threading.Lock()
         self.pod_cache_max = 8192
+        # live per-connection reader tasks (loop-thread-only, like the
+        # pend lists): teardown() cancels these explicitly — loop.stop()
+        # alone strands them pending forever, which leaks a task (and
+        # its reader/writer transports) per worker process that ever
+        # connected (ISSUE 16 satellite fix)
+        self._conn_tasks: set = set()
+        # observable leak count: how many connection tasks were still
+        # alive (and had to be cancelled) at teardown — tests assert 0
+        # after a clean client close, and that stop() drains stragglers
+        self.cancelled_conn_tasks = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -191,6 +201,16 @@ class AsyncBinaryServer:
             # blocking client sits in recv() for its full timeout)
             await asyncio.sleep(0)
             await asyncio.sleep(0)
+            # cancel surviving connection reader tasks — without this,
+            # loop.stop() leaves every still-connected client's _client
+            # task pending forever (the reader-task leak): the task, its
+            # transports and its buffers outlive the server object
+            stragglers = [t for t in self._conn_tasks if not t.done()]
+            self.cancelled_conn_tasks = len(stragglers)
+            for t in stragglers:
+                t.cancel()
+            if stragglers:
+                await asyncio.gather(*stragglers, return_exceptions=True)
             loop.stop()
 
         asyncio.run_coroutine_threadsafe(teardown(), loop)
@@ -240,6 +260,9 @@ class AsyncBinaryServer:
 
     async def _client(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
         dec = framing.FrameDecoder(self.max_frame)
         try:
             while True:
@@ -266,6 +289,8 @@ class AsyncBinaryServer:
             # an unexpected escape must never take the accept loop down
             self._count("wire_conn_errors")
         finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
             try:
                 writer.close()
             except Exception:
@@ -359,6 +384,15 @@ class AsyncBinaryServer:
             text = await loop.run_in_executor(self._pool,
                                               self.service.metrics_text)
             return framing.METRICS_TEXT, framing.encode_metrics_text(text)
+        if verb == framing.RELIST:
+            # bounded-stale snapshot pull (ISSUE 16): a freshly spawned
+            # scheduler process hydrates its local cache from store
+            # truth in one round trip. The backend walk takes the
+            # backend lock — off the event loop like every service touch
+            nodes, pods = await loop.run_in_executor(
+                self._pool, self.service.relist)
+            return (framing.RELIST_RESULT,
+                    framing.encode_relist_result(nodes, pods))
         if verb == framing.STATS:
             # live introspection (ISSUE 13): the registry snapshot takes
             # per-source locks — off the event loop like every other

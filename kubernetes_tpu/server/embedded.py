@@ -33,6 +33,7 @@ wire is measured AGAINST (acceptance: within 2x over the wire).
 from __future__ import annotations
 
 import random
+import re
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -165,6 +166,14 @@ class VerdictService:
     def sync_pods(self, pods) -> int:
         self.backend.sync_pods(pods)
         return len(pods)
+
+    def relist(self):
+        """``(nodes, bound_pods)`` — the cell-truth snapshot a scheduler
+        process pulls to refresh ITS OWN bounded-stale cache (ISSUE 16;
+        extender.list_state docstring). Served identically over the
+        binary RELIST verb; the level-triggered re-list half of the
+        reference's watch/relist discipline."""
+        return self.backend.list_state()
 
     def metrics_text(self) -> str:
         return self.backend.metrics_text()
@@ -307,6 +316,13 @@ class EmbeddedVerdictAPI(VerdictService):
                             trace_ctx=trace_ctx)
             if res.ok:
                 return node, attempt + 1
+            if res.kind == "conflict" and "double-claim" in res.error:
+                # another scheduler process owns this pod (ISSUE 16):
+                # converge on ITS placement instead of retrying into the
+                # same typed refusal forever — store is truth, the same
+                # discipline as the "already assigned" heal below
+                m = re.search(r"already claimed on (\S+)", res.error)
+                return (m.group(1) if m else node), attempt + 1
             if res.retryable:
                 time.sleep(res.retry_after_s * rng.uniform(0.5, 1.5))
                 continue

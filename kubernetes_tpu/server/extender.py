@@ -939,7 +939,7 @@ class TPUExtenderBackend:
         scores, _gen = self.prioritize_verdict(pod, node_names)
         return scores
 
-    def _bind_fence(self, pod: Pod, node: str) -> Optional[str]:
+    def _bind_fence(self, pod: Pod, node: str):
         """Single-commit mirror of the engine's harvest fence (ISSUE 9):
         re-validate capacity / pod count / host ports / liveness — and,
         when affinity is in play, the full topology verdict via a FRESH
@@ -947,27 +947,35 @@ class TPUExtenderBackend:
         truth. This is the Omega transaction re-validator at the wire:
         verdicts may be stale (stale_window_s), commits never are. Called
         with the lock held, BEFORE the assume. Returns the typed conflict
-        reason, or None to admit."""
+        as ``(reason_code, message)`` — reason_code indexes
+        podtrace.REASON_NAMES, the SAME vocabulary the wave engine's
+        fence_reason_* requeues use (ISSUE 16: the per-reason
+        bind_conflict counters partition the total with names the
+        existing requeue attribution already established) — or None to
+        admit."""
+        from kubernetes_tpu.observability import podtrace
         from kubernetes_tpu.ops import oracle
         from kubernetes_tpu.ops.affinity import _has_affinity
         infos = self._infos if self._infos is not None \
             else self.cache.node_infos()
         info = infos.get(node)
         if info is None:
-            return f"node {node} unknown"
+            return podtrace.REASON_LIVENESS, f"node {node} unknown"
         if info.node is None:
-            return f"node {node} gone"
+            return podtrace.REASON_LIVENESS, f"node {node} gone"
         if info.node.unschedulable:
-            return f"node {node} cordoned"
+            return podtrace.REASON_LIVENESS, f"node {node} cordoned"
         if not oracle.check_node_condition(info.node):
-            return f"node {node} not ready"
+            return podtrace.REASON_LIVENESS, f"node {node} not ready"
         # NodeInfo.requested includes every assume committed so far —
         # exactly the occupancy the harvest fence's prefix math re-checks
         ok, fails = oracle.pod_fits_resources(pod, info)
         if not ok:
-            return f"insufficient capacity on {node}: {','.join(fails)}"
+            return (podtrace.REASON_CAPACITY,
+                    f"insufficient capacity on {node}: {','.join(fails)}")
         if not oracle.pod_fits_host_ports(pod, info):
-            return f"host port conflict on {node}"
+            return (podtrace.REASON_CAPACITY,
+                    f"host port conflict on {node}")
         if _has_affinity(pod) or not self.eval_cache.cluster_aff_free:
             # topology mirror: an affinity verdict can be invalidated by
             # ANY foreign commit — force the deferred hint refresh past
@@ -977,8 +985,48 @@ class TPUExtenderBackend:
             snap, m, _s = self._eval(pod, None)
             i = snap.node_index.get(node, -1)
             if i < 0 or not m[i]:
-                return f"topology re-validation failed on {node}"
+                return (podtrace.REASON_AFFINITY,
+                        f"topology re-validation failed on {node}")
         return None
+
+    def _fence_conflict(self, code: int, reason: str,
+                        idem_key: Optional[str]):
+        """One typed fence refusal (lock held): fold the total, attribute
+        the per-reason counter — the partition invariant
+        sum(bind_conflict_reason_*) == bind_conflicts is test-pinned on
+        every transport — stamp a ring instant for the perfetto fence
+        lane (wave=-1 marks a WIRE conflict; b carries the reason code),
+        and answer the retryable CONFLICT."""
+        import time as _time
+
+        from kubernetes_tpu.observability import podtrace
+        from kubernetes_tpu.observability.recorder import RECORDER
+        from kubernetes_tpu.observability import recorder as flightrec
+        self._count("bind_conflicts")
+        self._count("bind_conflict_reason_" + podtrace.REASON_NAMES[code])
+        if RECORDER.enabled:
+            RECORDER.record(flightrec.FENCE_REQUEUE, wave=-1,
+                            t0=_time.monotonic(), a=1, b=code)
+        err = f"CONFLICT: {reason}"
+        if idem_key:
+            self.ledger.finish(idem_key, "conflict", err)
+        return err, "conflict", self._retry_jitter()
+
+    def list_state(self):
+        """``(nodes, bound_pods)`` — cell truth for a relisting scheduler
+        process (ISSUE 16): every live node plus every pod the cache
+        currently charges to a node (assumed AND confirmed — exactly the
+        occupancy the bind fence validates commits against). This is the
+        RELIST half of a per-process watch/relist snapshot refresh: a
+        worker process syncs this into ITS OWN backend and schedules
+        against bounded-stale local truth while commits race through the
+        shared fence."""
+        with self._lock:
+            infos = self._infos if self._infos is not None \
+                else self.cache.node_infos()
+            nodes = [i.node for i in infos.values() if i.node is not None]
+            pods = [p for i in infos.values() for p in list(i.pods)]
+            return nodes, pods
 
     def bind(self, pod_name, pod_namespace, pod_uid, node):
         """Legacy single-scheduler wire shape: error string, "" = bound."""
@@ -1077,18 +1125,30 @@ class TPUExtenderBackend:
             if base is None:
                 base = Pod(name=pod_name, namespace=pod_namespace,
                            uid=pod_uid)
+            # DOUBLE-CLAIM (ISSUE 16): a pod already charged to a
+            # DIFFERENT node was committed by another scheduler racing
+            # this cell — refuse typed BEFORE the capacity fence (and
+            # regardless of the generation skip below: a current-gen
+            # verdict attests the snapshot, not pod ownership). Same-node
+            # re-binds fall through untouched: that is the client-retry-
+            # of-a-landed-bind shape the assume's KeyError tolerance and
+            # the store's idempotent refusal already heal.
+            from kubernetes_tpu.observability import podtrace
+            claimed = self.cache.claimed_node(key)
+            if claimed is not None and claimed != node:
+                return self._fence_conflict(
+                    podtrace.REASON_DOUBLE_CLAIM,
+                    f"double-claim: pod {key} already claimed on "
+                    f"{claimed}", idem_key)
             # FENCE (optimistic concurrency): skip only when the verdict's
             # generation is provably current — nothing was committed since
             # the snapshot it read, so its own /filter pass IS the fence
             if snapshot_gen is None or snapshot_gen != self.commit_gen:
                 self._refresh_warm()  # liveness truth for _infos
-                reason = self._bind_fence(base, node)
-                if reason is not None:
-                    self._count("bind_conflicts")
-                    err = f"CONFLICT: {reason}"
-                    if idem_key:
-                        self.ledger.finish(idem_key, "conflict", err)
-                    return err, "conflict", self._retry_jitter()
+                fenced = self._bind_fence(base, node)
+                if fenced is not None:
+                    return self._fence_conflict(fenced[0], fenced[1],
+                                                idem_key)
             else:
                 self._count("bind_fence_skipped")
             pod = dataclasses.replace(base, node_name=node)

@@ -51,6 +51,13 @@ Verbs — requests:
                 snapshot plus the flight recorder's last_n events as a
                 JSON blob — identical content to HTTP /debug/vars +
                 /debug/trace and the embedded debug_snapshot.
+    RELIST      cell-truth pull (ISSUE 16): no payload ->
+                RELIST_RESULT carrying two codec-tagged item blobs —
+                live nodes, then every pod the shared cache charges to a
+                node — so a scheduler PROCESS refreshes its own
+                bounded-stale snapshot without the server pushing state
+                (the level-triggered re-list of the watch/relist
+                discipline, over the wire).
 
 Verbs — responses:
 
@@ -95,6 +102,10 @@ PING = 0x06
 # embedded debug_snapshot — the wire twin of Borg's per-task
 # introspection endpoints
 STATS = 0x07
+# cell-truth pull (ISSUE 16): the inverse of the SYNC push — a worker
+# process relists (nodes, bound pods) from the shared cell to refresh
+# its own scheduler's bounded-stale snapshot
+RELIST = 0x08
 
 VERDICT = 0x81
 BIND_RESULT = 0x82
@@ -105,6 +116,7 @@ SYNCED = 0x87
 METRICS_TEXT = 0x88
 PONG = 0x89
 STATS_RESULT = 0x8A
+RELIST_RESULT = 0x8B
 
 FLAG_COMPACT = 0x01
 # trace context on FILTER/BIND (ISSUE 15): when set, the payload is
@@ -541,22 +553,39 @@ def decode_stats_result(payload: bytes) -> Dict:
         raise FrameError(f"bad STATS payload: {e}") from e
 
 
+def encode_relist_result(nodes, pods) -> bytes:
+    """RELIST_RESULT: two codec-tagged item blobs — live nodes, then the
+    bound pods the shared cache charges to them (ISSUE 16). Each rides
+    its own length prefix so the reader never guesses a boundary."""
+    return bytes(Writer().blob(encode_items_blob(nodes, "nodes"))
+                 .blob(encode_items_blob(pods, "pods")).buf)
+
+
+def decode_relist_result(payload: bytes):
+    r = Reader(payload)
+    return (decode_items_blob(r.blob(), "nodes"),
+            decode_items_blob(r.blob(), "pods"))
+
+
 __all__ = [
     "BIND", "BIND_KINDS", "BIND_RESULT", "CODEC_JSON", "CODEC_PROTO",
     "DEADLINE", "ERROR", "FILTER", "FLAG_COMPACT", "FLAG_TRACE",
     "FrameDecoder",
     "FrameError", "HEADER_SIZE", "MAX_FRAME", "METRICS", "METRICS_TEXT",
-    "OVERLOADED", "PING", "PONG", "Reader", "STATS", "STATS_RESULT",
+    "OVERLOADED", "PING", "PONG", "RELIST", "RELIST_RESULT", "Reader",
+    "STATS", "STATS_RESULT",
     "SYNCED", "SYNC_NODES", "SYNC_PODS", "VERDICT", "Writer",
     "decode_bind_request", "decode_bind_request_lazy",
     "decode_bind_result", "decode_error", "decode_filter_request",
     "decode_filter_request_lazy", "decode_items_blob",
     "decode_metrics_text", "decode_overloaded", "decode_pod_blob",
+    "decode_relist_result",
     "decode_stats_request", "decode_stats_result", "decode_synced",
     "decode_verdict", "encode_bind_request", "encode_bind_result",
     "encode_error", "encode_filter_request", "encode_frame",
     "encode_items_blob", "encode_metrics_text", "encode_overloaded",
-    "encode_pod_blob", "encode_stats_request", "encode_stats_result",
+    "encode_pod_blob", "encode_relist_result", "encode_stats_request",
+    "encode_stats_result",
     "encode_sync_request", "encode_synced", "encode_verdict",
     "unwrap_trace", "wrap_trace",
 ]

@@ -293,6 +293,18 @@ class SchedulerCache:
             st = self._pod_states.get(pod_key)
             return bool(st and st.assumed)
 
+    def claimed_node(self, pod_key: str) -> Optional[str]:
+        """The node this pod currently occupies in cache truth (assumed
+        OR confirmed), or None — the bind fence's double-claim probe
+        (ISSUE 16): with N independent schedulers racing one cell, a
+        commit for a pod some other process already placed must fence
+        out as a typed conflict instead of reaching the store."""
+        with self._lock:
+            st = self._pod_states.get(pod_key)
+            if st is None:
+                return None
+            return st.pod.node_name or None
+
     def pod_count(self) -> int:
         with self._lock:
             return len(self._pod_states)
