@@ -69,6 +69,15 @@ class SchedulingQueue:
         self._queued_at: Dict[str, float] = {}  # first-admission stamp
         self.aging_threshold_s = self.AGING_THRESHOLD_S
         self.backoff = PodBackoff(now=now)
+        # fast tier (ISSUE 17): pods the classifier routes latency-critical
+        # pop via pop_fast() ahead of any quantum. None (default) keeps
+        # the queue single-tier — BIT-identical to the pre-fast-lane
+        # behavior, pinned by the A/B test. The bulk tier's r14
+        # aging/starvation guard is untouched: fast pods never enter the
+        # priority sort, bulk pods never wait behind the fast tier's pop
+        # (the streaming loop budgets fast pops per step).
+        self._fast: List[Pod] = []
+        self.fast_classifier: Optional[Callable[[Pod], bool]] = None
 
     def add(self, pod: Pod) -> None:
         with self._lock:
@@ -77,7 +86,11 @@ class SchedulingQueue:
                 return
             self._queued_at.setdefault(key, self._now())
             self._keys[key] = pod
-            self._fifo.append(pod)
+            cls = self.fast_classifier
+            if cls is not None and cls(pod):
+                self._fast.append(pod)
+            else:
+                self._fifo.append(pod)
             self._lock.notify_all()
         if TRACER.enabled:
             # pod-level black box (ISSUE 15): the queue-admission stamp
@@ -93,6 +106,8 @@ class SchedulingQueue:
         with self._lock:
             keys = self._keys
             fifo = self._fifo
+            fast = self._fast
+            cls = self.fast_classifier
             now = self._now()
             stamps = self._queued_at
             if TRACER.enabled:
@@ -103,7 +118,35 @@ class SchedulingQueue:
                     continue
                 stamps.setdefault(key, now)
                 keys[key] = pod
-                fifo.append(pod)
+                if cls is not None and cls(pod):
+                    fast.append(pod)
+                else:
+                    fifo.append(pod)
+                if admitted is not None:
+                    admitted.append(key)
+            self._lock.notify_all()
+        if admitted:
+            TRACER.begin_batch(admitted)
+
+    def add_bulk(self, pods: List[Pod]) -> None:
+        """Admit straight to the BULK tier, bypassing the fast
+        classifier — the fast lane's fallback path (ISSUE 17): a pod
+        whose bounded retries ran out must ride the wave path next, not
+        re-route into the fast tier forever."""
+        admitted = None
+        with self._lock:
+            keys = self._keys
+            now = self._now()
+            stamps = self._queued_at
+            if TRACER.enabled:
+                admitted = []
+            for pod in pods:
+                key = pod.key()
+                if key in keys:
+                    continue
+                stamps.setdefault(key, now)
+                keys[key] = pod
+                self._fifo.append(pod)
                 if admitted is not None:
                     admitted.append(key)
             self._lock.notify_all()
@@ -132,6 +175,9 @@ class SchedulingQueue:
             self._queued_at.pop(pod_key, None)  # terminal: stamp clears
             if self._keys.pop(pod_key, None) is not None:
                 self._fifo = [p for p in self._fifo if p.key() != pod_key]
+                if self._fast:
+                    self._fast = [p for p in self._fast
+                                  if p.key() != pod_key]
                 self._deferred = [(t, s, p) for (t, s, p) in self._deferred
                                   if p.key() != pod_key]
                 heapq.heapify(self._deferred)
@@ -151,6 +197,9 @@ class SchedulingQueue:
             for k in present:
                 del self._keys[k]
             self._fifo = [p for p in self._fifo if p.key() not in present]
+            if self._fast:
+                self._fast = [p for p in self._fast
+                              if p.key() not in present]
             self._deferred = [(t, s, p) for (t, s, p) in self._deferred
                               if p.key() not in present]
             heapq.heapify(self._deferred)
@@ -162,6 +211,12 @@ class SchedulingQueue:
         with self._lock:
             while True:
                 self._promote_ready()
+                if self._fast and not self._fifo:
+                    # a fast-tier arrival must not sit out a bulk
+                    # blocking wait: return empty so the streaming loop
+                    # pumps the fast lane now (with no classifier set
+                    # _fast is always empty — this branch never fires)
+                    return []
                 if self._fifo:
                     if features.enabled("PodPriority"):
                         # priority queue semantics (1.8's podqueue
@@ -202,6 +257,28 @@ class SchedulingQueue:
                     timeout = min(timeout, max(self._deferred[0][0] - self._now(), 0.01))
                 self._lock.wait(timeout)
 
+    def pop_fast(self, max_n: int = 0) -> List[Pod]:
+        """Drain up to max_n (0 = all) fast-tier pods NOW — no blocking,
+        no quantum, no priority sort (the fast tier is FIFO: every pod
+        in it is equally latency-critical and k-sampling spreads the
+        load server-side, Sparrow's discipline)."""
+        with self._lock:
+            if not self._fast:
+                return []
+            n = len(self._fast) if max_n == 0 else min(max_n,
+                                                       len(self._fast))
+            out = self._fast[:n]
+            self._fast = self._fast[n:]
+            for p in out:
+                self._keys.pop(p.key(), None)
+            if TRACER.enabled:
+                TRACER.pop_batch([p.key() for p in out])
+            return out
+
+    def fast_count(self) -> int:
+        with self._lock:
+            return len(self._fast)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._keys)
@@ -209,7 +286,7 @@ class SchedulingQueue:
     def ready_count(self) -> int:
         with self._lock:
             self._promote_ready()
-            return len(self._fifo)
+            return len(self._fifo) + len(self._fast)
 
     def _promote_ready(self) -> None:
         now = self._now()

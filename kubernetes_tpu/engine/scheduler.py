@@ -356,6 +356,65 @@ class Scheduler:
             raise
         return len(events)
 
+    def sync_pods_sip(self) -> int:
+        """Drain ONLY the leading run of simple pod events — fresh
+        pending ADDs and bind confirmations — from the watch stream: the
+        fast lane's poll-during-harvest sip (ISSUE 17). While the
+        streaming loop blocks on a wave's device array, this lets newly
+        created latency-critical pods reach the queue WITHOUT running a
+        full sync(): the first event the columnar fast paths can't
+        absorb (node, volume, workload, deletes, spec mods) stops the
+        sip with the cursor parked BEFORE it, so the next full sync()
+        applies it in order — a sip can therefore never flush the
+        pipeline or reorder harvests. Idempotency mirrors sync(): the
+        cursor only advances after the flush lands, and re-applying a
+        flushed run is safe."""
+        if not self._started or self._gang_waiting:
+            return 0
+        try:
+            events = self.api.watch_since(
+                ("Pod", "Node") + self.WORKLOAD_KINDS + self.VOLUME_KINDS,
+                self._rv, timeout=0.0)
+        except TooOldResourceVersion:
+            return 0  # the next full sync() owns the relist
+        if not events:
+            return 0
+        confirms: List[Pod] = []
+        fresh: List[Pod] = []
+        buffered: Dict[str, Pod] = {}
+        pods_map = self._pods
+        last_rv = self._rv
+        for ev in events:
+            if ev.kind != "Pod":
+                break
+            obj = ev.obj
+            if ev.type == "MODIFIED" and obj.node_name:
+                key = obj.key()
+                prev = buffered.get(key)
+                if prev is None:
+                    prev = pods_map.get(key)
+                if prev is not None and not prev.node_name:
+                    buffered[key] = obj
+                    confirms.append(obj)
+                    last_rv = ev.rv
+                    continue
+                break
+            if ev.type == "ADDED" and not obj.node_name \
+                    and self._responsible_for(obj):
+                buffered[obj.key()] = obj
+                fresh.append(obj)
+                last_rv = ev.rv
+                continue
+            break
+        applied = len(fresh) + len(confirms)
+        if not applied:
+            return 0
+        self._flush_fresh(fresh)
+        if confirms:
+            self._flush_confirms(confirms, buffered)
+        self._rv = last_rv  # advanced only past APPLIED events
+        return applied
+
     def _flush_fresh(self, fresh: List[Pod]) -> None:
         """Admit a run of fresh pending pods columnar: one bookkeeping
         pass, one queue lock (queue.add_many). Per-pod semantics identical
@@ -1054,17 +1113,27 @@ class Scheduler:
 
     def stream(self, budget_s: float = 0.25, min_quantum: int = 256,
                max_quantum: int = 16384, overlap: bool = True,
-               chunk: int = 0):
+               chunk: int = 0, fastlane=False):
         """The ALWAYS-ON loop (ISSUE 7): micro-waves admitted on a latency
         budget instead of fixed chunks — pop whatever is queued when the
         device frees up, bounded by an adaptive power-of-2 quantum so one
         admission can never make the next arrival wait past ``budget_s``.
         Same dataflow and fence as pipeline(); only the admission policy
         differs (engine/streaming.py docstring). ``chunk`` seeds the
-        initial quantum when given."""
+        initial quantum when given.
+
+        ``fastlane=True`` arms the Sparrow fast tier (ISSUE 17):
+        latency-critical pods bypass the micro-wave quantum through a
+        sampled [1, k] eval + late-bind fence (engine/fastlane.py). Pass
+        a FastLane instance instead of True to control k/retries/seed."""
+        fl = None
+        if fastlane:
+            from kubernetes_tpu.engine.fastlane import FastLane
+            fl = fastlane if not isinstance(fastlane, bool) \
+                else FastLane(self)
         return ScheduleLoop(self, chunk, overlap, budget_s=budget_s,
                             min_quantum=min_quantum,
-                            max_quantum=max_quantum)
+                            max_quantum=max_quantum, fastlane=fl)
 
     def run_until_drained(self, max_rounds: int = 10_000,
                           max_batch: int = 0,

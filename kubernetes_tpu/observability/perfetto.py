@@ -47,9 +47,11 @@ TID_HOST = 1
 TID_DEVICE = 2
 TID_FENCE = 3
 TID_PREEMPT = 4
+TID_FASTLANE = 5
 
 _THREADS = ((TID_HOST, "host"), (TID_DEVICE, "device"),
-            (TID_FENCE, "fence"), (TID_PREEMPT, "preempt"))
+            (TID_FENCE, "fence"), (TID_PREEMPT, "preempt"),
+            (TID_FASTLANE, "fastlane"))
 
 
 def build_chrome_trace(events: List[Dict]) -> Dict:
@@ -170,6 +172,17 @@ def build_chrome_trace(events: List[Dict]) -> Dict:
                         "ts": us(e["t"]),
                         "args": {"victims": e["a"],
                                  "lowest_priority": e["b"]}})
+        elif kind == "fastlane":
+            # one span per fast-lane pod, pop → bind-complete (ISSUE 17):
+            # the sub-10ms tier gets its own lane so its spans read
+            # against the micro-waves they threaded between; a is the
+            # attempts consumed, b the eval route (1 device, 0 host twin)
+            out.append({"ph": "X", "pid": PID, "tid": TID_FASTLANE,
+                        "name": "fast-bind",
+                        "ts": us(e["t"]), "dur": round(e["dur"] * 1e6, 1),
+                        "args": {"attempts": e["a"],
+                                 "eval": "device" if e["b"] else "host",
+                                 "span_ms": round(e["dur"] * 1e3, 3)}})
         elif kind == "slo_alert":
             out.append({"ph": "i", "pid": PID, "tid": TID_FENCE, "s": "p",
                         "name": "slo-alert-enter" if e["a"]

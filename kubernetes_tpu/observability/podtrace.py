@@ -99,10 +99,15 @@ PREEMPT_VICTIM = 7
 EVICTED = 8
 BOUND = 9
 WIRE_HOP = 10
+# fast-lane eval (ISSUE 17): the pod's sampled power-of-k scoring ran —
+# a carries the eval path (0=device [1,k] dispatch, 1=host twin), b the
+# attempt number (0 first try, >0 a fence-loss resample)
+FAST_DISPATCHED = 11
 
 KIND_NAMES = ("created", "enqueued", "popped", "wave_dispatched",
               "harvested", "fence_requeued", "gang_gated",
-              "preempt_victim", "evicted", "bound", "wire_hop")
+              "preempt_victim", "evicted", "bound", "wire_hop",
+              "fast_dispatched")
 
 # typed fence-requeue reasons (ISSUE 15 satellite): the one folded
 # "fence_requeued" count becomes attributable — capacity races vs
@@ -133,10 +138,13 @@ HOP_FILTER = 0
 HOP_BIND = 1
 HOP_NAMES = ("filter", "bind")
 
-# phase vocabulary of the critical-path decomposition (decompose())
+# phase vocabulary of the critical-path decomposition (decompose()).
+# fast_eval / fast_bind (ISSUE 17) decompose a fast-lane pod's span:
+# pop -> sampled eval, then eval -> bind-complete — the two halves of
+# the sub-10 ms budget, attributable separately
 PHASE_NAMES = ("queue_wait", "requeue_wait", "dispatch", "device",
                "bind_flush", "classic_round", "fence", "gang_wait",
-               "wire", "other")
+               "wire", "fast_eval", "fast_bind", "other")
 
 
 def phase_of(prev_k: int, k: int, requeued: bool) -> str:
@@ -149,11 +157,15 @@ def phase_of(prev_k: int, k: int, requeued: bool) -> str:
         return "requeue_wait" if requeued else "queue_wait"
     if k == WAVE_DISPATCHED:
         return "dispatch"
+    if k == FAST_DISPATCHED:
+        return "fast_eval"  # pop -> sampled [1,k] eval (ISSUE 17)
     if k == HARVESTED:
         return "device"
     if k == BOUND:
         if prev_k == HARVESTED:
             return "bind_flush"
+        if prev_k == FAST_DISPATCHED:
+            return "fast_bind"  # eval -> fence + bind-complete
         if prev_k == POPPED:
             return "classic_round"
         if prev_k == WIRE_HOP:
@@ -535,7 +547,8 @@ if os.environ.get("GRAFT_PODTRACE", "0") == "1":
     TRACER.enable()
 
 
-__all__ = ["BOUND", "CREATED", "ENQUEUED", "EVICTED", "FENCE_REQUEUED",
+__all__ = ["BOUND", "CREATED", "ENQUEUED", "EVICTED", "FAST_DISPATCHED",
+           "FENCE_REQUEUED",
            "GANG_GATED", "HARVESTED", "HOP_BIND", "HOP_FILTER",
            "HOP_NAMES", "KIND_NAMES", "PHASE_NAMES", "POPPED",
            "PREEMPT_VICTIM", "PodTracer", "REASON_AFFINITY",

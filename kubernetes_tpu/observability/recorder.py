@@ -64,6 +64,11 @@ Event kinds (the per-wave vocabulary of the pipelined engine):
                 (ISSUE 15). a=1 enter / 0 exit, b=fast-window burn rate
                 x100 at the flip — the page lands on the same timeline
                 as the waves that caused it.
+    FASTLANE    one fast-lane pod bound through the sampled-eval path
+                (ISSUE 17). wave=-1 (the fast lane rides between
+                waves), a=attempts used (1 = first sample won the
+                fence), b=1 device eval / 0 host twin; dur=pop ->
+                bind-complete — the sub-10 ms span itself.
 """
 
 from __future__ import annotations
@@ -89,11 +94,12 @@ PREEMPT_COMMIT = 8
 PREEMPT_ROLLBACK = 9
 VICTIM_REQUEUE = 10
 SLO_ALERT = 11
+FASTLANE = 12
 
 KIND_NAMES = ("dispatch", "harvest", "fence_requeue", "patch",
               "bind_flush", "degraded", "churn_op", "preempt_propose",
               "preempt_commit", "preempt_rollback", "victim_requeue",
-              "slo_alert")
+              "slo_alert", "fastlane")
 
 # churn-op kind -> small int for the CHURN_OP event's `a` field
 CHURN_OP_CODES = {"kill": 0, "respawn": 1, "flap_down": 2, "flap_up": 3,
@@ -210,7 +216,8 @@ if os.environ.get("GRAFT_FLIGHT_RECORDER", "0") == "1":
 
 
 __all__ = ["BIND_FLUSH", "CHURN_OP", "CHURN_OP_CODES", "CHURN_OP_NAMES",
-           "DEGRADED", "DISPATCH", "FENCE_REQUEUE", "FlightRecorder",
+           "DEGRADED", "DISPATCH", "FASTLANE", "FENCE_REQUEUE",
+           "FlightRecorder",
            "HARVEST", "KIND_NAMES", "PATCH", "PREEMPT_COMMIT",
            "PREEMPT_PROPOSE", "PREEMPT_ROLLBACK", "RECORDER",
            "SLO_ALERT", "VICTIM_REQUEUE"]

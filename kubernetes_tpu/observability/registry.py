@@ -43,7 +43,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.observability.podtrace import TRACER
 from kubernetes_tpu.observability.recorder import RECORDER
-from kubernetes_tpu.observability.slo import SLO
+from kubernetes_tpu.observability.slo import SLO, SLO_FAST
 from kubernetes_tpu.utils.trace import COUNTERS
 
 
@@ -52,7 +52,7 @@ class TelemetryRegistry:
     counter dicts, and gauge providers."""
 
     def __init__(self, spans=COUNTERS, recorder=RECORDER, tracer=TRACER,
-                 slo=SLO):
+                 slo=SLO, slo_fast=SLO_FAST):
         self._spans = spans
         self._recorder = recorder
         # pod-level black box (ISSUE 15): the tracer's bound accounting
@@ -61,6 +61,9 @@ class TelemetryRegistry:
         # transport
         self._tracer = tracer
         self._slo = slo
+        # per-tier objective (ISSUE 17): the fast lane's 10 ms SLO folds
+        # as slo.fast.* beside the bulk slo.* on every transport
+        self._slo_fast = slo_fast
         # keyed sources; insertion-ordered so renders are stable. The
         # registration lock guards the MAPS only (a ScheduleLoop swap
         # races a scrape's iteration — dict-changed-size mid-snapshot);
@@ -162,6 +165,8 @@ class TelemetryRegistry:
             out[f"podtrace.{k}"] = v
         for k, v in self._slo.snapshot().items():
             out[f"slo.{k}"] = v
+        for k, v in self._slo_fast.snapshot().items():
+            out[f"slo.fast.{k}"] = v
         return out
 
     # --------------------------------------------------------- Prometheus
@@ -215,6 +220,11 @@ class TelemetryRegistry:
             name = f"tpu_slo_{k}"
             kind = "counter" if k == "alerts_total" else "gauge"
             lines.append(f"# TYPE {name} {kind}\n{name} {slo[k]}")
+        slo_fast = self._slo_fast.snapshot()
+        for k in sorted(slo_fast):
+            name = f"tpu_slo_fast_{k}"
+            kind = "counter" if k == "alerts_total" else "gauge"
+            lines.append(f"# TYPE {name} {kind}\n{name} {slo_fast[k]}")
         return "\n".join(lines)
 
 

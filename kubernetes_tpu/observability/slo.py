@@ -212,8 +212,19 @@ class SLOMonitor:
 # paths guard on SLO.enabled — exact no-op off). GRAFT_SLO=1 arms at
 # import; bench.py arms it with the tracer for the podtrace A/B arm.
 SLO = SLOMonitor()
+
+# the fast tier's own objective (ISSUE 17): latency-critical pods are
+# operated against a 10 ms budget, not the bulk 250 ms — per-tier burn
+# rates so a bulk backlog can't hide a fast-lane regression (and vice
+# versa). Armed by the same GRAFT_SLO knob; folded as slo.fast.* and
+# served under "fast" in every /debug/slo payload.
+SLO_FAST = SLOMonitor(
+    budget_s=float(os.environ.get("GRAFT_SLO_FAST_BUDGET_MS", 10.0)) / 1e3,
+    target=float(os.environ.get("GRAFT_SLO_FAST_TARGET", 0.99)))
+
 if os.environ.get("GRAFT_SLO", "0") == "1":
     SLO.enable()
+    SLO_FAST.enable()
 
 
-__all__ = ["SLO", "SLOMonitor"]
+__all__ = ["SLO", "SLO_FAST", "SLOMonitor"]

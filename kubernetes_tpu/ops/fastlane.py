@@ -1,0 +1,159 @@
+"""Sampled power-of-k eval for the Sparrow fast lane (ISSUE 17).
+
+The bulk wave path amortizes its cost over thousands of pods: encoding
+build, vocab interning, a [P, N] fused eval. A latency-critical pod can't
+wait for any of that. This kernel is the whole device story of the fast
+lane: gather k sampled node rows out of the RESIDENT snapshot arrays
+(the same buffers `_nodes_on_device` keeps between waves — nothing is
+uploaded, nothing is re-encoded) and score the pod against exactly those
+k rows. One dispatch, one [1, k] problem, compiled once per (k, N, R)
+shape like the r10 ladder.
+
+Admission keeps the kernel tiny by construction: the fast lane only takes
+"simple" pods — no affinity, no selector, no tolerations, no host ports,
+no volumes, no extended resources (engine/fastlane.py gates this). That
+shrinks the predicate chain to resources + pod count + node conditions +
+an any-taint check (a toleration-free pod fails on ANY NoSchedule taint,
+so the intolerated×taint matmul degenerates to a row-sum), which is
+EXACT for the admitted population — and the late-bind fence re-validates
+the winner against live cache truth anyway, so a stale score costs a
+resample, never a wrong bind.
+
+``sample_eval_host`` is the same math in numpy over the HOST snapshot
+arrays. The fast lane uses it whenever a bulk wave is in flight: the CPU
+backend executes device programs FIFO per device, so even a microsecond
+[1, k] dispatch would queue behind the wave and pay its full latency.
+Device and host twins are A/B-pinned equal (tests/test_fastlane.py) so
+the routing choice is pure latency policy, never a semantics fork.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.state.snapshot import (
+    NUM_BASE_RESOURCES,
+    R_CPU,
+    R_MEM,
+    R_OVERLAY,
+    R_SCRATCH,
+)
+
+# node-side rows the sampled eval gathers — a strict subset of the
+# engine's resident _nodes_on_device buffers (scheduler_engine.py), so
+# the device path reads state that is already there
+FAST_NODE_KEYS = ("alloc", "requested", "pod_count", "allowed_pods",
+                  "schedulable", "valid", "mem_pressure", "disk_pressure",
+                  "taints_sched")
+
+# score floor for unfit rows: real scores are fractional headroom in
+# [0, 1] (fit guarantees spare >= 0), so -1 can never win argmax
+_UNFIT = -1.0
+
+
+def _sample_eval(idx, req, zero_req, best_effort, nodes):
+    """Score one pod against k sampled nodes -> int32 [3].
+
+    idx int32 [k] node row indices; req int32 [R] quantized request row
+    (resource_row semantics); zero_req / best_effort bool scalars; nodes
+    = the FAST_NODE_KEYS dict of resident arrays. Returns
+    [winner_local_index, fit_count, best_score * 1e6] — winner is
+    meaningful only when fit_count > 0.
+    """
+    a = jnp.take(nodes["alloc"], idx, axis=0)          # [k,R]
+    r = jnp.take(nodes["requested"], idx, axis=0)      # [k,R]
+    total = req[None, :] + r
+    ok = total <= a
+    # cpu/mem/gpu + extended: plain elementwise (resources_fit layout)
+    plain = jnp.concatenate(
+        [ok[:, :R_SCRATCH], ok[:, NUM_BASE_RESOURCES:]], axis=-1
+    ).all(axis=-1)
+    # storage special-case (predicates.go:590-604): no overlay capacity
+    # means overlay requests fall back onto scratch space
+    alloc_s = a[:, R_SCRATCH]
+    alloc_o = a[:, R_OVERLAY]
+    pod_s = req[R_SCRATCH]
+    pod_o = req[R_OVERLAY]
+    node_s = r[:, R_SCRATCH]
+    node_o = r[:, R_OVERLAY]
+    no_overlay = alloc_o == 0
+    scratch_ok = jnp.where(
+        no_overlay,
+        pod_s + pod_o + node_s + node_o <= alloc_s,
+        pod_s + node_s <= alloc_s,
+    )
+    overlay_ok = no_overlay | (pod_o + node_o <= alloc_o)
+    res_ok = (plain & scratch_ok & overlay_ok) | zero_req
+    count_ok = (jnp.take(nodes["pod_count"], idx) + 1
+                <= jnp.take(nodes["allowed_pods"], idx))
+    cond_ok = jnp.take(nodes["schedulable"], idx) & jnp.take(nodes["valid"], idx)
+    mem_ok = (~best_effort) | (~jnp.take(nodes["mem_pressure"], idx))
+    disk_ok = ~jnp.take(nodes["disk_pressure"], idx)
+    # toleration-free admission: ANY NoSchedule/NoExecute taint fails
+    taint_free = jnp.take(nodes["taints_sched"], idx, axis=0).astype(
+        jnp.int32).sum(axis=-1) == 0
+    fit = res_ok & count_ok & cond_ok & mem_ok & disk_ok & taint_free
+    # power-of-k choice: the least-loaded fit sample by worst-dimension
+    # fractional headroom AFTER placement
+    spare_c = (a[:, R_CPU] - total[:, R_CPU]).astype(jnp.float32)
+    spare_m = (a[:, R_MEM] - total[:, R_MEM]).astype(jnp.float32)
+    cap_c = jnp.maximum(a[:, R_CPU], 1).astype(jnp.float32)
+    cap_m = jnp.maximum(a[:, R_MEM], 1).astype(jnp.float32)
+    score = jnp.where(fit, jnp.minimum(spare_c / cap_c, spare_m / cap_m),
+                      _UNFIT)
+    win = jnp.argmax(score).astype(jnp.int32)
+    return jnp.stack([win, fit.astype(jnp.int32).sum(),
+                      (jnp.max(score) * 1e6).astype(jnp.int32)])
+
+
+sample_eval = jax.jit(_sample_eval)
+
+
+def sample_eval_host(idx, req, zero_req, best_effort, nodes) -> np.ndarray:
+    """Numpy twin of ``sample_eval`` over the HOST snapshot arrays —
+    bit-identical verdicts by test (same inputs -> same [3] output), used
+    when a wave owns the device (FIFO execution would stall the fast pod
+    behind it) and for resample retries."""
+    idx = np.asarray(idx)
+    a = nodes["alloc"][idx]
+    r = nodes["requested"][idx]
+    total = req[None, :] + r
+    ok = total <= a
+    plain = np.concatenate(
+        [ok[:, :R_SCRATCH], ok[:, NUM_BASE_RESOURCES:]], axis=-1
+    ).all(axis=-1)
+    alloc_s = a[:, R_SCRATCH]
+    alloc_o = a[:, R_OVERLAY]
+    pod_s = req[R_SCRATCH]
+    pod_o = req[R_OVERLAY]
+    node_s = r[:, R_SCRATCH]
+    node_o = r[:, R_OVERLAY]
+    no_overlay = alloc_o == 0
+    scratch_ok = np.where(
+        no_overlay,
+        pod_s + pod_o + node_s + node_o <= alloc_s,
+        pod_s + node_s <= alloc_s,
+    )
+    overlay_ok = no_overlay | (pod_o + node_o <= alloc_o)
+    res_ok = (plain & scratch_ok & overlay_ok) | zero_req
+    count_ok = nodes["pod_count"][idx] + 1 <= nodes["allowed_pods"][idx]
+    cond_ok = nodes["schedulable"][idx] & nodes["valid"][idx]
+    mem_ok = (not best_effort) | (~nodes["mem_pressure"][idx])
+    disk_ok = ~nodes["disk_pressure"][idx]
+    taint_free = nodes["taints_sched"][idx].astype(
+        np.int32).sum(axis=-1) == 0
+    fit = res_ok & count_ok & cond_ok & mem_ok & disk_ok & taint_free
+    spare_c = (a[:, R_CPU] - total[:, R_CPU]).astype(np.float32)
+    spare_m = (a[:, R_MEM] - total[:, R_MEM]).astype(np.float32)
+    cap_c = np.maximum(a[:, R_CPU], 1).astype(np.float32)
+    cap_m = np.maximum(a[:, R_MEM], 1).astype(np.float32)
+    score = np.where(fit, np.minimum(spare_c / cap_c, spare_m / cap_m),
+                     np.float32(_UNFIT))
+    win = np.int32(np.argmax(score))
+    return np.array([win, fit.astype(np.int32).sum(),
+                     np.int32(score.max() * 1e6)], dtype=np.int32)
+
+
+__all__ = ["FAST_NODE_KEYS", "sample_eval", "sample_eval_host"]

@@ -613,9 +613,10 @@ class TPUExtenderBackend:
 
     def debug_slo(self):
         """The SLO engine's /debug/slo payload (ISSUE 15), identical on
-        every transport."""
-        from kubernetes_tpu.observability.slo import SLO
-        return SLO.snapshot()
+        every transport. The fast tier's 10 ms objective (ISSUE 17)
+        rides under the "fast" key so both tiers land in one scrape."""
+        from kubernetes_tpu.observability.slo import SLO, SLO_FAST
+        return {**SLO.snapshot(), "fast": SLO_FAST.snapshot()}
 
     # -- cache sync ---------------------------------------------------------
 

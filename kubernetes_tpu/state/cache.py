@@ -80,12 +80,21 @@ class SchedulerCache:
         # still retained; consumers behind it must rebuild.
         self._aff_log: List[tuple] = []
         self._aff_log_start = 0
+        # exact count of resident pods carrying affinity/anti-affinity
+        # terms (ISSUE 17): the fast lane's eligibility gate — an
+        # EXISTING pod's anti-affinity can forbid a new plain pod
+        # (k8s 1.8 InterPodAffinityPredicate symmetry), so the fast lane
+        # only runs when this is zero. Maintained in _aff_event_locked,
+        # which every pod enter/leave already routes through.
+        self._aff_pods = 0
 
     # ---------------------------------------------------------- churn log
 
     def _aff_event_locked(self, pod: Pod, node_name: str, delta: int) -> None:
         """Bump aff_seq AND record what moved (caller holds the lock)."""
         self.aff_seq += 1
+        if delta != 0 and pod.has_pod_affinity():
+            self._aff_pods += delta
         log = self._aff_log
         log.append((self.aff_seq, pod, node_name, delta))
         # amortized trim: shifting per append would be O(ring) on the
@@ -389,6 +398,20 @@ class SchedulerCache:
         the moral equivalent of UpdateNodeNameToInfoMap (cache.go:79)."""
         with self._lock:
             return dict(self._nodes)
+
+    def node_info(self, name: str) -> Optional[NodeInfo]:
+        """One live NodeInfo reference (same read-only contract as
+        node_infos) — the fast-lane fence re-validates its single winner
+        without copying the whole map (ISSUE 17)."""
+        with self._lock:
+            return self._nodes.get(name)
+
+    def affinity_pod_count(self) -> int:
+        """Resident pods carrying affinity/anti-affinity terms — the
+        fast lane falls back to the full wave eval whenever this is
+        nonzero (ISSUE 17)."""
+        with self._lock:
+            return self._aff_pods
 
     def snapshot_infos(self) -> Dict[str, NodeInfo]:
         with self._lock:

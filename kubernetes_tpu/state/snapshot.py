@@ -224,6 +224,11 @@ class ClusterSnapshot:
         self._conflict_width = _pad(0, 4)
         self._pd_width = _pad(0, 4)
         self._vol_vocab_dirty = False
+        # fast-lane headroom view cache (ISSUE 17): (weights, ok) derived
+        # from the resident arrays, keyed on `version` — a fast pop with
+        # no intervening snapshot change reuses it for free
+        self._headroom = None
+        self._headroom_version = -1
         # arrays created on first refresh
         self.alloc: np.ndarray
         self.requested: np.ndarray
@@ -275,6 +280,27 @@ class ClusterSnapshot:
                 continue
             row[NUM_BASE_RESOURCES + idx] = q
         return row
+
+    def headroom_view(self):
+        """(weights float64 [N], ok bool [N]) for the fast lane's
+        weighted power-of-k sampling (ISSUE 17): weight = spare CPU + 1
+        on rows that could plausibly take a pod (live + schedulable +
+        pod-count headroom), 0 elsewhere. Derived from the RESIDENT host
+        arrays only — no refresh, no device read — and cached on
+        `version` so back-to-back fast pops between snapshot changes pay
+        one subtract. Approximate by design: the sampled eval re-checks
+        everything exactly, the fence re-validates against live truth."""
+        if self._headroom_version == self.version and \
+                self._headroom is not None:
+            return self._headroom
+        spare = np.clip(self.alloc[:, R_CPU] - self.requested[:, R_CPU],
+                        0, None).astype(np.float64)
+        ok = (self.schedulable & self.valid
+              & (self.pod_count < self.allowed_pods))
+        weights = np.where(ok, spare + 1.0, 0.0)
+        self._headroom = (weights, ok)
+        self._headroom_version = self.version
+        return self._headroom
 
     def ensure_label_pair(self, key: str, value: str) -> int:
         """Intern a selector-referenced pair; marks the label matrix stale

@@ -1286,3 +1286,91 @@ def test_gl002_podtrace_slo_seams_stay_host_pure(tmp_path):
     assert not errors, errors
     assert not [f for f in findings if "good_podtrace_emit" in f.path], \
         findings
+
+
+# --------------------------------------- ISSUE 17: fast-lane eval seam
+
+
+def test_gl002_registry_covers_fastlane_sample_eval(tmp_path):
+    """ISSUE 17: the fast lane's [1, k] sampled eval is a jitted entry
+    point (ops/fastlane.sample_eval) — the project-wide registry must
+    pick it up from the REAL source so GL002 taint extends to consumers.
+    An unblessed fetch here sits INSIDE the sub-10 ms bind path: one
+    accidental sync against a busy device queue is the whole budget."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    fl_py = os.path.join(PKG_DIR, "ops", "fastlane.py")
+    with open(fl_py, "r", encoding="utf-8") as fh:
+        index = ProjectIndex()
+        index.scan(ast.parse(fh.read()))
+    assert "sample_eval" in index.jitted_names
+    fixture = tmp_path / "fast_bind.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.ops.fastlane import sample_eval
+
+        def fast_bind(idx, req, nodes):
+            out = sample_eval(idx, req, False, False, nodes)
+            return np.asarray(out)
+    """))
+    findings, _sup, errors = run_paths([fl_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "fast_bind" in f.context
+               for f in findings), findings
+    # the blessed fetch — the lane's documented synchronous consume
+    # (device dispatched only when idle, so the wait IS the eval)
+    fixture.write_text(fixture.read_text().replace(
+        "return np.asarray(out)",
+        "return np.asarray(out)  # graftlint: sync-ok"))
+    findings, _sup, errors = run_paths([fl_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "fast_bind" in f.context], \
+        findings
+
+
+def test_gl003_fires_on_ragged_fastlane_sample(tmp_path):
+    """ISSUE 17: a data-dependent k-slice feeding the sampled eval would
+    mint one XLA compile per distinct candidate count (the GL003 storm,
+    paid on the LATENCY path); the fixed-[1, k] shape the lane actually
+    dispatches — resampling re-fills the same width — stays silent."""
+    fl_py = os.path.join(PKG_DIR, "ops", "fastlane.py")
+    bad = tmp_path / "ragged_sample.py"
+    bad.write_text(textwrap.dedent("""
+        from kubernetes_tpu.ops.fastlane import sample_eval
+
+        def probe(pods, idx, req, nodes):
+            out = []
+            while pods:
+                k = pods.pop()
+                out.append(sample_eval(idx[:k], req, False, False,
+                                       nodes))
+            return out
+    """))
+    findings, _sup, errors = run_paths([fl_py, str(bad)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert any(f.rule == "GL003" and "probe" in f.context
+               for f in findings), findings
+    good = tmp_path / "fixed_sample.py"
+    good.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.ops.fastlane import sample_eval
+
+        def probe(pods, draw, req, nodes, k):
+            out = []
+            while pods:
+                pods.pop()
+                idx = np.zeros(k, dtype=np.int32)
+                idx[:] = draw(k)
+                out.append(sample_eval(idx, req, False, False, nodes))
+            return out
+    """))
+    findings, _sup, errors = run_paths([fl_py, str(good)],
+                                       rules=["GL003"])
+    assert not errors, errors
+    assert not [f for f in findings if f.rule == "GL003"
+                and "fixed_sample" in f.path], findings
