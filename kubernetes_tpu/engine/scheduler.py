@@ -785,10 +785,11 @@ class Scheduler:
     def _wave_eligible(self, pods: List[Pod]) -> bool:
         """Cheap host-side gate before dispatch: with gang_pipeline off,
         gang-bearing chunks flush to the classic round (the pre-ISSUE 5
-        behavior, kept as the bench A/B baseline); the engine applies the
-        deeper checks itself (host-path classes, policy, affinity slot
-        overflow — required (anti-)affinity and quorum-ready gangs ride
-        the wave path, ISSUEs 3/5)."""
+        behavior, kept as the bench A/B baseline). No chunk SHAPE is
+        host-gated anymore (ISSUE 18): required (anti-)affinity, gangs,
+        host-check, and Policy classes all ride the wave path (ISSUEs
+        3/5/18); the engine returns None only for the gang-quorum-
+        unreachable corner, which the caller flushes per chunk."""
         if self.gang_pipeline:
             return True
         return all(gangmod.gang_name(p) is None for p in pods)

@@ -886,14 +886,18 @@ class _WaveEncoding:
                  "committed_nodes", "key_node", "static_forbid_hit",
                  "tail_cols", "aff_wave_dev", "aff_tail_dev",
                  "anti_terms", "aff_terms", "foreign_forbid",
-                 "foreign_forbid_dom", "aff_patch_dirty")
+                 "foreign_forbid_dom", "aff_patch_dirty",
+                 "host_exact", "host_static", "policy_on", "spread_on",
+                 "wkey", "has_static_cols")
 
     def __init__(self, vocab_gen, key_index, reps, cls_arr, num_classes,
                  c_pad, req_rows, special, derived, ports_max,
                  adata=None, fits_on=False, prio_on=False,
                  has_aff_pod=None, aff_seq=0, aff_wave_dev=None,
                  aff_tail_dev=None, key_node=None, static_forbid_hit=None,
-                 tail_cols=None, n_pad=0, labels_gen=0):
+                 tail_cols=None, n_pad=0, labels_gen=0,
+                 host_exact=None, host_static=None, policy_on=False,
+                 spread_on=False, wkey=(), has_static_cols=False):
         self.vocab_gen = vocab_gen
         self.labels_gen = labels_gen  # snapshot.labels_gen at build: the
         # topology views (key_node/static_forbid_hit/labels_aff) bake
@@ -912,6 +916,29 @@ class _WaveEncoding:
         self.prio_on = prio_on        # preferred-affinity scoring live
         self.wave_strict = adata.wave_strict if adata is not None \
             else np.zeros(c_pad, dtype=bool)
+        # host-check / Policy absorption (ISSUE 18): host_exact classes
+        # ride the wave as inactive padding-class rows and place at the
+        # harvest's exact oracle tail (live-NodeInfo ports, score-
+        # affecting preference overflow, Policy order-dependence,
+        # affinity slot overflow); host_static classes carry a
+        # precomputed exact label-pure fit column (cls_arr["host_fit"])
+        # and place on the wave itself. Neither shape flushes the
+        # pipeline anymore.
+        self.host_exact = host_exact if host_exact is not None \
+            else np.zeros(c_pad, dtype=bool)
+        self.host_static = host_static if host_static is not None \
+            else np.zeros(c_pad, dtype=bool)
+        self.policy_on = policy_on    # policy_fit/policy_score baked
+        self.spread_on = spread_on    # SelectorSpread riding frozen score
+        # workload-set identity at build (the scheduler replaces workload
+        # objects on watch events, so `is`-comparison detects any change);
+        # compared only when workloads are placement-relevant (policy or
+        # spread weight) — see _wave_encoding
+        self.wkey = wkey
+        # host/policy static columns bake LABEL CONTENT and workload
+        # state; a labels_gen move invalidates the whole encoding (no
+        # patch path for these columns — conservative, they are rare)
+        self.has_static_cols = has_static_cols
         self.has_aff_pod = has_aff_pod if has_aff_pod is not None \
             else np.zeros(c_pad, dtype=bool)
         self.aff_seq = aff_seq        # expected cache.aff_seq (own folds in)
@@ -970,12 +997,13 @@ class WaveHandle:
 
     __slots__ = ("pods", "pc", "enc", "packed", "state_out", "counter_out",
                  "nodes", "blind", "pop_ts", "dispatch_ts", "pad_floor",
-                 "committed_out", "strict_idx", "gangs", "wave_id")
+                 "committed_out", "strict_idx", "gangs", "wave_id",
+                 "host_idx")
 
     def __init__(self, pods, pc, enc, packed, state_out, counter_out, nodes,
                  blind, pop_ts, dispatch_ts, pad_floor=0,
                  committed_out=None, strict_idx=None, gangs=None,
-                 wave_id=-1):
+                 wave_id=-1, host_idx=None):
         self.pad_floor = pad_floor
         self.pods = pods
         self.pc = pc                  # host int32 [n] class index per pod
@@ -996,6 +1024,11 @@ class WaveHandle:
         # indices into `pods`, quorum)] — the harvest's gang fence commits
         # or atomically rolls back each one
         self.gangs = gangs or []
+        # host_exact rows (ISSUE 18): riding as inactive padding-class
+        # rows, placed by the harvest's exact oracle tail AFTER the
+        # fence — never counted unschedulable off the device result
+        self.host_idx = host_idx if host_idx is not None \
+            else np.empty(0, dtype=np.int64)
         # flight-recorder wave id (ISSUE 13): joins this wave's dispatch /
         # harvest / bind-flush events on the exported timeline; -1 when
         # the recorder was off at dispatch
@@ -1915,11 +1948,15 @@ class SchedulingEngine:
 
     def _wave_encoding(self, pods: Sequence[Pod], infos):
         """(encoding, pod_class[n]) for a pipeline chunk, via the
-        (vocab_gen, aff_seq)-keyed reuse cache; None when any class is not
-        wave-eligible (host-check routing, affinity slot overflow — those
-        chunks take the classic synchronous path). Affinity-bearing chunks
-        ARE wave-eligible (ISSUE 3): classes the topology counters express
-        run per-wave on device, the rest route to the seeded strict tail."""
+        (vocab_gen, aff_seq, workload-identity)-keyed reuse cache.
+        EVERY chunk shape is wave-eligible now (ISSUE 18): affinity
+        classes the topology counters express run per-wave on device
+        (ISSUE 3), label-pure host-check classes carry an exact
+        precomputed host_fit column, Policy classes carry frozen
+        policy_fit/policy_score columns with a fence-side exact
+        re-check, and everything else (live-NodeInfo ports, preference
+        overflow, Policy order-dependence, affinity slot overflow)
+        rides inactive and places at the harvest's exact oracle tail."""
         import dataclasses as _dc
 
         from kubernetes_tpu.ops.affinity import (
@@ -1927,7 +1964,6 @@ class SchedulingEngine:
             _has_affinity,
             collect_pod_pairs,
             intern_topology_pairs,
-            spec_overflow,
         )
         from kubernetes_tpu.ops.predicates import pod_arrays_padded
         from kubernetes_tpu.state.classes import pod_class_key
@@ -1935,7 +1971,35 @@ class SchedulingEngine:
 
         snap = self.snapshot
         enc = self._wave_enc
+        policy_active = self.policy_algos is not None \
+            and self.policy_algos.active
+        w_ip = sum(w for nm, w in self.priorities
+                   if nm == "InterPodAffinityPriority")
+        w_sp = sum(w for nm, w in self.priorities
+                   if nm == "SelectorSpreadPriority")
+        # workloads are placement-relevant only through Policy predicates
+        # or a live SelectorSpread weight; otherwise their churn can never
+        # change a placement and the encoding ignores them entirely
+        workloads_now = tuple(self.workloads_provider()) \
+            if (policy_active or w_sp) else ()
         fresh = enc is not None and enc.vocab_gen == snap.vocab_gen
+        if fresh and enc.policy_on != policy_active:
+            fresh = False
+        if fresh and (policy_active or w_sp):
+            wk = enc.wkey
+            if len(wk) != len(workloads_now) or not all(
+                    a is b for a, b in zip(wk, workloads_now)):
+                # workload set moved (the scheduler replaces workload
+                # objects on watch events, so identity detects every
+                # change): the frozen policy/spread arrays and the
+                # needs_host classification are stale — full rebuild
+                fresh = False
+        if fresh and enc.has_static_cols \
+                and enc.labels_gen != snap.labels_gen:
+            # host/policy static columns bake label content; checked
+            # BEFORE the affinity label-patch path so a patched encoding
+            # can never keep a stale column
+            fresh = False
         if fresh and enc.adata is not None \
                 and enc.labels_gen != snap.labels_gen:
             # label content moved: patch the touched rows (Protean,
@@ -1968,32 +2032,54 @@ class SchedulingEngine:
         chunk_aff = any(_has_affinity(p) for p in seed) \
             or any(_has_affinity(p) for p in pods)
         cluster_aff = any(bool(i.pods_with_affinity) for i in infos.values())
-        if (chunk_aff or cluster_aff) and any(
-                spec_overflow(p, self.hard_pod_affinity_weight)
-                for p in seed + list(pods)):
-            # known slot overflow: the full build would only rediscover it
-            # after collect_pod_pairs + intern + ClassBatch + AffinityData
-            return None  # classic path (exact oracle)
+        # spread-only chunks build AffinityData too (ISSUE 18): the
+        # workload-membership arrays drive the frozen SelectorSpread
+        # score, so workload-bearing streams no longer flush the pipeline
+        build_adata = chunk_aff or cluster_aff \
+            or (bool(w_sp) and bool(workloads_now))
         all_pairs: list = []
         aff_pairs: list = []
-        if chunk_aff or cluster_aff:
+        if build_adata or policy_active:
+            all_pairs, aff_pairs = collect_pod_pairs(infos)
+        if build_adata:
             # topology keys referenced by ANY affinity term must be interned
             # BEFORE the label matrix finalizes (the r2 symmetry bug), same
             # ordering contract as schedule()
-            all_pairs, aff_pairs = collect_pod_pairs(infos)
             intern_topology_pairs(snap, seed + list(pods), aff_pairs)
         batch = ClassBatch(seed + list(pods), snap)
         n_cls = batch.num_classes
         rb = batch.reps_batch
-        if rb.needs_host_check[:n_cls].any():
-            return None
         c_pad = bucket(n_cls + 1)
+        # host-check absorption (ISSUE 18): label-pure host classes get an
+        # exact precomputed fit column and ride the wave; the rest (live-
+        # NodeInfo ports, score-affecting preference overflow, shapes the
+        # column cannot derive, Policy order-dependence, affinity slot
+        # overflow below) ride as inactive rows and place at the harvest's
+        # exact oracle tail. No chunk SHAPE flushes the pipeline anymore.
+        host_exact = np.zeros(c_pad, dtype=bool)
+        host_static = np.zeros(c_pad, dtype=bool)
+        nhc = rb.needs_host_check[:n_cls]
+        host_exact[:n_cls] = nhc & rb.host_check_dynamic[:n_cls]
+        host_fit_rows: Dict[int, np.ndarray] = {}
+        for c in np.nonzero(nhc & ~rb.host_check_dynamic[:n_cls])[0]:
+            row = rb.host_static_fit(int(c), snap)
+            if row is None:
+                host_exact[c] = True  # not derivable from labels alone
+            else:
+                host_static[c] = True
+                host_fit_rows[int(c)] = row
+        if policy_active:
+            # service-coupled classes are order-dependent in-batch (the
+            # reference's pod lister is the scheduler cache) -> exact tail
+            host_exact[:n_cls] |= np.asarray(
+                self.policy_algos.needs_host(batch.reps, workloads_now),
+                dtype=bool)[:n_cls]
         adata = None
-        fits_on = prio_on = False
+        fits_on = prio_on = spread_on = False
         has_aff_pod = None
         aff_wave_dev = aff_tail_dev = None
         key_node = static_forbid_hit = tail_cols = None
-        if chunk_aff or cluster_aff:
+        if build_adata:
             COUNTERS.inc("engine.wave_aff_build")
             # the churn-robustness observable (ISSUE 8): every wholesale
             # AffinityData build the patch paths could NOT absorb. Under
@@ -2001,14 +2087,16 @@ class SchedulingEngine:
             # growth), not O(foreign binds) — the bench reports it.
             COUNTERS.inc("engine.aff_full_rebuilds")
             adata = AffinityData(batch.reps, snap, all_pairs, aff_pairs,
-                                 (), self.hard_pod_affinity_weight,
+                                 workloads_now,
+                                 self.hard_pod_affinity_weight,
                                  c_pad=c_pad)
-            if adata.overflow[:n_cls].any():
-                return None  # slot overflow -> classic path (exact oracle)
-            w_ip = sum(w for nm, w in self.priorities
-                       if nm == "InterPodAffinityPriority")
+            # slot overflow no longer flushes (ISSUE 18): overflow classes
+            # join the exact oracle tail — the classic round marked them
+            # host-check; same semantics, minus the pipeline drain
+            host_exact[:n_cls] |= adata.overflow[:n_cls]
             fits_on = adata.fits_needed
             prio_on = bool(w_ip) and adata.prio_needed
+            spread_on = bool(w_sp) and adata.spread_needed
             has_aff_pod = np.zeros(c_pad, dtype=bool)
             for c, rep in enumerate(batch.reps):
                 has_aff_pod[c] = _has_affinity(rep)
@@ -2030,12 +2118,32 @@ class SchedulingEngine:
                     "wave_gate": sanitize.upload_frozen(
                         adata.wave_gate, sharding=_sh("wave_gate")),
                 }
-            if fits_on or prio_on:
+            if fits_on or prio_on or spread_on:
                 tail_cols = _aff_tail_cols(adata, prio_on)
                 aff_tail_dev = _aff_tail_arrays(adata, snap, tail_cols,
                                                 rmesh=self._rmesh)
         COUNTERS.inc("engine.wave_encode_build")
         cls_arr = pod_arrays_padded(rb, c_pad)
+        if host_fit_rows:
+            # the host-check static column: exact label-pure fit rows for
+            # host_static classes, folded into the fused [C, N] eval via
+            # predicates.static_fits (padding rows True — the validity
+            # mask already excludes them)
+            hf = np.ones((c_pad, snap.valid.shape[0]), dtype=bool)
+            for c, row in host_fit_rows.items():
+                hf[c] = row
+            cls_arr["host_fit"] = sanitize.upload_frozen(hf)
+        policy_cols = False
+        if policy_active:
+            pfit, pscore = self.policy_algos.static_class_arrays(
+                batch.reps, snap, workloads_now, all_pairs, c_pad,
+                skip=host_exact[:n_cls])
+            if pfit is not None:
+                cls_arr["policy_fit"] = jnp.asarray(pfit)
+                policy_cols = True
+            if pscore is not None:
+                cls_arr["policy_score"] = jnp.asarray(pscore)
+                policy_cols = True
         key_index = {pod_class_key(rep): c
                      for c, rep in enumerate(batch.reps)}
         special = ((rb.ports[:n_cls, 0] >= 0)
@@ -2056,7 +2164,11 @@ class SchedulingEngine:
             aff_wave_dev=aff_wave_dev, aff_tail_dev=aff_tail_dev,
             key_node=key_node, static_forbid_hit=static_forbid_hit,
             tail_cols=tail_cols, n_pad=snap.valid.shape[0],
-            labels_gen=snap.labels_gen)
+            labels_gen=snap.labels_gen,
+            host_exact=host_exact, host_static=host_static,
+            policy_on=policy_active, spread_on=spread_on,
+            wkey=workloads_now,
+            has_static_cols=bool(host_fit_rows) or policy_cols)
         if adata is not None:
             from kubernetes_tpu.ops.oracle_ext import _own_terms
             for c, rep in enumerate(reps):
@@ -2076,11 +2188,14 @@ class SchedulingEngine:
         occupancy). Required (anti-)affinity chunks are wave-eligible
         (ISSUE 3): counter-expressible classes re-evaluate their masks per
         wave on device, inexpressible ones ride as inactive rows and the
-        harvest finishes them via the seeded strict tail. Returns None only
-        when the chunk needs the classic path (policy algorithms,
-        workloads/spreading, host-check classes, affinity slot overflow) —
-        the caller must then flush the pipeline and run the synchronous
-        engine.
+        harvest finishes them via the seeded strict tail. Host-check and
+        Policy chunks ride too (ISSUE 18): label-pure host classes via
+        the precomputed host_fit column, the rest as inactive rows placed
+        at the harvest's exact oracle tail. Returns None only for the one
+        disclosed corner — a gang whose quorum is unreachable from its
+        wave-eligible members (it would roll back forever); every other
+        chunk shape dispatches, and the only remaining pipeline flush
+        triggers are Node SPEC events (_node_event_needs_flush, r11).
 
         `gangs` = [(name, member indices into `pods`, quorum)]: quorum-
         ready gangs riding this wave as ordinary batch rows (ISSUE 5).
@@ -2093,10 +2208,6 @@ class SchedulingEngine:
 
         if not pods:
             return None
-        if self.policy_algos is not None and self.policy_algos.active:
-            return None
-        if self.workloads_provider():
-            return None
         # flight recorder (ISSUE 13): one host-side timestamp when armed,
         # nothing at all when off — the event itself is emitted after the
         # async launch, carrying only host scalars already in hand
@@ -2107,6 +2218,18 @@ class SchedulingEngine:
             if out is None:
                 return None
             enc, pc = out
+            hx = enc.host_exact[pc]
+            host_idx = np.nonzero(hx)[0].astype(np.int64)
+            if gangs and host_idx.size:
+                # the one remaining chunk-shape flush corner (disclosed):
+                # a gang whose quorum is unreachable from its wave-
+                # eligible members would roll back on every re-dispatch —
+                # only IT flushes to the classic round
+                hset = set(host_idx.tolist())
+                for _gname, idxs, quorum in gangs:
+                    if sum(1 for i in idxs if i not in hset) < quorum:
+                        COUNTERS.inc("engine.wave_flush_gang_host")
+                        return None
             if enc.adata is not None:
                 # patched topology views re-upload once per dispatch,
                 # however many churn events were absorbed since the last
@@ -2115,6 +2238,13 @@ class SchedulingEngine:
             p_pad = bucket(max(n, self.wave_pad_floor or 1))
             pc_pad = np.full(p_pad, enc.num_classes, dtype=np.int32)
             pc_pad[:n] = pc
+            if host_idx.size:
+                # host_exact rows ride as the PADDING class: impossible on
+                # device (fit nothing, no RR ticks, retire on the first
+                # wave) — the harvest's exact oracle tail places them
+                # against live NodeInfo truth after the fence
+                pc_pad[host_idx] = enc.num_classes
+                COUNTERS.inc("engine.wave_host_rows", int(host_idx.size))
             max_words = self.snapshot.port_words_used()
             if enc.ports_max >= 0:
                 max_words = max(max_words, enc.ports_max // 32 + 1)
@@ -2127,23 +2257,30 @@ class SchedulingEngine:
             counter = self._rr_chain if self._rr_chain is not None \
                 else jnp.uint32(self.rr.counter)
             extra = None
-            if enc.prio_on:
-                # preferred-affinity scores, frozen against the encoding's
-                # static topology view (the wave-mode approximation, same
-                # as the classic _run_wave's batch-frozen extra_score) —
-                # over the tail's projected domain axis, which covers every
-                # priority-side keymask column by construction
+            if enc.prio_on or enc.spread_on:
+                # preferred-affinity / SelectorSpread scores, frozen
+                # against the encoding's static topology view (the
+                # wave-mode approximation, same as the classic _run_wave's
+                # batch-frozen extra_score) — over the tail's projected
+                # domain axis, which covers every priority-side keymask
+                # column by construction. Spread rides frozen too (ISSUE
+                # 18): within-batch drift of workload counts is the same
+                # documented score-only approximation.
                 w_ip = sum(w for nm, w in self.priorities
                            if nm == "InterPodAffinityPriority")
+                w_sp = sum(w for nm, w in self.priorities
+                           if nm == "SelectorSpreadPriority")
                 extra = waves.frozen_affinity_scores(
-                    enc.cls_arr, nodes, state, enc.aff_tail_dev, (w_ip, 0))
+                    enc.cls_arr, nodes, state, enc.aff_tail_dev,
+                    (w_ip if enc.prio_on else 0,
+                     w_sp if enc.spread_on else 0))
             strict_idx = np.empty(0, dtype=np.int64)
             committed_out = None
             if enc.fits_on:
-                ser = enc.wave_strict[pc]
+                ser = enc.wave_strict[pc] & ~hx
                 strict_idx = np.nonzero(ser)[0]
                 act = np.zeros(p_pad, dtype=bool)
-                act[:n] = ~ser
+                act[:n] = ~(ser | hx)
                 # committed_nodes must upload as a COPY: the harvest FOLD
                 # mutates it in place (np.add.at) while this wave may
                 # still be executing against it asynchronously (the same
@@ -2201,7 +2338,7 @@ class SchedulingEngine:
                               _time.monotonic(), self.wave_pad_floor,
                               committed_out=committed_out,
                               strict_idx=strict_idx, gangs=gangs,
-                              wave_id=wave_id)
+                              wave_id=wave_id, host_idx=host_idx)
 
     def harvest_waves(self, handle: WaveHandle) -> WaveHarvest:
         """Block on one wave's device→host sync, fence its placements
@@ -2262,6 +2399,11 @@ class SchedulingEngine:
         act = packed_h[2 * p_pad:2 * p_pad + n].astype(bool)
         counter_h = int(np.uint32(packed_h[3 * p_pad]))
         tail_idx = np.nonzero(act)[0]
+        if handle.host_idx.size:
+            # host_exact rows retire inactive off the padding class on the
+            # first wave; they never ride the device tail — the exact
+            # oracle tail below places them after the fence
+            tail_idx = np.setdiff1d(tail_idx, handle.host_idx)
         straggler_idx = np.empty(0, dtype=np.int64)
         if enc.adata is not None and tail_idx.size:
             # max-waves stragglers may NOT ride the seeded tail in an
@@ -2404,9 +2546,11 @@ class SchedulingEngine:
                 acc_cls = acc_cls[keep]
             else:
                 drop = None
+        host_rows = set(handle.host_idx.tolist())
         unschedulable = [(pods[i], int(fc[i]))
                          for i in np.nonzero(sel < 0)[0].tolist()
-                         if i not in strag and (drop is None or not drop[i])]
+                         if i not in strag and i not in host_rows
+                         and (drop is None or not drop[i])]
         bound: List[Pod] = []
         # conflicts + their typed reason codes, parallel (ISSUE 15):
         # max-waves stragglers are an affinity-routing verdict
@@ -2480,6 +2624,40 @@ class SchedulingEngine:
                               1)
                 enc.aff_seq += len(acc_l)
             bound = [pods[i] for i in sorted(acc_l)]
+        if host_rows:
+            # the exact oracle tail (ISSUE 18): host_exact rows place
+            # AFTER the wave rows' assume, against live NodeInfo truth —
+            # exactly the classic round's slow_idx FIFO loop, so each
+            # host pod sees every commit this harvest just made (and each
+            # other's). Rolled-back gangs' members are excluded (their
+            # gang fence already requeued them WITH backoff — zero
+            # partial residue holds).
+            h_rows = [i for i in sorted(host_rows)
+                      if drop is None or not drop[i]]
+            if h_rows:
+                from kubernetes_tpu.ops.oracle_ext import SchedulingContext
+                COUNTERS.inc("engine.wave_host_tail", len(h_rows))
+                with timed_span("pipeline.host_tail"):
+                    infos_t = self.cache.node_infos()
+                    names_t = snap.node_names
+                    ctx = SchedulingContext(
+                        infos_t, self.workloads_provider(),
+                        hard_pod_affinity_weight=(
+                            self.hard_pod_affinity_weight),
+                        volume_ctx=self.volume_ctx,
+                        policy_algos=self.policy_algos)
+                    for i in h_rows:
+                        name = oracle.schedule_one(
+                            pods[i], names_t, infos_t, self.rr,
+                            self.priorities, ctx)
+                        if name is not None:
+                            self._assume(pods[i], name)
+                            infos_t = self.cache.node_infos()
+                            ctx.infos = infos_t
+                            ctx.invalidate()
+                            bound.append(pods[i])
+                        else:
+                            unschedulable.append((pods[i], 0))
         if _rec_t0 and RECORDER.enabled:
             RECORDER.record(flightrec.HARVEST, wave=handle.wave_id,
                             t0=_rec_block_end - t_block, dur=t_block,
@@ -2597,6 +2775,49 @@ class SchedulingEngine:
                     podtrace.REASON_STALE if aff_stale \
                     else podtrace.REASON_AFFINITY
                 ok &= ~aff_bad
+        # host-check re-validation (ISSUE 18): the host_fit column baked
+        # label CONTENT at build; a relabel landing while this wave was
+        # in flight makes the column stale — conservative requeue of
+        # every host_static row (relabels are rare; the re-dispatch
+        # rebuilds the encoding against fresh truth, the has_static_cols
+        # invalidation above guarantees it)
+        hs_bad = enc.host_static[cls_rows]
+        if hs_bad.any() and snap.labels_gen != enc.labels_gen:
+            n_h = int((hs_bad & ok).sum())
+            if n_h:
+                COUNTERS.inc("engine.hostcheck_fence_requeues", n_h)
+            reason[hs_bad & (reason < 0)] = podtrace.REASON_HOSTCHECK
+            ok &= ~hs_bad
+        if enc.policy_on and self.policy_algos is not None \
+                and self.policy_algos.active:
+            # Policy re-validation (ISSUE 18): the frozen policy_fit
+            # column was exact against the build-time workload set and
+            # pod locations; re-check the EXACT oracle predicate against
+            # live truth for every surviving row — ServiceAffinity moves
+            # with every commit, and this fence is what lets Policy
+            # chunks ride blind without ghost-binding on stale state
+            cand = np.nonzero(ok)[0]
+            if cand.size:
+                from kubernetes_tpu.ops.oracle_ext import SchedulingContext
+                infos_f = self.cache.node_infos()
+                ctx = SchedulingContext(
+                    infos_f, self.workloads_provider(),
+                    hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                    volume_ctx=self.volume_ctx,
+                    policy_algos=self.policy_algos)
+                names_f = snap.node_names
+                p_bad = np.zeros(m, dtype=bool)
+                for r in cand.tolist():
+                    info = infos_f.get(names_f[int(gnode[r])])
+                    node = info.node if info is not None else None
+                    if node is None or not self.policy_algos.oracle_fit(
+                            handle.pods[int(gidx[r])], node, ctx):
+                        p_bad[r] = True
+                if p_bad.any():
+                    COUNTERS.inc("engine.policy_fence_requeues",
+                                 int(p_bad.sum()))
+                    reason[p_bad & (reason < 0)] = podtrace.REASON_POLICY
+                    ok &= ~p_bad
         # liveness re-validation (ISSUE 8): a row targeting a node the
         # owner declared dying (watch event seen, not yet applied — the
         # doomed set) or one the refreshed snapshot already rules out
@@ -2617,7 +2838,8 @@ class SchedulingEngine:
             ok &= ~live_bad
         conflict_mask = ~ok & ~live_bad
         for code in (podtrace.REASON_CAPACITY, podtrace.REASON_AFFINITY,
-                     podtrace.REASON_STALE):
+                     podtrace.REASON_STALE, podtrace.REASON_HOSTCHECK,
+                     podtrace.REASON_POLICY):
             n_r = int(((reason == code) & conflict_mask).sum())
             if n_r:
                 COUNTERS.inc("engine.fence_reason_"

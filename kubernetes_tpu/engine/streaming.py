@@ -360,10 +360,13 @@ class ScheduleLoop:
                     if trace is not None and handle is not None:
                         trace.step("wave dispatched (async)")
             if handle is None and chunk_pods:
-                # chunk needs the strict/oracle machinery (host-check
-                # classes, affinity slot overflow, policy — or gangs with
-                # gang_pipeline off): drain the pipeline so the
-                # synchronous path sees every commit, then run it classic
+                # classic fallback (ISSUE 18: no chunk SHAPE lands here
+                # anymore — host-check and Policy chunks ride the wave).
+                # Remaining triggers: gangs with gang_pipeline off, a
+                # gang whose quorum is unreachable from its wave-eligible
+                # members, degraded mode. The counter is the no-flush
+                # routing guard's observable.
+                COUNTERS.inc("stream.chunk_flush")
                 self.flush()
                 sub = s._process_batch(chunk_pods, pop_ts)
                 sub["popped"] = 0  # already counted

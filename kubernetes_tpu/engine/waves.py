@@ -237,7 +237,17 @@ def precompute(cls: Arrays, nodes: Arrays,
     node-affinity label-axis matmuls in here are the single largest
     per-dispatch cost once the loops themselves are round-granular.
     `precompute_jit` is the standalone entry point for that caching;
-    the loops keep computing it inline when no `pre` is passed."""
+    the loops keep computing it inline when no `pre` is passed.
+
+    Optional frozen columns (ISSUE 18): a `host_fit` [C, N] bool column
+    (label-pure host-check classes, exact against build-time label
+    truth — ops/predicates.static_fits ANDs it in) and `policy_fit` /
+    `policy_score` columns (Policy-configured algorithms, frozen per
+    class — ops/policy_algos.static_class_arrays). Both ride every
+    dispatch of the encoding; staleness is the FENCE's problem
+    (scheduler_engine._fence re-validates against live truth), never
+    this eval's — which is what lets host-check and Policy chunks ride
+    the wave path instead of flushing the pipeline."""
     c = cls["req"].shape[0]
     n = nodes["alloc"].shape[0]
     static_score = jnp.zeros((c, n), dtype=jnp.int32)

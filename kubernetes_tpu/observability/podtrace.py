@@ -125,9 +125,19 @@ REASON_STALE = 4
 # bind_conflict_reason_* counters partition with the same names as the
 # engine's fence_reason_* requeues.
 REASON_DOUBLE_CLAIM = 5
+# host_check / policy (ISSUE 18): the last two serializing chunk shapes
+# now ride the wave blind — host-check classes against a precomputed
+# static host column (or an exact harvest-tail oracle), Policy classes
+# against frozen policy fit/score columns. Their fence losers are their
+# own production story: a label or workload-set change raced the wave
+# in flight, the conservative fence caught it, and the pod requeued
+# instead of binding on stale truth.
+REASON_HOSTCHECK = 6
+REASON_POLICY = 7
 
 REASON_NAMES = ("capacity", "affinity", "liveness", "gang",
-                "stale_encoding", "double_claim")
+                "stale_encoding", "double_claim", "host_check",
+                "policy")
 
 # wire-hop codes
 WIRE_HTTP = 0
@@ -553,7 +563,8 @@ __all__ = ["BOUND", "CREATED", "ENQUEUED", "EVICTED", "FAST_DISPATCHED",
            "HOP_NAMES", "KIND_NAMES", "PHASE_NAMES", "POPPED",
            "PREEMPT_VICTIM", "PodTracer", "REASON_AFFINITY",
            "REASON_CAPACITY", "REASON_DOUBLE_CLAIM", "REASON_GANG",
-           "REASON_LIVENESS",
-           "REASON_NAMES", "REASON_STALE", "TRACER", "WAVE_DISPATCHED",
+           "REASON_HOSTCHECK", "REASON_LIVENESS",
+           "REASON_NAMES", "REASON_POLICY", "REASON_STALE", "TRACER",
+           "WAVE_DISPATCHED",
            "WIRE_BINARY", "WIRE_EMBEDDED", "WIRE_HOP", "WIRE_HTTP",
            "WIRE_NAMES", "decompose", "phase_of"]

@@ -49,6 +49,12 @@ HEADLINE_METRICS: Tuple[Tuple[str, str, Optional[str]], ...] = (
     # before r19; the gate tolerates missing history like multiproc
     ("fastlane_p99_ms", "fastlane p99 ms", "down"),
     ("mixed_bulk_sustained", "mixed bulk frac", "up"),
+    # ISSUE 18: the rolling-update scenario — update completion time and
+    # the replacement pods' p99 create->bound on the loaded stream —
+    # absent before r20; the gate tolerates missing history like
+    # multiproc/fastlane
+    ("rolling_update_completion_s", "rollout done s", "down"),
+    ("rolling_replacement_p99_ms", "rollout p99 ms", "down"),
     ("telemetry_overhead_pct", "recorder ovh %", None),
     ("podtrace_overhead_pct", "podtrace ovh %", None),
 )

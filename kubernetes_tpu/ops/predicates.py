@@ -411,6 +411,14 @@ def static_fits(pods: Arrays, nodes: Arrays) -> jnp.ndarray:
         # Policy-configured NodeLabelPresence / ServiceAffinity masks,
         # precomputed host-side (ops/policy_algos.py)
         out = out & pods["policy_fit"]
+    if "host_fit" in pods:
+        # host-check static column (ISSUE 18): the exact label-pure
+        # host predicate for classes whose selector/zone/PV shape
+        # overflowed the fused encoding, precomputed host-side
+        # (PodBatch.host_static_fit) so those classes ride the wave
+        # instead of flushing. ANDing exact with the over-approximate
+        # terms above keeps the composite exact.
+        out = out & pods["host_fit"]
     return out
 
 

@@ -1255,6 +1255,11 @@ class PodBatch:
         self.host_required = np.full(P, -1, dtype=np.int32)  # PodFitsHost node idx
         self.has_host = np.zeros(P, dtype=bool)
         self.needs_host_check = np.zeros(P, dtype=bool)
+        # which host-check causes are NOT derivable from node labels alone
+        # (live-NodeInfo ports, score-affecting preference overflow) — the
+        # wave path can absorb the label-pure remainder as a static fit
+        # column (host_static_fit) but these must stay on the exact oracle
+        self.host_check_dynamic = np.zeros(P, dtype=bool)
 
         # selector structures — sized by actual usage, min 1 term. Compiling
         # interns referenced label pairs into the snapshot's demand-driven
@@ -1442,6 +1447,7 @@ class PodBatch:
             self.ports[p, j] = port
         if len(pod.used_ports()) > MAX_PORTS_PER_POD:
             self.needs_host_check[p] = True
+            self.host_check_dynamic[p] = True  # HostPorts needs live pods
 
         if pod.node_name:
             self.has_host[p] = True
@@ -1531,6 +1537,9 @@ class PodBatch:
         if len(prefs) > n_pref:
             # too many preferred terms for static shape: host-exact path
             self.needs_host_check[p] = True
+            # score-affecting — a fit column can't express the missing
+            # preference weights, so no static-column absorption
+            self.host_check_dynamic[p] = True
             prefs = prefs[:0]
         for t, (weight, comp) in enumerate(prefs):
             self.pref_valid[p, t] = True
@@ -1541,6 +1550,7 @@ class PodBatch:
             req_all, any_groups, forbid, unsat = comp
             if len(any_groups) > n_any:
                 self.needs_host_check[p] = True
+                self.host_check_dynamic[p] = True  # score-affecting
                 any_groups = []
             if unsat:
                 self.pref_unsat[p, t] = True
@@ -1552,6 +1562,66 @@ class PodBatch:
                 self.pref_any_used[p, t, a] = True
                 for i in group:
                     self.pref_req_any[p, t, a, i] = 1
+
+    def host_static_fit(self, p: int, snap: ClusterSnapshot):
+        """Exact label-pure host-fit row [n_pad] for pod p over the
+        snapshot's raw per-node label maps (ISSUE 18) — the static
+        column a host-check class rides the wave with instead of
+        flushing the pipeline. Evaluates the FULL predicates the fused
+        eval over-approximated (selector shape overflow, VolumeZone
+        ""-valued constraints, PV-affinity any-group overflow) straight
+        from the reference semantics (oracle.pod_matches_node_selector,
+        volumes.node_zone_check, NoVolumeNodeConflict), so ANDing it
+        with the device's superset column yields the exact predicate.
+
+        Returns None when the pod's host requirement is NOT derivable
+        from labels alone (live-NodeInfo ports, score-affecting pref
+        overflow, unresolvable PVs) — the caller must keep that class
+        on the exact harvest-tail path. Padding rows are left True;
+        the validity mask excludes them downstream.
+        """
+        if self.host_check_dynamic[p]:
+            return None
+        from kubernetes_tpu.utils import features as featmod
+        pod = self.pods[p]
+        zcons = None
+        pv_reqs = ()
+        if pod.volumes:
+            try:
+                zcons = volmod.zone_constraints(pod, snap.volume_ctx)
+            except volmod.UnresolvedVolume:
+                zcons = None  # vz_err: the device column handles it exactly
+            if featmod.enabled("PersistentLocalVolumes"):
+                try:
+                    pv_reqs = volmod.pv_affinity_requirements(
+                        pod, snap.volume_ctx)
+                except volmod.UnresolvedVolume:
+                    return None  # reference fails the attempt: exact path
+        na = pod.affinity.node_affinity if pod.affinity else None
+        fit = np.ones(snap.valid.shape[0], dtype=bool)
+        for i in range(len(snap.node_names)):
+            labels = snap._row_labels[i]
+            ok = True
+            for k, v in pod.node_selector.items():
+                if labels.get(k) != v:
+                    ok = False
+                    break
+            if ok and na is not None and na.required_terms is not None:
+                # ORed terms; empty list matches nothing
+                ok = any(t.matches_labels(labels)
+                         for t in na.required_terms)
+            if ok and zcons:
+                node_zone = {k: v for k, v in labels.items()
+                             if k in (volmod.ZONE_LABEL,
+                                      volmod.REGION_LABEL)}
+                for k, v in (node_zone and zcons or ()):
+                    if node_zone.get(k, "") != v:
+                        ok = False
+                        break
+            if ok and pv_reqs:
+                ok = all(r.matches_labels(labels) for r in pv_reqs)
+            fit[i] = ok
+        return fit
 
     def __len__(self) -> int:
         return len(self.pods)

@@ -389,6 +389,158 @@ class ChurnInjector:
         return th
 
 
+# ------------------------------------------------------- rolling updates
+
+
+def diurnal_rate(base: float, amp: float = 0.5, period_s: float = 60.0):
+    """Offered-rate curve shaped like a day: rate(t) = base * (1 + amp *
+    sin(2*pi*t/period)). The rolling-update scenario rides its replacement
+    waves on TOP of this curve, so the update is measured against a
+    cluster whose background load is moving — the deploy-shaped traffic
+    of ISSUE 18, not a quiet box."""
+    import math
+
+    def rate(t: float) -> float:
+        return max(0.0, base * (1.0 + amp *
+                                math.sin(2.0 * math.pi * t / period_s)))
+
+    return rate
+
+
+@dataclass
+class RollingUpdateConfig:
+    """Deployment-shaped rolling update (the reference's deployment
+    controller semantics, driven against store truth): `replicas` old-
+    revision pods are replaced by new-revision pods under the two
+    standard bounds — at most `max_surge` pods OVER the replica count
+    may exist at once, and availability may fall at most
+    `max_unavailable` UNDER it (a replacement counts available once it
+    is bound)."""
+
+    replicas: int = 200
+    max_surge: int = 25
+    max_unavailable: int = 25
+    app: str = "web"
+    old_rev: str = "1"
+    new_rev: str = "2"
+
+
+class RollingUpdateDriver:
+    """Evict-and-recreate controller: each ``step()`` observes STORE
+    truth (never its own bookkeeping — a controller trusting its own
+    view would hide scheduler lag), creates replacements up to the surge
+    bound, and evicts old-revision pods down to the unavailability
+    bound. The driver records the observed extremes so the bench can
+    report `surge_respected` / `unavailable_respected` as measured
+    facts rather than configuration echoes.
+
+    ``make_replacement(i)`` must return a pod labeled
+    {app: cfg.app, rev: cfg.new_rev}; the driver stamps each creation
+    in ``create_ts`` (key -> monotonic instant) for the caller's
+    create->bound join."""
+
+    def __init__(self, api: ApiServerLite, cfg: RollingUpdateConfig,
+                 make_replacement):
+        self.api = api
+        self.cfg = cfg
+        self.make_replacement = make_replacement
+        self.create_ts: Dict[str, float] = {}
+        self.replacement_keys: List[str] = []
+        self._created = 0
+        self.evicted = 0
+        self.noop = 0
+        self.started_at: Optional[float] = None
+        self.completed_at: Optional[float] = None
+        self.max_total_seen = 0
+        self.min_available_seen = cfg.replicas
+
+    def _observe(self):
+        cfg = self.cfg
+        pods = [p for p in self.api.list("Pod")[0]
+                if p.labels.get("app") == cfg.app]
+        old = [p for p in pods if p.labels.get("rev") == cfg.old_rev]
+        new = [p for p in pods if p.labels.get("rev") == cfg.new_rev]
+        return old, new
+
+    def step(self) -> bool:
+        """One controller pass; returns True once the update is complete
+        (no old-revision pod remains and every replacement is bound)."""
+        cfg = self.cfg
+        now = time.monotonic()
+        if self.started_at is None:
+            self.started_at = now
+        old, new = self._observe()
+        new_bound = sum(1 for p in new if p.node_name)
+        available = sum(1 for p in old if p.node_name) + new_bound
+        total = len(old) + len(new)
+        self.max_total_seen = max(self.max_total_seen, total)
+        self.min_available_seen = min(self.min_available_seen, available)
+        # surge-bounded creation: never exceed replicas + max_surge pods
+        # of this app in the store, never create more than replicas
+        # replacements overall
+        n_create = min(cfg.replicas + cfg.max_surge - total,
+                       cfg.replicas - self._created)
+        for _ in range(max(n_create, 0)):
+            p = self.make_replacement(self._created)
+            self.api.create("Pod", p)
+            self.create_ts[p.key()] = time.monotonic()
+            self.replacement_keys.append(p.key())
+            self._created += 1
+        # unavailability-bounded eviction: only as many old pods as keeps
+        # available >= replicas - max_unavailable (replacements created
+        # above are NOT yet available — they count only once bound)
+        n_evict = available - (cfg.replicas - cfg.max_unavailable)
+        victims = sorted((p for p in old if p.node_name),
+                         key=lambda p: p.name)
+        for p in victims[:max(n_evict, 0)]:
+            try:
+                self.api.delete("Pod", p.namespace, p.name)
+            except NotFound:
+                self.noop += 1
+            else:
+                self.evicted += 1
+        # completion is judged on THIS step's pre-action observation: the
+        # step after the last eviction sees an empty old set and every
+        # replacement bound
+        done = not old and self._created >= cfg.replicas \
+            and new_bound >= cfg.replicas
+        if done and self.completed_at is None:
+            self.completed_at = time.monotonic()
+        return done
+
+    def bounds_report(self) -> Dict[str, object]:
+        cfg = self.cfg
+        return {
+            "replicas": cfg.replicas,
+            "max_surge": cfg.max_surge,
+            "max_unavailable": cfg.max_unavailable,
+            "max_total_seen": int(self.max_total_seen),
+            "min_available_seen": int(self.min_available_seen),
+            "surge_respected":
+                bool(self.max_total_seen <= cfg.replicas + cfg.max_surge),
+            "unavailable_respected":
+                bool(self.min_available_seen
+                     >= cfg.replicas - cfg.max_unavailable),
+            "evicted": int(self.evicted),
+            "created": int(self._created),
+        }
+
+    def run_thread(self, stop: threading.Event,
+                   poll_s: float = 0.01) -> threading.Thread:
+        """Wall-clock driver for the bench: steps the controller until
+        the update completes or ``stop`` is set."""
+
+        def _run():
+            while not stop.is_set():
+                if self.step():
+                    break
+                stop.wait(poll_s)
+
+        th = threading.Thread(target=_run, daemon=True)
+        th.start()
+        return th
+
+
 # ----------------------------------------------------- store-truth audits
 
 
@@ -450,5 +602,7 @@ def audit_cache_vs_store(sched, api) -> List[str]:
 
 
 __all__ = ["ChurnConfig", "ChurnInjector", "ChurnOp", "FaultyBindApi",
+           "RollingUpdateConfig", "RollingUpdateDriver",
            "audit_cache_vs_store", "audit_store_transitions",
-           "extender_store_binder", "make_churn_schedule", "ZONES"]
+           "diurnal_rate", "extender_store_binder", "make_churn_schedule",
+           "ZONES"]

@@ -26,8 +26,10 @@ def test_arrival_stream_distribution_is_not_degenerate():
     out = bench.run_arrival(200, rate=300, duration_s=3, warm=True,
                             min_quantum=64, max_quantum=256)
     assert out["bound"] == 900
-    # intervals now attribute binds at their bind instants — exact count
-    assert sum(out["intervals"]) == 900
+    # intervals attribute binds at their bind instants over FULL buckets;
+    # the trailing partial remainder rides separately (ISSUE 18) — the
+    # exact count telescopes across both
+    assert sum(out["intervals"]) + out["tail_partial"]["binds"] == 900
     assert out["sustained_pods_s"] > 0
     assert out["p50_ms"] < out["p99_ms"], \
         "per-pod create->bound must be a real distribution"
@@ -40,10 +42,42 @@ def test_arrival_stream_distribution_is_not_degenerate():
     # the ISSUE 7 per-interval honesty plumbing: offered/backlog series
     # aligned with the bind intervals, creator self-audit present
     assert len(out["backlog_series"]) == len(out["intervals"])
-    assert sum(out["offered_series"]) == 900
+    assert sum(out["offered_series"]) + out["tail_partial"]["offered"] \
+        == 900
     assert out["offered_realized_pods_s"] > 0
     assert isinstance(out["creator_jitter_ok"], bool)
     assert out["creator_max_burst"] >= 1
     # latency is creator-stamped per pod: honest distributions never report
     # a p50 of zero while pods bound
     assert out["p50_ms"] > 0
+
+
+def test_interval_series_drops_trailing_partial_bucket():
+    """The BENCH_r19 skew (ISSUE 18): a 19-pod sliver in a fractional
+    final bucket next to 1322-pod steady buckets read as a rate collapse.
+    interval_series must emit FULL buckets only, route the remainder to
+    tail_partial with its true width, and telescope exactly."""
+    binds = [(0.2, ["a"] * 100), (1.3, ["b"] * 100), (2.4, ["c"] * 100),
+             (3.05, ["d"] * 19)]          # 3.05s end -> partial 4th bucket
+    creates = [(0.1, 160), (1.1, 159)]
+    backlog = [(0.5, 40), (1.5, 10), (3.02, 3)]
+    iv, off, bk, tail = bench.interval_series(binds, creates, backlog,
+                                              interval_s=1.0)
+    assert iv == [100, 100, 100]          # full buckets only
+    assert tail["binds"] == 19            # the sliver, out of the series
+    assert abs(tail["width_s"] - 0.05) < 1e-9
+    assert sum(iv) + tail["binds"] == 319
+    assert off == [160, 159, 0] and tail["offered"] == 0
+    assert bk == [40, 10, 0] and tail["backlog"] == 3
+
+    # boundary case: a final event exactly ON a bucket edge opens a
+    # zero-width tail (the bucket it starts is empty of time) — every
+    # bucket in the series is still exactly interval_s wide
+    iv2, _off2, _bk2, tail2 = bench.interval_series(
+        [(0.5, ["x"] * 5), (2.0, ["y"] * 5)], [(0.1, 10)], [], 1.0)
+    assert iv2 == [5, 0] and tail2["binds"] == 5 and tail2["width_s"] == 0.0
+
+    # degenerate: everything inside one partial first bucket
+    iv3, _o3, _b3, tail3 = bench.interval_series(
+        [(0.2, ["x"])], [(0.1, 1)], [], 1.0)
+    assert iv3 == [] and tail3["binds"] == 1

@@ -470,3 +470,78 @@ def test_frozen_churn_trace_streaming_equals_pipelined():
     assert not diff, diff
     assert all(v for v in pa.values()), \
         [k for k, v in pa.items() if not v]
+
+
+# ------------------------------------------------------- rolling updates
+
+
+def test_rolling_update_respects_bounds_and_binds_exactly_once():
+    """Deployment-shaped rolling update (ISSUE 18): the evict-and-
+    recreate controller stepped deterministically against store truth,
+    scheduler drained between steps. The surge bound (never more than
+    replicas + max_surge pods of the app), the availability bound
+    (never fewer than replicas - max_unavailable bound pods), full
+    completion, and the store-truth exactly-once audit (every
+    replacement bound exactly once, zero ghost residue in the cache)
+    must all hold."""
+    from kubernetes_tpu.testing.churn import (
+        RollingUpdateConfig,
+        RollingUpdateDriver,
+        audit_cache_vs_store,
+        audit_store_transitions,
+    )
+
+    replicas, surge, unavail = 12, 3, 3
+    api = ApiServerLite()
+    load_cluster(api, mk_nodes(8), [])
+    s = Scheduler(api, record_events=False)
+    s.start()
+
+    def web_pod(rev, i):
+        return make_pod(f"web-{rev}-{i:03d}", cpu=100,
+                        memory=128 << 20,
+                        labels={"app": "web", "rev": rev})
+
+    for i in range(replicas):
+        api.create("Pod", web_pod("1", i))
+    assert s.run_until_drained()["bound"] == replicas
+
+    cfg = RollingUpdateConfig(replicas=replicas, max_surge=surge,
+                              max_unavailable=unavail)
+    driver = RollingUpdateDriver(api, cfg, lambda i: web_pod("2", i))
+    steps = 0
+    while not driver.step():
+        s.run_until_drained()
+        steps += 1
+        assert steps < 60, f"rolling update did not converge: " \
+            f"{driver.bounds_report()}"
+    rep = driver.bounds_report()
+    assert rep["surge_respected"], rep
+    assert rep["unavailable_respected"], rep
+    # a bounded update is necessarily multi-step: with surge=3 it takes
+    # at least replicas/surge controller passes
+    assert steps >= replicas // surge, (steps, rep)
+    assert rep["evicted"] == replicas and rep["created"] == replicas
+    # end state: only new-revision pods, all bound
+    pods = api.list("Pod")[0]
+    web = [p for p in pods if p.labels.get("app") == "web"]
+    assert len(web) == replicas
+    assert all(p.labels["rev"] == "2" and p.node_name for p in web)
+    # store-truth audits: every replacement bound exactly once, zero
+    # ghost residue in the scheduler cache
+    trans = audit_store_transitions(api)
+    repl = {k for k in driver.replacement_keys}
+    assert all(trans["binds"].get(k, 0) == 1 for k in repl), trans["binds"]
+    assert audit_cache_vs_store(s, api) == []
+
+
+def test_diurnal_rate_curve_shape():
+    from kubernetes_tpu.testing.churn import diurnal_rate
+
+    rate = diurnal_rate(1000.0, amp=0.5, period_s=60.0)
+    assert abs(rate(0.0) - 1000.0) < 1e-6          # mean at phase 0
+    assert abs(rate(15.0) - 1500.0) < 1e-6         # peak at quarter period
+    assert abs(rate(45.0) - 500.0) < 1e-6          # trough at 3/4 period
+    # never negative, even at amp > 1
+    deep = diurnal_rate(100.0, amp=1.5, period_s=10.0)
+    assert min(deep(t / 10.0) for t in range(100)) >= 0.0

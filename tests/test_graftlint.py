@@ -256,6 +256,52 @@ def test_gl002_registry_covers_streaming_pop_seam(tmp_path):
         findings
 
 
+def test_gl002_registry_covers_hostcheck_static_column_seam(tmp_path):
+    """ISSUE 18: host-check classes ride the wave via a precomputed
+    `host_fit` [C, N] column ANDed inside the fused static eval
+    (ops/predicates.static_fits, entered through waves.precompute_jit).
+    The column is built host-side from label truth and uploaded frozen —
+    the registry built over the REAL waves.py must extend GL002 taint to
+    a consumer feeding the host_fit-bearing class dict, because an
+    unblessed fetch at this seam would serialize every host-check wave
+    (exactly the flush this PR removed, reintroduced as a hidden
+    sync)."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    waves_py = os.path.join(PKG_DIR, "engine", "waves.py")
+    with open(waves_py, "r", encoding="utf-8") as fh:
+        index = ProjectIndex()
+        index.scan(ast.parse(fh.read()))
+    assert "precompute_jit" in index.jitted_names, \
+        "host-check static-column entry missing from the jit registry"
+    fixture = tmp_path / "host_column.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.engine.waves import precompute_jit
+
+        def eval_host_static_chunk(cls, nodes, host_rows, priorities):
+            cls = dict(cls, host_fit=host_rows)  # frozen label column
+            pre = precompute_jit(cls, nodes, priorities=priorities)
+            return np.asarray(pre["static_fit"])
+    """))
+    findings, _sup, errors = run_paths([waves_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "eval_host_static_chunk" in f.context
+               for f in findings), findings
+    # the blessed form (the dispatch's documented fetch point) is silent
+    fixture.write_text(fixture.read_text().replace(
+        'return np.asarray(pre["static_fit"])',
+        'return np.asarray(pre["static_fit"])  # graftlint: sync-ok'))
+    findings, _sup, errors = run_paths([waves_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings
+                if "eval_host_static_chunk" in f.context], findings
+
+
 def test_gl002_registry_covers_batched_extender_eval(tmp_path):
     """ISSUE 9: the coalesced multi-frontend eval adds a jitted entry
     point (scheduler_engine._fused_eval_batch_jit, the [C, N] sibling of

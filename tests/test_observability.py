@@ -128,7 +128,8 @@ def test_perfetto_export_lanes_and_overlap(flight, tmp_path):
     assert loaded["traceEvents"]
     lanes = {m["args"]["name"] for m in loaded["traceEvents"]
              if m.get("ph") == "M" and m["name"] == "thread_name"}
-    assert lanes == {"host", "device", "fence", "preempt"}
+    # fastlane joined the fixed lane set in r19 (ISSUE 17)
+    assert lanes == {"host", "device", "fence", "preempt", "fastlane"}
     tids = {"host": None, "device": None}
     for m in trace["traceEvents"]:
         if m.get("ph") == "M" and m["name"] == "thread_name" \

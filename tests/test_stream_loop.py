@@ -232,8 +232,9 @@ def test_arrival_smoke_sustains_offered_rate():
                             min_quantum=64, max_quantum=256,
                             budget_ms=500.0)
     assert out["bound"] == 600 and out["unbound"] == 0
-    assert sum(out["intervals"]) == 600
-    assert sum(out["offered_series"]) == 600
+    assert sum(out["intervals"]) + out["tail_partial"]["binds"] == 600
+    assert sum(out["offered_series"]) + out["tail_partial"]["offered"] \
+        == 600
     # sustained >= offered: the loop kept up INSIDE the offer window
     # (tolerance for interval-edge rounding on a 2-bucket window)
     assert out["sustained_pods_s"] >= 0.95 * out["offered_pods_s"], out
