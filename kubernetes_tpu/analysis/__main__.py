@@ -28,8 +28,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kubernetes_tpu.analysis",
         description="graftlint: AST hazard analysis for the JAX hot path "
-                    "(GL001 aliasing, GL002 host-sync, GL003 recompile, "
-                    "GL004 tracer leak, GL005 generation discipline)")
+                    "and the concurrency discipline (GL001 aliasing, "
+                    "GL002 host-sync, GL003 recompile, GL004 tracer leak, "
+                    "GL005 generation discipline, GL006 lock order, "
+                    "GL007 torn read/write, GL008 event-loop blocking, "
+                    "GL009 spawn safety)")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the "
                          "kubernetes_tpu package directory)")
@@ -86,12 +89,16 @@ def main(argv=None) -> int:
         return 1 if errors else 0
 
     if args.json:
+        by_rule = {rid: 0 for rid in (rules or RULE_IDS)}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         print(json.dumps({
             "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
                           "col": f.col, "context": f.context,
                           "message": f.message,
                           "fingerprint": f.fingerprint()}
                          for f in findings],
+            "by_rule": by_rule,
             "baseline_suppressed": n_sup,
             "parse_errors": errors}, indent=2))
     else:

@@ -106,7 +106,14 @@ def run_paths(paths: Sequence[str],
             errors.append(f"{f}: {e}")
             continue
         contexts.append(ctx)
-        index.scan(ctx.tree)
+        index.scan(ctx.tree, ctx.path)
+    # pass 1.5: project-wide rule state (GL006's lock graph) — built over
+    # the FULL set before any per-file check runs, so cross-file cycles
+    # fire at every participating site
+    for mod in ALL_RULES:
+        prep = getattr(mod, "prepare", None)
+        if prep is not None and mod.RULE in want:
+            prep(contexts, index)
 
     findings: List[Finding] = []
     suppressed = 0

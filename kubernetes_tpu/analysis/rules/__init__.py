@@ -1,6 +1,8 @@
-"""graftlint rule registry: GL001-GL005, one module each.
+"""graftlint rule registry: GL001-GL009, one module each.
 
-A rule module exports `RULE` (the id) and `check(ctx, index) -> [Finding]`.
+A rule module exports `RULE` (the id) and `check(ctx, index) -> [Finding]`,
+plus an optional `prepare(contexts, index)` hook run after pass 1 over the
+WHOLE linted set (GL006 uses it to build the project-wide lock graph).
 The engine (analysis/lint.py) applies pragma suppression and baselines;
 rules only report.
 """
@@ -11,6 +13,10 @@ from kubernetes_tpu.analysis.rules import (  # noqa: F401
     gl003_recompile,
     gl004_tracer,
     gl005_generation,
+    gl006_lockorder,
+    gl007_tornread,
+    gl008_blockloop,
+    gl009_spawnsafety,
 )
 from kubernetes_tpu.analysis.rules.base import (  # noqa: F401
     FileContext,
@@ -19,7 +25,8 @@ from kubernetes_tpu.analysis.rules.base import (  # noqa: F401
 )
 
 ALL_RULES = (gl001_aliasing, gl002_hostsync, gl003_recompile,
-             gl004_tracer, gl005_generation)
+             gl004_tracer, gl005_generation, gl006_lockorder,
+             gl007_tornread, gl008_blockloop, gl009_spawnsafety)
 
 RULE_IDS = tuple(m.RULE for m in ALL_RULES)
 
@@ -31,4 +38,12 @@ CATALOG = {
              "shapes into a jitted call in a loop",
     "GL004": "tracer leak: host state mutated inside a traced scope",
     "GL005": "snapshot dynamic-row write without dirty/generation bump",
+    "GL006": "lock-order cycle / self-deadlock over the project-wide "
+             "acquisition graph (declare with lock-order(...))",
+    "GL007": "torn read/write: lock-guarded field accessed outside "
+             "any lock region",
+    "GL008": "blocking call (sleep, threading lock, socket op, device "
+             "sync) on an asyncio event-loop thread",
+    "GL009": "spawn-unsafe Process target: closure/bound-method "
+             "entrypoint or module-global mutable/lock/device capture",
 }

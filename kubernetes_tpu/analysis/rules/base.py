@@ -28,6 +28,10 @@ SUPPRESS_TAGS = {
     "GL003": "recompile-ok",
     "GL004": "tracer-ok",
     "GL005": "gen-ok",
+    "GL006": "lock-ok",
+    "GL007": "torn-ok",
+    "GL008": "block-ok",
+    "GL009": "spawn-ok",
 }
 
 # WaveHandle fields documented as un-fetched DEVICE arrays: touching one
@@ -57,6 +61,30 @@ SYNC_BUILTINS = frozenset({"float", "int", "bool"})
 TRACE_CONSUMERS = frozenset({"while_loop", "scan", "cond", "fori_loop",
                              "switch", "vmap", "grad", "checkpoint",
                              "remat"})
+
+# lock constructors the concurrency family (GL006-GL009) recognizes:
+# the raw threading primitives AND the lockcheck factories the shipped
+# tree uses (analysis/lockcheck.py — same object either way, plus the
+# tsan-lite instrumentation under GRAFT_LOCKCHECK=1). kind matters:
+# re-acquiring a non-reentrant "lock" on the same object is a provable
+# self-deadlock; "rlock"/"condition" are reentrant.
+LOCK_CTORS = {
+    "threading.Lock": "lock", "Lock": "lock",
+    "threading.RLock": "rlock", "RLock": "rlock",
+    "threading.Condition": "condition", "Condition": "condition",
+    "lockcheck.make_lock": "lock", "make_lock": "lock",
+    "lockcheck.make_rlock": "rlock", "make_rlock": "rlock",
+    "lockcheck.make_condition": "condition", "make_condition": "condition",
+}
+
+# container/ndarray methods that mutate the receiver in place — the
+# write half of GL007's guarded-field accounting (MUTATOR_METHODS is the
+# ndarray subset GL001/GL005 already key on)
+MUTATING_METHODS = MUTATOR_METHODS | frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "update", "setdefault", "add",
+    "discard", "move_to_end",
+})
 
 
 @dataclasses.dataclass
@@ -92,13 +120,32 @@ class ProjectIndex:
         # itself is a traced scope for GL004 even though callers go through
         # the wrapper name)
         self.traced_defs: Set[str] = set()
+        # class name -> {attr: lock kind} for every class in the linted set
+        # that binds a threading/lockcheck primitive to self.<attr> (or a
+        # class-level attr). Lock IDs are "<ClassName>.<attr>" — the same
+        # spelling the lockcheck factories are handed at the call sites,
+        # so the static graph and the runtime checker speak one namespace.
+        self.lock_classes: Dict[str, Dict[str, str]] = {}
+        # module-level locks: "<module id>.<name>" -> kind
+        self.module_locks: Dict[str, str] = {}
+        # GL006 project-wide state, filled by gl006_lockorder.prepare():
+        # observed edges (a, b) -> [(path, qualname, b-site line)] meaning
+        # lock b was acquired while a was held; declared edges from
+        # `# graftlint: lock-order(a,b,...)` pragmas -> declaration site.
+        self.lock_edges: Dict[Tuple[str, str], List[Tuple[str, str, int]]] \
+            = {}
+        self.lock_decls: Dict[Tuple[str, str], str] = {}
 
-    def scan(self, tree: ast.Module) -> None:
+    def scan(self, tree: ast.Module, path: Optional[str] = None) -> None:
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if any(_is_jit_expr(d) for d in node.decorator_list):
                     self.jitted_names.add(node.name)
                     self.traced_defs.add(node.name)
+            elif isinstance(node, ast.ClassDef):
+                attrs = class_lock_attrs(node)
+                if attrs:
+                    self.lock_classes.setdefault(node.name, {}).update(attrs)
         for stmt in tree.body:
             if isinstance(stmt, ast.Assign) and _is_jit_expr(stmt.value):
                 for t in stmt.targets:
@@ -109,6 +156,13 @@ class ProjectIndex:
                     for a in call.args:
                         if isinstance(a, ast.Name):
                             self.traced_defs.add(a.id)
+            elif isinstance(stmt, ast.Assign):
+                kind = lock_ctor_kind(stmt.value)
+                if kind is not None:
+                    mod = module_id(path) if path else "<module>"
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks[f"{mod}.{t.id}"] = kind
 
 
 class FileContext:
@@ -344,3 +398,69 @@ def functions_of(tree: ast.Module) -> Iterator[ast.AST]:
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             yield node
+
+
+# ------------------------------------------------------------ lock helpers
+
+
+def lock_ctor_kind(node: ast.AST) -> Optional[str]:
+    """"lock" / "rlock" / "condition" when `node` is a call to a known
+    lock constructor (threading primitive or lockcheck factory), else
+    None."""
+    if isinstance(node, ast.Call):
+        fn = dotted(node.func)
+        if fn in LOCK_CTORS:
+            return LOCK_CTORS[fn]
+    return None
+
+
+def class_lock_attrs(klass: ast.ClassDef) -> Dict[str, str]:
+    """attr -> lock kind for every `self.<attr> = <lock ctor>` (or
+    class-level `<attr> = <lock ctor>`) binding inside the class body."""
+    attrs: Dict[str, str] = {}
+    for node in ast.walk(klass):
+        if not isinstance(node, ast.Assign):
+            continue
+        kind = lock_ctor_kind(node.value)
+        if kind is None:
+            continue
+        for t in node.targets:
+            p = dotted(t)
+            if p is not None and p.startswith("self.") and p.count(".") == 1:
+                attrs[p.split(".", 1)[1]] = kind
+            elif isinstance(t, ast.Name):
+                attrs[t.id] = kind
+    return attrs
+
+
+def module_id(path: str) -> str:
+    """A short dotted module id for lock naming: the file path with the
+    extension, path separators and any leading `kubernetes_tpu.` prefix
+    folded away (`kubernetes_tpu/api/pb/__init__.py` -> `api.pb`). Files
+    outside a package tree reduce to their stem (`snippet.py` ->
+    `snippet`)."""
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [c for c in p.split("/") if c not in ("", ".", "..")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if parts and parts[0] == "kubernetes_tpu":
+        parts = parts[1:]
+    return ".".join(parts[-3:]) if parts else "<module>"
+
+
+def walk_shallow(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk `fn`'s body WITHOUT descending into nested function/lambda
+    bodies — their statements run on some other call stack (an executor
+    hop, a callback), so they must not be attributed to `fn`'s own
+    execution context (GL008's whole point is WHICH thread runs a
+    statement)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

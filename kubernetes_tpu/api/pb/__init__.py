@@ -8,13 +8,14 @@ from __future__ import annotations
 import os
 import subprocess
 import threading
+from kubernetes_tpu.analysis import lockcheck
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(os.path.dirname(_HERE))
 _PROTO_DIR = os.path.join(os.path.dirname(_ROOT), "proto")
 _GEN = os.path.join(_HERE, "ktpb_pb2.py")
 
-_lock = threading.Lock()
+_lock = lockcheck.make_lock("api.pb._lock")
 _mod = None
 _tried = False
 

@@ -24,6 +24,7 @@ lives in tensors refreshed from the Store's generation counters.
 from __future__ import annotations
 
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
@@ -45,7 +46,7 @@ class Store:
 
     def __init__(self, key_func: Callable[[Any], str] = meta_namespace_key):
         self._key = key_func
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("Store._lock")
         self._items: Dict[str, Any] = {}
         # index name -> (index_func, value -> set of keys)
         self._indexers: Dict[str, Callable[[Any], List[str]]] = {}
@@ -60,7 +61,8 @@ class Store:
                     idx.setdefault(v, set()).add(key)
             self._indices[name] = idx
 
-    def _update_index(self, key: str, old: Any, new: Any) -> None:
+    def _update_index_locked(self, key: str, old: Any, new: Any) -> None:
+        lockcheck.assert_held(self._lock, "_update_index_locked")
         for name, fn in self._indexers.items():
             idx = self._indices[name]
             old_vals = set(fn(old)) if old is not None else set()
@@ -80,7 +82,7 @@ class Store:
         with self._lock:
             old = self._items.get(key)
             self._items[key] = obj
-            self._update_index(key, old, obj)
+            self._update_index_locked(key, old, obj)
             return old
 
     def remove(self, obj: Any) -> Optional[Any]:
@@ -88,7 +90,7 @@ class Store:
         with self._lock:
             old = self._items.pop(key, None)
             if old is not None:
-                self._update_index(key, old, None)
+                self._update_index_locked(key, old, None)
             return old
 
     def replace(self, objs: List[Any]) -> Tuple[List[Any], List[Any], List[Tuple[Any, Any]]]:
@@ -151,7 +153,7 @@ class SharedInformer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._rv = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("SharedInformer._lock")
 
     def add_event_handler(self, on_add=None, on_update=None, on_delete=None) -> None:
         """Late handlers get synthetic ADDs for current contents, like
@@ -236,7 +238,7 @@ class SharedInformerFactory:
     def __init__(self, api: ApiServerLite):
         self.api = api
         self._informers: Dict[str, SharedInformer] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("SharedInformerFactory._lock")
         self._started = False
         self._poll = 0.05
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Tuple
@@ -48,7 +49,7 @@ class EventRecorder:
         self.api = api
         self.source = source
         self._now = now
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("EventRecorder._lock")
         self._seq = 0
         # (involved_key, reason, message) -> stored event name, for dedup
         self._names: Dict[Tuple[str, str, str], str] = {}

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from typing import Any, Callable, Hashable, List, Optional
 
@@ -29,7 +30,7 @@ class WorkQueue:
     """Deduplicating FIFO with in-flight tracking (workqueue/queue.go)."""
 
     def __init__(self, now: Callable[[], float] = time.monotonic):
-        self._lock = threading.Condition()
+        self._lock = lockcheck.make_condition("WorkQueue._lock")
         self._queue: List[Hashable] = []
         self._dirty: set = set()
         self._processing: set = set()
@@ -89,7 +90,7 @@ class ItemExponentialFailureRateLimiter:
         self.base = base
         self.max_delay = max_delay
         self._failures: dict = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("ItemExponentialFailureRateLimiter._lock")
 
     def when(self, item: Hashable) -> float:
         with self._lock:
@@ -186,7 +187,7 @@ def parallelize(workers: int, pieces: int, do_work: Callable[[int], Any]) -> Non
             do_work(i)
         return
     counter = iter(range(pieces))
-    lock = threading.Lock()
+    lock = lockcheck.make_lock("parallelize.lock")
     errors: List[BaseException] = []
 
     def run():

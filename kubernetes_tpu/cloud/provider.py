@@ -13,6 +13,7 @@ observe (zone layout, LB naming, route semantics)."""
 from __future__ import annotations
 
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -114,7 +115,7 @@ class FakeCloud(CloudProvider):
     provider_name = "fake"
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("FakeCloud._lock")
         self.instances: Dict[str, List[str]] = {}
         self.zones: Dict[str, Tuple[str, str]] = {}
         self.balancers: Dict[str, LoadBalancerStatus] = {}
@@ -141,12 +142,14 @@ class FakeCloud(CloudProvider):
         self.zones[name] = (zone, region)
 
     def node_addresses(self, node_name: str) -> List[str]:
-        self.calls.append("node-addresses")
-        return self.instances.get(node_name, [])
+        with self._lock:
+            self.calls.append("node-addresses")
+            return self.instances.get(node_name, [])
 
     def instance_exists(self, node_name: str) -> bool:
-        self.calls.append("instance-exists")
-        return node_name in self.instances
+        with self._lock:
+            self.calls.append("instance-exists")
+            return node_name in self.instances
 
     # Zones
     def has_zones(self) -> bool:
@@ -186,15 +189,18 @@ class FakeCloud(CloudProvider):
         return True
 
     def list_routes(self):
-        return list(self.routes.values())
+        with self._lock:
+            return list(self.routes.values())
 
     def create_route(self, route: Route) -> None:
-        self.calls.append("create-route")
-        self.routes[route.name] = route
+        with self._lock:
+            self.calls.append("create-route")
+            self.routes[route.name] = route
 
     def delete_route(self, name: str) -> None:
-        self.calls.append("delete-route")
-        self.routes.pop(name, None)
+        with self._lock:
+            self.calls.append("delete-route")
+            self.routes.pop(name, None)
 
     # Disks
     def has_disks(self) -> bool:
@@ -211,7 +217,8 @@ class FakeCloud(CloudProvider):
         label admission stamps onto PVs. None for a disk this cloud never
         created (the reference plugin errors rather than fabricate a
         zone)."""
-        return self.disk_zones.get(volume_id)
+        with self._lock:
+            return self.disk_zones.get(volume_id)
 
     def delete_disk(self, volume_id: str) -> None:
         with self._lock:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -61,7 +62,7 @@ class SchedulingQueue:
 
     def __init__(self, now: Callable[[], float] = time.monotonic):
         self._now = now
-        self._lock = threading.Condition()
+        self._lock = lockcheck.make_condition("SchedulingQueue._lock")
         self._fifo: List[Pod] = []
         self._keys: Dict[str, Pod] = {}
         self._deferred: List = []  # heap of (ready_time, seq, pod)
@@ -210,7 +211,7 @@ class SchedulingQueue:
         deadline = None if wait is None else self._now() + wait
         with self._lock:
             while True:
-                self._promote_ready()
+                self._promote_ready_locked()
                 if self._fast and not self._fifo:
                     # a fast-tier arrival must not sit out a bulk
                     # blocking wait: return empty so the streaming loop
@@ -285,10 +286,11 @@ class SchedulingQueue:
 
     def ready_count(self) -> int:
         with self._lock:
-            self._promote_ready()
+            self._promote_ready_locked()
             return len(self._fifo) + len(self._fast)
 
-    def _promote_ready(self) -> None:
+    def _promote_ready_locked(self) -> None:
+        lockcheck.assert_held(self._lock, "_promote_ready_locked")
         now = self._now()
         while self._deferred and self._deferred[0][0] <= now:
             _, _, pod = heapq.heappop(self._deferred)

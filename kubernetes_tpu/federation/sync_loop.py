@@ -32,6 +32,7 @@ watch event alone (tests/test_federation_watch.py)."""
 from __future__ import annotations
 
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from typing import Dict, Optional, Tuple
 
 from kubernetes_tpu.client.informer import SharedInformerFactory
@@ -71,7 +72,7 @@ class FederationSyncLoop:
         self.queue = WorkQueue()
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._pump_lock = threading.Lock()  # worker and test-hook pump()
+        self._pump_lock = lockcheck.make_lock("FederationSyncLoop._pump_lock")  # worker and test-hook pump()
         # share one body; serialized so sync bodies never interleave
         self.rs_ctrl = FederatedReplicaSetController(plane)
         self.deploy_ctrl = FederatedDeploymentController(plane)

@@ -14,6 +14,7 @@ import ctypes
 import os
 import subprocess
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from typing import Optional
 
 import numpy as np
@@ -23,7 +24,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _SRC = os.path.join(_ROOT, "native", "hostops.cc")
 _SO = os.path.join(_ROOT, "native", "libhostops.so")
 
-_lock = threading.Lock()
+_lock = lockcheck.make_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

@@ -13,6 +13,7 @@ fleet-wide), so one HollowProxy instance serves the whole hollow cluster.
 from __future__ import annotations
 
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.client.informer import SharedInformerFactory
@@ -25,7 +26,7 @@ class HollowProxy:
     def __init__(self, factory: SharedInformerFactory):
         self.svc_informer = factory.informer("Service")
         self.eps_informer = factory.informer("Endpoints")
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("HollowProxy._lock")
         self._table: Dict[str, List[Backend]] = {}
         self._local_counts: Dict[str, Dict[str, int]] = {}
         self._rr: Dict[str, int] = {}

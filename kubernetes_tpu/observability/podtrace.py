@@ -82,6 +82,7 @@ from __future__ import annotations
 
 import os
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 import zlib
 from typing import Dict, List, Optional, Sequence
@@ -238,7 +239,7 @@ class PodTracer:
         self.max_events = max(int(max_events), 8)
         self.enabled = False
         self._now = now
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("PodTracer._lock")
         self._live: Dict[str, List[tuple]] = {}
         self._done: set = set()         # completed this window (dup audit)
         self._seq = 0

@@ -75,6 +75,7 @@ from __future__ import annotations
 
 import os
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from typing import Dict, List
 
@@ -123,7 +124,7 @@ class FlightRecorder:
             capacity = int(os.environ.get("GRAFT_FLIGHT_CAPACITY", 65536))
         self.capacity = max(int(capacity), 8)
         self.enabled = False
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("FlightRecorder._lock")
         self._kind = np.zeros(self.capacity, dtype=np.int8)
         self._wave = np.zeros(self.capacity, dtype=np.int64)
         self._t0 = np.zeros(self.capacity, dtype=np.float64)

@@ -39,6 +39,7 @@ accumulating.
 from __future__ import annotations
 
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.observability.podtrace import TRACER
@@ -69,7 +70,7 @@ class TelemetryRegistry:
         # races a scrape's iteration — dict-changed-size mid-snapshot);
         # provider fns are called OUTSIDE it, so a slow source can never
         # block registration and the per-source lock discipline holds.
-        self._reg_lock = threading.Lock()
+        self._reg_lock = lockcheck.make_lock("TelemetryRegistry._reg_lock")
         self._metrics: Dict[str, object] = {}
         self._counters: Dict[str, Tuple[Callable[[], Dict[str, int]],
                                         Optional[str]]] = {}

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import os
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from typing import Dict, List, Optional
 
@@ -90,7 +91,7 @@ class SLOMonitor:
         self._bad = np.zeros(n, dtype=np.int64)
         self._hist = np.zeros((n, len(self._edges) + 1), dtype=np.int64)
         self._epoch = np.full(n, -1, dtype=np.int64)  # bucket epoch held
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("SLOMonitor._lock")
         self.alert = False
         self.alerts_total = 0
 

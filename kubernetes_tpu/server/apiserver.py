@@ -23,6 +23,7 @@ exactly like the reference; the TPU fabric is the engine's device arrays.
 from __future__ import annotations
 
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -187,7 +188,7 @@ class ApiServer:
         self.audit_policy = audit_policy if audit_policy is not None \
             else AuditPolicy()
         self._now = now
-        self._audit_lock = threading.Lock()
+        self._audit_lock = lockcheck.make_lock("ApiServer._audit_lock")
         self._inflight = threading.Semaphore(400)  # --max-requests-inflight
 
     # ---------------------------------------------------------------- setup

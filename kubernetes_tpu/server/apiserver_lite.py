@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -66,7 +67,7 @@ class ApiServerLite:
         (server/durable.py — the etcd role, etcd3/store.go:85) and restores
         state on construction. Watchers resuming with a pre-restart rv get
         TooOldResourceVersion and must relist, like an etcd compaction."""
-        self._lock = threading.Condition()
+        self._lock = lockcheck.make_condition("ApiServerLite._lock")
         self._objects: Dict[_KEY, Any] = {}
         self._rv = 0
         self._log: List[WatchEvent] = []
@@ -92,8 +93,8 @@ class ApiServerLite:
             self._rv += 1
             obj.resource_version = self._rv
             self._objects[key] = obj
-            self._append(WatchEvent("ADDED", kind, obj, self._rv))
-            self._persist_put(key, obj)
+            self._append_locked(WatchEvent("ADDED", kind, obj, self._rv))
+            self._persist_put_locked(key, obj)
             return self._rv
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -122,8 +123,8 @@ class ApiServerLite:
             self._rv += 1
             obj.resource_version = self._rv
             self._objects[key] = obj
-            self._append(WatchEvent("MODIFIED", kind, obj, self._rv))
-            self._persist_put(key, obj)
+            self._append_locked(WatchEvent("MODIFIED", kind, obj, self._rv))
+            self._persist_put_locked(key, obj)
             return self._rv
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -133,11 +134,11 @@ class ApiServerLite:
             if obj is None:
                 raise NotFound(str(key))
             self._rv += 1
-            self._append(WatchEvent("DELETED", kind, obj, self._rv))
+            self._append_locked(WatchEvent("DELETED", kind, obj, self._rv))
             if self._durable is not None:
                 self._durable.delete(key, self._rv)
                 self._durable.flush()
-                self._maybe_compact()
+                self._maybe_compact_locked()
 
     # ------------------------------------------------------------- binding
 
@@ -207,7 +208,7 @@ class ApiServerLite:
                 self._rv = rv
             if durable is not None:
                 durable.flush()
-                self._maybe_compact()
+                self._maybe_compact_locked()
             if len(log) > self._max_log:
                 drop = len(log) - self._max_log
                 self._log = log[drop:]
@@ -269,8 +270,8 @@ class ApiServerLite:
                 self._rv += 1
                 new.resource_version = self._rv
                 self._objects[key] = new
-                self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
-                self._persist_put(key, new)
+                self._append_locked(WatchEvent("MODIFIED", "Pod", new, self._rv))
+                self._persist_put_locked(key, new)
             if bind_needed:
                 new = mk(Pod)
                 new.__dict__.update(target.__dict__)
@@ -278,8 +279,8 @@ class ApiServerLite:
                 self._rv += 1
                 new.resource_version = self._rv
                 self._objects[bkey] = new
-                self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
-                self._persist_put(bkey, new)
+                self._append_locked(WatchEvent("MODIFIED", "Pod", new, self._rv))
+                self._persist_put_locked(bkey, new)
             self._lock.notify_all()
             return None
 
@@ -299,8 +300,8 @@ class ApiServerLite:
         self._rv += 1
         new.resource_version = self._rv
         self._objects[key] = new
-        self._append(WatchEvent("MODIFIED", "Pod", new, self._rv))
-        self._persist_put(key, new)
+        self._append_locked(WatchEvent("MODIFIED", "Pod", new, self._rv))
+        self._persist_put_locked(key, new)
         return self._rv
 
     # --------------------------------------------------------------- watch
@@ -318,10 +319,10 @@ class ApiServerLite:
                     raise TooOldResourceVersion(
                         f"requested rv {from_rv}, log starts at rv "
                         f"{self._log[0].rv if self._log else self._log_start_rv}")
-            evs = self._collect(kinds, from_rv)
+            evs = self._collect_locked(kinds, from_rv)
             if not evs and timeout:
                 self._lock.wait(timeout)
-                evs = self._collect(kinds, from_rv)
+                evs = self._collect_locked(kinds, from_rv)
             return evs
 
     def current_rv(self) -> int:
@@ -330,14 +331,16 @@ class ApiServerLite:
 
     # --------------------------------------------------------- durability
 
-    def _persist_put(self, key: _KEY, obj: Any) -> None:
+    def _persist_put_locked(self, key: _KEY, obj: Any) -> None:
         """Called under the lock after a state mutation + event append."""
+        lockcheck.assert_held(self._lock, "_persist_put_locked")
         if self._durable is not None:
             self._durable.put(key, obj, self._rv)
             self._durable.flush()
-            self._maybe_compact()
+            self._maybe_compact_locked()
 
-    def _maybe_compact(self) -> None:
+    def _maybe_compact_locked(self) -> None:
+        lockcheck.assert_held(self._lock, "_maybe_compact_locked")
         if self._durable.should_compact():
             self._durable.compact(self._objects, self._rv)
 
@@ -355,13 +358,15 @@ class ApiServerLite:
 
     # ------------------------------------------------------------ internals
 
-    def _collect(self, kinds: Tuple[str, ...], from_rv: int) -> List[WatchEvent]:
+    def _collect_locked(self, kinds: Tuple[str, ...], from_rv: int) -> List[WatchEvent]:
         # events are appended in rv order — binary-search the start
+        lockcheck.assert_held(self._lock, "_collect_locked")
         import bisect
         lo = bisect.bisect_right(self._log, from_rv, key=lambda e: e.rv)
         return [e for e in self._log[lo:] if e.kind in kinds]
 
-    def _append(self, ev: WatchEvent) -> None:
+    def _append_locked(self, ev: WatchEvent) -> None:
+        lockcheck.assert_held(self._lock, "_append_locked")
         self._log.append(ev)
         if len(self._log) > self._max_log:
             drop = len(self._log) - self._max_log

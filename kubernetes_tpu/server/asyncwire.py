@@ -58,6 +58,7 @@ from __future__ import annotations
 import asyncio
 import random
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
@@ -132,7 +133,7 @@ class AsyncBinaryServer:
         # WORKER — never on the event loop — and the shared Pod object
         # keeps its key/class-hash memos warm across verbs
         self._pod_cache: "OrderedDict[bytes, object]" = OrderedDict()
-        self._pod_cache_lock = threading.Lock()
+        self._pod_cache_lock = lockcheck.make_lock("AsyncBinaryServer._pod_cache_lock")
         self.pod_cache_max = 8192
         # live per-connection reader tasks (loop-thread-only, like the
         # pend lists): teardown() cancels these explicitly — loop.stop()

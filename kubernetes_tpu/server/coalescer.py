@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import random
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from collections import deque
 from typing import Optional
@@ -80,7 +81,7 @@ class EvalCoalescer:
         self.window_s = window_s
         self.max_batch = max(int(max_batch), 1)
         self.max_depth = max(int(max_depth), 1)
-        self._cv = threading.Condition()
+        self._cv = lockcheck.make_condition("EvalCoalescer._cv")
         self._queue: deque = deque()
         self._leader_active = False
         self._rng = random.Random(0xC0A1)

@@ -67,6 +67,7 @@ from __future__ import annotations
 import json
 import random
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Protocol, Tuple
 
@@ -117,7 +118,7 @@ class ExtenderHTTPServer:
         # story; the coalescer bounds its own queue below this)
         self.max_inflight = max_inflight
         self._inflight = 0
-        self._adm_lock = threading.Lock()
+        self._adm_lock = lockcheck.make_lock("ExtenderHTTPServer._adm_lock")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -527,7 +528,7 @@ class TPUExtenderBackend:
         self.eval_cache = EvalCache()
         # staleness ledger for the warm lane (class docstring); guarded by
         # _lock — ThreadingHTTPServer serves each request on its own thread
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("TPUExtenderBackend._lock")
         self._state_dirty = True          # full refresh needed
         self._bind_hint: set = set()      # targeted refresh of these nodes
         self._infos = None                # cached node_infos() view
@@ -557,7 +558,7 @@ class TPUExtenderBackend:
         # service counters: own lock, so /metrics scrapes and coalescer
         # increments never contend with (or tear against) the eval lock —
         # the ISSUE 9 torn-read audit
-        self._counters_lock = threading.Lock()
+        self._counters_lock = lockcheck.make_lock("TPUExtenderBackend._counters_lock")
         self._counters: Dict[str, int] = {}
         self._rng = random.Random(0xB19D)
         self.coalescer = EvalCoalescer(self, window_s=coalesce_window_s,
@@ -627,9 +628,10 @@ class TPUExtenderBackend:
     # leaks for the process lifetime
     CLEANUP_INTERVAL_S = 5.0
 
-    def _maybe_cleanup_assumed(self) -> None:
+    def _maybe_cleanup_assumed_locked(self) -> None:
         """Time-gated cleanup_assumed (cache.go:355 analog) — called with
         the lock held from the sync/refresh paths."""
+        lockcheck.assert_held(self._lock, "_maybe_cleanup_assumed_locked")
         import time as _time
         now = _time.monotonic()
         if now - self._last_cleanup < self.CLEANUP_INTERVAL_S:
@@ -651,7 +653,7 @@ class TPUExtenderBackend:
             self._state_dirty = True
             self.commit_gen += 1
             self._bind_hint.clear()
-            self._maybe_cleanup_assumed()
+            self._maybe_cleanup_assumed_locked()
             seen = set()
             for n in nodes:
                 self.cache.update_node(n)
@@ -674,7 +676,7 @@ class TPUExtenderBackend:
             self._state_dirty = True
             self.commit_gen += 1
             self._bind_hint.clear()
-            self._maybe_cleanup_assumed()
+            self._maybe_cleanup_assumed_locked()
             seen = set()
             for p in pods:
                 if not p.node_name:
@@ -704,7 +706,7 @@ class TPUExtenderBackend:
 
     # -- extender verbs -----------------------------------------------------
 
-    def _refresh_warm(self):
+    def _refresh_warm_locked(self):
         """Bring the persistent snapshot up to date with the cache, paying
         only for what actually moved (class docstring). Returns the live
         infos view.
@@ -716,11 +718,12 @@ class TPUExtenderBackend:
         re-validates every commit against live cache truth. Sync-driven
         dirtiness always refreshes immediately: membership/spec changes
         are not a staleness the fence is allowed to absorb."""
+        lockcheck.assert_held(self._lock, "_refresh_warm_locked")
         import time as _time
 
         from kubernetes_tpu.utils.trace import COUNTERS, timed_span
         snap = self.engine.snapshot
-        self._maybe_cleanup_assumed()  # time-gated; a bind-only deployment
+        self._maybe_cleanup_assumed_locked()  # time-gated; a bind-only deployment
         # (no syncs ever) must still expire unconfirmed assumptions
         if self._state_dirty or self._infos is None:
             with timed_span("extender.refresh_full"):
@@ -754,7 +757,8 @@ class TPUExtenderBackend:
                     words = max(words, p.host_port // 32 + 1)
         return bucket(max(words, 1), lo=1)
 
-    def _eval(self, pod: Pod, nodes: Optional[List[Node]]):
+    def _eval_locked(self, pod: Pod, nodes: Optional[List[Node]]):
+        lockcheck.assert_held(self._lock, "_eval_locked")
         from kubernetes_tpu.engine.scheduler_engine import evaluate_pod
         from kubernetes_tpu.state.snapshot import ClusterSnapshot
 
@@ -774,7 +778,7 @@ class TPUExtenderBackend:
                 volume_ctx=self.engine.volume_ctx, eval_cache=None)
             return snap, m, s
         snap = self.engine.snapshot
-        infos = self._refresh_warm()
+        infos = self._refresh_warm_locked()
         # deferred: evaluate_pod invokes this only after vocab flushes, so
         # a label-matrix rebuild can never race a stale device upload
         provider = (lambda: self.engine._nodes_on_device(
@@ -799,7 +803,7 @@ class TPUExtenderBackend:
         _Verdict per pod, in order."""
         from kubernetes_tpu.engine.scheduler_engine import evaluate_pods_batch
         with self._lock:
-            infos = self._refresh_warm()
+            infos = self._refresh_warm_locked()
             snap = self.engine.snapshot
             port_words = max(self._port_words_for(p) for p in pods)
             provider = (lambda: self.engine._nodes_on_device(
@@ -818,7 +822,7 @@ class TPUExtenderBackend:
     def _eval_one(self, pod):
         """Degraded per-request fallback (coalescer fault path)."""
         with self._lock:
-            snap, m, s = self._eval(pod, None)
+            snap, m, s = self._eval_locked(pod, None)
             return _Verdict(m, s, snap.node_names, snap.node_index,
                             self._snap_gen)
 
@@ -918,7 +922,7 @@ class TPUExtenderBackend:
             # non-cache-capable args-mode: full state ships per request —
             # nothing to coalesce against, evaluate directly
             with self._lock:
-                snap, m, _ = self._eval(pod, nodes)
+                snap, m, _ = self._eval_locked(pod, nodes)
                 names = snap.node_names
                 idx = snap.node_index
             cand = node_names if node_names is not None \
@@ -930,7 +934,7 @@ class TPUExtenderBackend:
     def prioritize(self, pod, nodes, node_names):
         if nodes is not None:
             with self._lock:
-                snap, _, s = self._eval(pod, nodes)
+                snap, _, s = self._eval_locked(pod, nodes)
                 names = snap.node_names
                 idx = snap.node_index
             sl = s.tolist()
@@ -940,7 +944,7 @@ class TPUExtenderBackend:
         scores, _gen = self.prioritize_verdict(pod, node_names)
         return scores
 
-    def _bind_fence(self, pod: Pod, node: str):
+    def _bind_fence_locked(self, pod: Pod, node: str):
         """Single-commit mirror of the engine's harvest fence (ISSUE 9):
         re-validate capacity / pod count / host ports / liveness — and,
         when affinity is in play, the full topology verdict via a FRESH
@@ -954,6 +958,7 @@ class TPUExtenderBackend:
         bind_conflict counters partition the total with names the
         existing requeue attribution already established) — or None to
         admit."""
+        lockcheck.assert_held(self._lock, "_bind_fence_locked")
         from kubernetes_tpu.observability import podtrace
         from kubernetes_tpu.ops import oracle
         from kubernetes_tpu.ops.affinity import _has_affinity
@@ -983,7 +988,7 @@ class TPUExtenderBackend:
             # the staleness window and re-check the chosen node against
             # the fresh evaluation
             self._last_refresh = 0.0
-            snap, m, _s = self._eval(pod, None)
+            snap, m, _s = self._eval_locked(pod, None)
             i = snap.node_index.get(node, -1)
             if i < 0 or not m[i]:
                 return (podtrace.REASON_AFFINITY,
@@ -1145,8 +1150,8 @@ class TPUExtenderBackend:
             # generation is provably current — nothing was committed since
             # the snapshot it read, so its own /filter pass IS the fence
             if snapshot_gen is None or snapshot_gen != self.commit_gen:
-                self._refresh_warm()  # liveness truth for _infos
-                fenced = self._bind_fence(base, node)
+                self._refresh_warm_locked()  # liveness truth for _infos
+                fenced = self._bind_fence_locked(base, node)
                 if fenced is not None:
                     return self._fence_conflict(fenced[0], fenced[1],
                                                 idem_key)

@@ -19,6 +19,7 @@ Two reference components re-built for the in-process control plane:
 from __future__ import annotations
 
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -171,7 +172,7 @@ class Aggregator:
     def __init__(self, primary, probe_interval: float = 30.0):
         self.primary = primary
         self._backends: Dict[Tuple[str, str], Any] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("Aggregator._lock")
         self.probe_interval = probe_interval
         self._last_probe = 0.0
 

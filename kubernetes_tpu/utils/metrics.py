@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+from kubernetes_tpu.analysis import lockcheck
 from typing import Dict, List
 
 
@@ -61,12 +62,13 @@ class Histogram:
         self._points = 0        # retained entries across all three stores
         self._compactions = 0
         self.reservoir_max = int(reservoir_max) or self.RESERVOIR_MAX
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("Histogram._lock")
 
     def observe(self, v: float) -> None:
         self.observe_many(v, 1)
 
     def _observe_locked(self, v: float, n: int) -> None:
+        lockcheck.assert_held(self._lock, "_observe_locked")
         i = bisect.bisect_left(self.buckets, v)
         self._counts[i] += n
         self._sum += v * n
@@ -107,11 +109,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def totals(self):
         """(count, sum) read under the lock — the telemetry registry's
@@ -155,6 +159,7 @@ class Histogram:
         k points at equal-mass ranks (stratum centers), stratum masses as
         weights — total mass preserved exactly, rank error per later
         percentile() bounded by ~total/k per compaction."""
+        lockcheck.assert_held(self._lock, "_compact_locked")
         import numpy as np
         merged = self._merged_locked()
         self._values = []
@@ -218,7 +223,7 @@ class Counter:
         self.name = name
         self.help = help_text
         self._v = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("Counter._lock")
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -226,11 +231,13 @@ class Counter:
 
     @property
     def value(self) -> int:
-        return self._v
+        with self._lock:
+            return self._v
 
     def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n{self.name} {self._v}")
+        with self._lock:
+            return (f"# HELP {self.name} {self.help}\n"
+                    f"# TYPE {self.name} counter\n{self.name} {self._v}")
 
 
 class SchedulerMetrics:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from kubernetes_tpu.analysis import lockcheck
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -30,7 +31,7 @@ class SpanCounters:
     behavior structurally; profile_bench reads times for attribution."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("SpanCounters._lock")
         self._counts: Dict[str, int] = {}
         self._times: Dict[str, float] = {}
 

@@ -555,3 +555,51 @@ def test_stop_resolves_queued_bind_tickets():
     assert elapsed < 10, elapsed
     assert all(o == "ok" or "server stopped" in o
                or "closed connection" in o for o in outcomes), outcomes
+
+
+# ------------------------------------------- tsan-lite storm leg (ISSUE 19)
+
+
+def test_lockcheck_leg_wire_scheduleone_bit_identical(monkeypatch):
+    """scheduleOne over the binary wire with GRAFT_LOCKCHECK=1: the
+    armed world (event loop, coalescer condition, fence, ledger, store
+    condition — all checked twins) returns the same verdict, the same
+    top scores, and a working idempotent bind, with zero recorded
+    lock-discipline violations."""
+    from kubernetes_tpu.analysis import lockcheck
+
+    pod = _pod("lc-wire")
+    backend, srv = _serve()  # unarmed reference
+    try:
+        c = BinaryWireClient("127.0.0.1", srv.port).connect()
+        want = c.filter_fused(pod, top_k=8, deadline_ms=10_000)
+        c.close()
+    finally:
+        srv.stop()
+
+    monkeypatch.setenv("GRAFT_LOCKCHECK", "1")
+    lockcheck.reset()
+    api = ApiServerLite()
+    nodes = hollow_nodes(N_NODES)
+    for n in nodes:
+        api.create("Node", n)
+    api.create("Pod", pod)  # the store binder binds STORE pods
+    binder = extender_store_binder(FaultyBindApi(api))
+    backend, srv = _serve(nodes=nodes, binder=binder)
+    try:
+        c = BinaryWireClient("127.0.0.1", srv.port).connect()
+        v = c.filter_fused(pod, top_k=8, deadline_ms=10_000)
+        assert v.passed_count == want.passed_count == N_NODES
+        assert v.top_scores == want.top_scores  # bit-identical ranking
+        node = v.top_scores[0][0]
+        r = c.bind("lc-wire", "default", pod.uid, node,
+                   snapshot_gen=v.snapshot_gen, idem_key="lc:1", pod=pod)
+        assert r.ok, r
+        pods0 = backend.cache.pod_count()
+        r = c.bind("lc-wire", "default", pod.uid, node,
+                   snapshot_gen=v.snapshot_gen, idem_key="lc:1", pod=pod)
+        assert r.ok and backend.cache.pod_count() == pods0
+        c.close()
+    finally:
+        srv.stop()
+    lockcheck.assert_clean()

@@ -1420,3 +1420,534 @@ def test_gl003_fires_on_ragged_fastlane_sample(tmp_path):
     assert not errors, errors
     assert not [f for f in findings if f.rule == "GL003"
                 and "fixed_sample" in f.path], findings
+
+
+# ---------------------- ISSUE 19: the concurrency family (GL006-GL009)
+
+
+GL006_INVERSION = """
+    import threading
+
+    class Cell:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def forward(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def backward(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_gl006_fires_on_two_lock_inversion(tmp_path):
+    """The deliberate ABBA reintroduction: both nesting directions exist,
+    so BOTH observed edges sit on the cycle and each site fires."""
+    fs = lint_src(tmp_path, GL006_INVERSION, rules=["GL006"])
+    assert rules_of(fs) == ["GL006", "GL006"]
+    assert all("lock-order cycle" in f.message for f in fs)
+    assert "'Cell._a'" in fs[0].message and "'Cell._b'" in fs[0].message
+
+
+def test_gl006_silent_on_consistent_order(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class Cell:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def also_forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """, rules=["GL006"])
+    assert fs == []
+
+
+def test_gl006_cycle_across_files(tmp_path):
+    """One direction per FILE: the cycle only exists project-wide, which
+    is exactly what the prepare() pass-1.5 graph is for."""
+    (tmp_path / "fwd.py").write_text(textwrap.dedent("""
+        import threading
+
+        class Cell:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """))
+    (tmp_path / "bwd.py").write_text(textwrap.dedent("""
+        import threading
+
+        class Cell:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """))
+    findings, _s, errors = run_paths([str(tmp_path)], rules=["GL006"])
+    assert not errors
+    assert len(findings) == 2  # one per participating site, per file
+    assert {os.path.basename(f.path) for f in findings} == \
+        {"fwd.py", "bwd.py"}
+
+
+def test_gl006_declared_order_catches_lone_inversion(tmp_path):
+    """A `lock-order(...)` declaration blesses A->B project-wide, so a
+    single B->A nesting fires even though the forward `with` nesting is
+    never written anywhere."""
+    fs = lint_src(tmp_path, """
+        import threading
+
+        # graftlint: lock-order(Cell._a,Cell._b)
+
+        class Cell:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, rules=["GL006"])
+    assert rules_of(fs) == ["GL006"]
+    assert "declared lock-order" in fs[0].message
+
+
+def test_gl006_fires_on_self_deadlock_and_spares_rlock(tmp_path):
+    bad = lint_src(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def work(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """, rules=["GL006"])
+    assert rules_of(bad) == ["GL006"]
+    assert "re-acquiring non-reentrant" in bad[0].message
+    good = lint_src(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def work(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """, name="good.py", rules=["GL006"])
+    assert good == []
+
+
+def test_gl006_lock_ok_pragma_blesses_site(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class Cell:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:  # graftlint: lock-ok
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:  # graftlint: lock-ok
+                        pass
+    """, rules=["GL006"])
+    assert fs == []
+
+
+# ------------------------------------------------------------------- GL007
+
+
+GL007_TORN_COUNTER = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._v = 0
+
+        def inc(self):
+            with self._lock:
+                self._v += 1
+
+        def reset(self):
+            self._v = 0
+
+        def peek(self):
+            return self._v
+"""
+
+
+def test_gl007_fires_on_torn_counter_regression(tmp_path):
+    """The r18 metrics-audit regression, reintroduced deliberately: one
+    guarded writer, one STRAY unguarded write and one bare read — the
+    stray write must not demote the field (it IS the bug), and both
+    unguarded accesses fire."""
+    fs = lint_src(tmp_path, GL007_TORN_COUNTER, rules=["GL007"])
+    assert rules_of(fs) == ["GL007", "GL007"]
+    assert "torn write" in fs[0].message  # reset
+    assert "torn read" in fs[1].message   # peek
+
+
+def test_gl007_silent_when_guarded_or_locked_helper(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0
+
+            def inc(self):
+                with self._lock:
+                    self._inc_locked()
+
+            def _inc_locked(self):
+                self._v += 1
+
+            def peek(self):
+                with self._lock:
+                    return self._v
+    """, rules=["GL007"])
+    assert fs == []
+
+
+def test_gl007_torn_ok_pragma_blesses_stale_read(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0
+
+            def inc(self):
+                with self._lock:
+                    self._v += 1
+
+            def peek(self):
+                # single int, CPython store is atomic; staleness is fine
+                # for a monitoring read. graftlint: torn-ok
+                return self._v
+    """, rules=["GL007"])
+    assert fs == []
+
+
+def test_gl007_ignores_unguarded_fields(tmp_path):
+    """A field NEVER written under the lock belongs to some other
+    discipline (a loop-owned field, a config constant) — not GL007's."""
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.mode = "idle"
+
+            def flip(self):
+                self.mode = "busy"
+
+            def show(self):
+                return self.mode
+    """, rules=["GL007"])
+    assert fs == []
+
+
+# ------------------------------------------------------------------- GL008
+
+
+def test_gl008_fires_on_blocking_shapes_in_async_def(tmp_path):
+    fs = lint_src(tmp_path, """
+        import socket
+        import threading
+        import time
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def handle(self, sock):
+                time.sleep(0.01)
+                with self._lock:
+                    pass
+                data = sock.recv(4)
+                self._lock.acquire()
+                return data
+    """, rules=["GL008"])
+    assert rules_of(fs) == ["GL008"] * 4
+    msgs = "\n".join(f.message for f in fs)
+    assert "time.sleep" in msgs
+    assert "threading lock self._lock" in msgs
+    assert ".recv()" in msgs
+
+
+def test_gl008_fires_on_device_sync_in_async_def(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x
+
+        class Srv:
+            async def pump(self, xs):
+                out = kernel(xs)
+                return np.asarray(out)
+    """, rules=["GL008"])
+    assert rules_of(fs) == ["GL008"]
+    assert "device->host sync" in fs[0].message
+
+
+def test_gl008_silent_on_async_twins_and_executor_hop(tmp_path):
+    """The blessed shapes: await asyncio.sleep, and blocking work INSIDE
+    the lambda handed to run_in_executor — that body runs on a worker
+    thread, not the loop (asyncwire's actual idiom)."""
+    fs = lint_src(tmp_path, """
+        import asyncio
+        import threading
+        import time
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _sync_work(self):
+                with self._lock:
+                    time.sleep(0.001)
+
+            async def handle(self, loop):
+                await asyncio.sleep(0.01)
+                await loop.run_in_executor(None, lambda: self._sync_work())
+    """, rules=["GL008"])
+    assert fs == []
+
+
+def test_gl008_block_ok_pragma_blesses_tiny_section(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def handle(self):
+                with self._lock:  # graftlint: block-ok
+                    pass
+    """, rules=["GL008"])
+    assert fs == []
+
+
+# ------------------------------------------------------------------- GL009
+
+
+def test_gl009_fires_on_lambda_and_bound_method_targets(tmp_path):
+    fs = lint_src(tmp_path, """
+        import multiprocessing as mp
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self):
+                pass
+
+            def boot(self):
+                a = mp.Process(target=lambda: None)
+                b = mp.Process(target=self.run)
+                return a, b
+    """, rules=["GL009"])
+    assert rules_of(fs) == ["GL009", "GL009"]
+    assert "lambda" in fs[0].message
+    assert "bound method" in fs[1].message and "_lock" in fs[1].message
+
+
+def test_gl009_fires_on_module_state_capture_and_global_write(tmp_path):
+    fs = lint_src(tmp_path, """
+        import multiprocessing as mp
+        import threading
+
+        _TABLE = {}
+        _LOCK = threading.Lock()
+        _TOTAL = 0
+
+        def worker(cfg):
+            global _TOTAL
+            with _LOCK:
+                _TABLE[cfg] = 1
+                _TOTAL += 1
+
+        def boot():
+            p = mp.Process(target=worker, args=(1,))
+            p.start()
+    """, rules=["GL009"])
+    msgs = "\n".join(f.message for f in fs)
+    assert "_TABLE" in msgs and "mutable state" in msgs
+    assert "_LOCK" in msgs and "synchronizes nothing" in msgs
+    assert "_TOTAL" in msgs and "CHILD's module" in msgs
+
+
+def test_gl009_silent_on_picklable_config_worker(tmp_path):
+    """The multiproc.py discipline: a module-level def handed everything
+    through picklable args; module CONSTANTS (ints, strings, compiled
+    regexes) are not hazards."""
+    fs = lint_src(tmp_path, """
+        import multiprocessing as mp
+        import re
+
+        _OWNER_RE = re.compile(r"owner=(\\w+)")
+        MAX_EVENTS = 4096
+
+        def worker(cfg, queue):
+            n = min(cfg["n"], MAX_EVENTS)
+            m = _OWNER_RE.match(cfg["line"])
+            queue.put((n, m and m.group(1)))
+
+        def boot(q):
+            ctx = mp.get_context("spawn")
+            p = ctx.Process(target=worker, args=({"n": 1, "line": ""}, q))
+            p.start()
+            return p
+    """, rules=["GL009"])
+    assert fs == []
+
+
+def test_gl009_spawn_ok_pragma_blesses_readonly_table(tmp_path):
+    fs = lint_src(tmp_path, """
+        import multiprocessing as mp
+
+        _CANNED = {"a": 1}
+
+        def worker(q):
+            # import-time-frozen table, mutated nowhere: the child's copy
+            # is identical by construction. graftlint: spawn-ok
+            q.put(_CANNED["a"])
+
+        def boot(q):
+            return mp.Process(target=worker, args=(q,))
+    """, rules=["GL009"])
+    assert fs == []
+
+
+# --------------------------------------- concurrency family CLI plumbing
+
+
+def test_cli_selective_concurrency_rules_exit_codes(tmp_path):
+    """`--rules GL006,GL007,GL008,GL009` is the concurrency-only
+    invocation: exit 1 on a torn counter, exit 0 once it is clean, and
+    the same file keeps exit 0 when only OTHER rules are selected."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(GL007_TORN_COUNTER))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    conc = ["--rules", "GL006,GL007,GL008,GL009"]
+    r = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis", *conc, str(bad)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PKG_DIR))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "GL007" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis",
+         "--rules", "GL001,GL002", str(bad)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PKG_DIR))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    r3 = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis", *conc,
+         str(good)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PKG_DIR))
+    assert r3.returncode == 0, r3.stdout + r3.stderr
+
+
+def test_cli_json_carries_by_rule_counters(tmp_path):
+    from kubernetes_tpu.analysis.__main__ import main
+    import io
+    from contextlib import redirect_stdout
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(GL007_TORN_COUNTER))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["--rules", "GL006,GL007", "--json", str(bad)])
+    data = json.loads(buf.getvalue())
+    assert rc == 1
+    assert data["by_rule"] == {"GL006": 0, "GL007": 2}
+    full = io.StringIO()
+    with redirect_stdout(full):
+        main(["--json", str(bad)])
+    data = json.loads(full.getvalue())
+    assert set(data["by_rule"]) == {f"GL00{i}" for i in range(1, 10)}
+    assert data["by_rule"]["GL007"] == 2
+
+
+def test_list_rules_documents_concurrency_family(tmp_path):
+    from kubernetes_tpu.analysis.__main__ import main
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert main(["--list-rules"]) == 0
+    out = buf.getvalue()
+    for rid in ("GL006", "GL007", "GL008", "GL009"):
+        assert rid in out, out
+
+
+def test_gl007_baseline_fingerprint_survives_line_drift(tmp_path):
+    """A baselined GL007 finding keeps suppressing after edits ABOVE it
+    shift every line number — fingerprints anchor on qualname+message."""
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(GL007_TORN_COUNTER))
+    findings, _s, _e = run_paths([str(f)], rules=["GL007"])
+    assert len(findings) == 2
+    bpath = tmp_path / "b.json"
+    write_baseline(str(bpath), findings)
+    f.write_text("# a new header comment\n# another\n\n"
+                 + textwrap.dedent(GL007_TORN_COUNTER))
+    findings2, sup, _e = run_paths([str(f)], rules=["GL007"],
+                                   baseline=load_baseline(str(bpath)))
+    assert findings2 == [] and sup == 2
+
+
+def test_lint_gate_refuses_concurrency_dirty_tree(tmp_path):
+    """`bench --lint-gate` refuses a tree carrying a torn counter or a
+    lock-order hazard the same way it refuses an aliasing upload."""
+    (tmp_path / "bad.py").write_text(textwrap.dedent(GL007_TORN_COUNTER))
+    ok, report = lint_gate(str(tmp_path))
+    assert not ok and "GL007" in report

@@ -1,0 +1,209 @@
+"""The tsan-lite runtime checker (analysis/lockcheck.py).
+
+Static GL006/GL007 prove what the AST shows; these tests pin the runtime
+half: inversion witnesses without losing the race, assert_held guards on
+the ``*_locked()`` convention, the guaranteed-self-deadlock raise, and —
+load-bearing for every shipped configuration — EXACT pass-through when
+the knob is off.
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.analysis import lockcheck
+
+
+@pytest.fixture()
+def armed(monkeypatch):
+    monkeypatch.setenv("GRAFT_LOCKCHECK", "1")
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+# ------------------------------------------------------------ knob off
+
+
+def test_knob_off_returns_raw_primitives(monkeypatch):
+    monkeypatch.delenv("GRAFT_LOCKCHECK", raising=False)
+    assert not lockcheck.enabled()
+    lk = lockcheck.make_lock("X._lock")
+    assert type(lk) is type(threading.Lock())  # raw _thread.lock, no wrapper
+    rl = lockcheck.make_rlock("X._rlock")
+    assert type(rl) is type(threading.RLock())
+    cv = lockcheck.make_condition("X._cv")
+    assert type(cv) is threading.Condition
+    # assert_held is an isinstance-gated no-op on raw primitives
+    lockcheck.assert_held(lk, "anything")
+    assert lockcheck.violations() == []
+
+
+# ----------------------------------------------------------- inversion
+
+
+def test_abba_inversion_recorded_without_losing_the_race(armed):
+    a = lockcheck.make_lock("Cell._a")
+    b = lockcheck.make_lock("Cell._b")
+    with a:
+        with b:
+            pass
+    # single-threaded, never actually deadlocks — the edge table still
+    # has the witness
+    with b:
+        with a:
+            pass
+    vs = lockcheck.violations()
+    assert len(vs) == 1
+    assert "lock-order inversion" in vs[0]
+    assert "Cell._a" in vs[0] and "Cell._b" in vs[0]
+    with pytest.raises(AssertionError, match="lock-order inversion"):
+        lockcheck.assert_clean()
+    lockcheck.reset()
+    assert lockcheck.violations() == []
+    lockcheck.assert_clean()
+
+
+def test_consistent_order_is_clean(armed):
+    a = lockcheck.make_lock("Cell._a")
+    b = lockcheck.make_lock("Cell._b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockcheck.violations() == []
+
+
+def test_same_name_different_objects_no_edge(armed):
+    """Two INSTANCES of one class share a lock id; no order exists
+    between peers, so hand-over-hand on two instances is not an
+    inversion."""
+    a1 = lockcheck.make_lock("Peer._lock")
+    a2 = lockcheck.make_lock("Peer._lock")
+    with a1:
+        with a2:
+            pass
+    with a2:
+        with a1:
+            pass
+    assert lockcheck.violations() == []
+
+
+def test_cross_thread_inversion_detected(armed):
+    """The realistic shape: each direction on its OWN thread, never
+    racing — lockdep-style, the edge table spans threads."""
+    a = lockcheck.make_lock("Cell._a")
+    b = lockcheck.make_lock("Cell._b")
+
+    def fwd():
+        with a:
+            with b:
+                pass
+
+    def bwd():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=fwd)
+    t.start()
+    t.join()
+    t = threading.Thread(target=bwd)
+    t.start()
+    t.join()
+    vs = lockcheck.violations()
+    assert len(vs) == 1 and "inversion" in vs[0]
+
+
+# ------------------------------------------------------- self-deadlock
+
+
+def test_nonreentrant_reacquire_raises_instead_of_hanging(armed):
+    lk = lockcheck.make_lock("C._lock")
+    with lk:
+        with pytest.raises(RuntimeError, match="guaranteed deadlock"):
+            lk.acquire()
+    # the raise happened BEFORE the raw acquire: lock is free again
+    assert lk.acquire(timeout=0.5)
+    lk.release()
+
+
+def test_rlock_reentry_is_fine(armed):
+    rl = lockcheck.make_rlock("C._rlock")
+    with rl:
+        with rl:
+            pass
+    assert lockcheck.violations() == []
+    # fully released: another thread can take it (and give it back)
+    got = []
+
+    def taker():
+        ok = rl.acquire(timeout=1)
+        got.append(ok)
+        if ok:
+            rl.release()
+
+    t = threading.Thread(target=taker)
+    t.start()
+    t.join()
+    assert got == [True]
+
+
+# --------------------------------------------------------- assert_held
+
+
+def test_assert_held_records_unguarded_locked_call(armed):
+    lk = lockcheck.make_lock("C._lock")
+    with lk:
+        lockcheck.assert_held(lk, "guarded path")
+    assert lockcheck.violations() == []
+    lockcheck.assert_held(lk, "bare path")
+    vs = lockcheck.violations()
+    assert len(vs) == 1
+    assert "guard not held" in vs[0] and "bare path" in vs[0]
+
+
+def test_assert_held_catches_real_torn_metrics_write(armed):
+    """The r18 regression at runtime: calling Histogram._observe_locked
+    without its lock is exactly what GL007 catches statically — the
+    armed checker catches the same bug if it sneaks past the linter."""
+    from kubernetes_tpu.utils.metrics import Histogram
+
+    h = Histogram("t")  # constructed while armed -> checked lock
+    h.observe(0.25)     # the public path holds the lock
+    assert lockcheck.violations() == []
+    h._observe_locked(0.5, 1)  # the bug: bare call
+    vs = lockcheck.violations()
+    assert len(vs) == 1 and "Histogram._lock" in vs[0]
+    assert h.count == 2  # behaviour unchanged; only the report differs
+
+
+# ----------------------------------------------------------- condition
+
+
+def test_condition_wait_pops_and_restores_held_entry(armed):
+    """wait() releases the lock for the duration, so the held entry must
+    pop for the sleep and come back on wake — otherwise every lock the
+    wait predicate (or the woken continuation) touches would hang a
+    phantom cv-> X edge on the thread."""
+    cv = lockcheck.make_condition("Q._lock")
+    seen = []
+    ready = []
+
+    def producer():
+        with cv:
+            ready.append(1)
+            cv.notify_all()
+
+    with cv:
+        assert cv._is_held()
+        t = threading.Thread(target=producer)
+        t.start()
+        # the predicate runs on the waiter thread DURING the wait
+        assert cv.wait_for(
+            lambda: (seen.append(cv._is_held()), bool(ready))[1],
+            timeout=5)
+        assert cv._is_held()
+    t.join()
+    assert seen and not any(seen)
+    assert lockcheck.violations() == []

@@ -415,3 +415,27 @@ def test_trend_learns_multiproc_headline(tmp_path):
     w(19, value=30000.0, multiproc_pods_s=20.0)  # -60%: regression
     regs = trend.find_regressions(trend.load_rounds(str(tmp_path)))
     assert [g["metric"] for g in regs] == ["multiproc_pods_s"]
+
+
+# ------------------------------------------- tsan-lite storm leg (ISSUE 19)
+
+
+def test_lockcheck_leg_process_fleet_exactly_once(monkeypatch):
+    """The two-process race with GRAFT_LOCKCHECK=1 end to end: spawned
+    children inherit the knob through the environment, so EVERY lock on
+    both sides is a checked twin. The exactly-once audit must hold
+    unchanged, the parent-side checker must end silent, and a child-side
+    guaranteed-self-deadlock raise would surface as a worker failure."""
+    from kubernetes_tpu.analysis import lockcheck
+    from kubernetes_tpu.parallel.multiproc import run_process_fleet
+
+    monkeypatch.setenv("GRAFT_LOCKCHECK", "1")
+    lockcheck.reset()
+    out = run_process_fleet(2, pods_per_worker=6, overlap=1.0,
+                            n_nodes=32, relist_every=3,
+                            pod_prefix="lcfleet", timeout_s=180.0)
+    agg = out["agg"]
+    assert agg["missing_workers"] == 0, agg
+    assert agg["worker_failures"] == [], agg
+    assert agg["duplicate_binds"] == 0
+    lockcheck.assert_clean()
