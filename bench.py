@@ -1493,6 +1493,258 @@ def measure_multiproc(n_nodes: int = 64, workers_list=(1, 2),
     return out
 
 
+def measure_federation(n_cells: int = 4, nodes_per_cell: int = 50_000,
+                       n_pods: int = 1600, batch: int = 64,
+                       rate: float = 0.0, brownout_down_s: float = 1.5,
+                       boot_timeout_s: float = 420.0,
+                       drain_timeout_s: float = 300.0) -> dict:
+    """The ISSUE 20 acceptance scenario: M cell PROCESSES (each the r18
+    engine unchanged behind server/asyncwire.py, its own store and
+    always-on loop) behind ONE FederationRouter, admission scored over
+    the fused [C, M] cell-aggregate tensor and committed over the binary
+    wire with idempotency keys.
+
+    Mid-offer a BrownoutDriver takes one cell NotReady: its pending pods
+    evacuate through the spillover path to the survivors; after the
+    offer, spill pumps drain every backlog to zero. The acceptance audit
+    is store truth and HARD-FAILS the scenario: per-cell
+    audit_duplicate_binds must be zero AND no pod key may appear bound
+    in two different cells' final stores (one bound cell per pod, ever).
+
+    Offered rate is auto-scaled to the box (rate=0 -> 250*cpus pods/s)
+    and disclosed beside every number with the cpu count — a 1-core box
+    runs M schedulers + the router on one core, so the absolute
+    throughput reads against that shape, never against a fleet's."""
+    import multiprocessing
+    import statistics
+
+    from kubernetes_tpu.api.types import make_pod
+    from kubernetes_tpu.engine.gang import (
+        GANG_MIN_AVAILABLE_ANNOTATION,
+        GANG_NAME_ANNOTATION,
+    )
+    from kubernetes_tpu.federation.cell import run_cell_process
+    from kubernetes_tpu.federation.router import FederationRouter, WireCell
+    from kubernetes_tpu.testing.churn import (
+        BrownoutDriver,
+        make_brownout_schedule,
+    )
+
+    cpus = os.cpu_count() or 1
+    if not rate:
+        rate = 250.0 * cpus
+    names = [f"cell{i}" for i in range(n_cells)]
+    zones = 8
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    try:
+        for i, name in enumerate(names):
+            out_q = ctx.Queue()
+            ctrl_q = ctx.Queue()
+            cfg = {"cell": name, "n_nodes": nodes_per_cell, "seed": i,
+                   "zones": zones, "spill_after_attempts": 2}
+            p = ctx.Process(target=run_cell_process,
+                            args=(cfg, out_q, ctrl_q),
+                            name=f"fed-{name}", daemon=True)
+            p.start()
+            procs.append({"name": name, "proc": p, "out": out_q,
+                          "ctrl": ctrl_q})
+        # ---- boot barrier: every cell announces its ephemeral port
+        t0 = time.monotonic()
+        for rec in procs:
+            left = boot_timeout_s - (time.monotonic() - t0)
+            msg = rec["out"].get(timeout=max(left, 1.0))
+            if not msg.get("ok"):
+                raise RuntimeError(
+                    f"cell {rec['name']} failed to boot: "
+                    f"{msg.get('error')}")
+            rec["port"] = msg["port"]
+        boot_s = time.monotonic() - t0
+        router = FederationRouter(
+            [WireCell(r["name"], "127.0.0.1", r["port"]) for r in procs])
+        th = time.monotonic()
+        router.hydrate()
+        hydrate_s = time.monotonic() - th
+        agg_nodes = sum(a.nodes_total for a in router.aggs.values())
+
+        # ---- warm the route+admit path (first batch pays np/jit import
+        # + per-cell first-create; its span would report warm cost as
+        # admission latency)
+        warm = [make_pod(f"fedwarm-{i}", cpu=100, memory=64 * 1024 ** 2)
+                for i in range(8)]
+        router.admit(warm)
+        router.admit_spans.clear()
+
+        # ---- the offered stream: plain pods + zone-pinned pods (the
+        # affinity-domain routing leg — each cell's zones are disjoint by
+        # construction, so a zone selector admits to exactly one cell) +
+        # whole-cell gangs
+        pods: list = []
+        for i in range(n_pods):
+            if i % 8 == 5:
+                cell_i = (i // 8) % n_cells
+                sel = {"zone": f"{names[cell_i]}-z{i % zones}"}
+                p = make_pod(f"fedp-{i}", cpu=100,
+                             memory=64 * 1024 ** 2, node_selector=sel)
+            else:
+                p = make_pod(f"fedp-{i}", cpu=100,
+                             memory=64 * 1024 ** 2)
+            pods.append(p)
+        n_gangs = 4
+        for g in range(n_gangs):
+            for m in range(6):
+                p = make_pod(f"fedgang{g}-{m}", cpu=50,
+                             memory=32 * 1024 ** 2)
+                p.annotations[GANG_NAME_ANNOTATION] = f"fedgang{g}"
+                p.annotations[GANG_MIN_AVAILABLE_ANNOTATION] = "6"
+                pods.append(p)
+        offer_s = len(pods) / rate
+        schedule = make_brownout_schedule(
+            names, duration_s=max(offer_s, brownout_down_s * 2 + 1.0),
+            down_s=brownout_down_s, count=1, seed=0)
+        driver = BrownoutDriver(router, schedule)
+        t_start = time.monotonic()
+        sent = 0
+        while sent < len(pods):
+            now = time.monotonic() - t_start
+            driver.apply_until(now)
+            due = min(len(pods), int(now * rate) + batch)
+            if due > sent:
+                router.admit(pods[sent:due])
+                sent = due
+                if (sent // batch) % 4 == 0:
+                    router.refresh()
+            else:
+                time.sleep(min(batch / rate, 0.05))
+        offer_wall_s = time.monotonic() - t_start
+
+        # ---- drain: spill pumps move every backlog/spill to a cell
+        # that fits until global pending is zero (and the brownout
+        # schedule has fully played out, recover included)
+        td = time.monotonic()
+        pending = -1
+        while time.monotonic() - td < drain_timeout_s:
+            driver.apply_until(time.monotonic() - t_start)
+            router.spill_pump()
+            pending = sum(a.pending for a in router.aggs.values())
+            if pending == 0 and not router.backlog and driver.done():
+                break
+            time.sleep(0.1)
+        drain_s = time.monotonic() - td
+        counters = router.counters_snapshot()
+        spans = sorted(d for _t, d, _n in router.admit_spans)
+        p50 = statistics.median(spans) * 1e3 if spans else 0.0
+        p99_ms = router.admission_p99_ms()
+        # steady-batch p99: admission spans at the offered batch size
+        # only. The all-batches p99 above includes the brownout
+        # evacuation (one batch carrying EVERY pending pod of the dead
+        # cell, admitted while the survivors chew on one core) — real
+        # work, disclosed separately so the steady admission latency is
+        # readable beside it
+        steady = sorted(d for _t, d, n in router.admit_spans
+                        if n <= batch)
+        sp99 = 0.0
+        if steady:
+            i = min(len(steady) - 1,
+                    int(round(0.99 * (len(steady) - 1))))
+            sp99 = steady[i] * 1e3
+        router.close()
+
+        # ---- stop the fleet, collect STORE-truth finals
+        for rec in procs:
+            rec["ctrl"].put("stop")
+        finals = {}
+        for rec in procs:
+            msg = rec["out"].get(timeout=60.0)
+            while not msg.get("final"):
+                msg = rec["out"].get(timeout=60.0)
+            finals[rec["name"]] = msg
+            rec["proc"].join(timeout=30.0)
+
+        # ---- the acceptance audits (hard-fail: a federation number over
+        # a double-bound pod is not a number)
+        dup_per_cell = {}
+        owner: dict = {}
+        cross_cell = 0
+        for name, f in finals.items():
+            if not f.get("ok"):
+                raise RuntimeError(
+                    f"cell {name} died: {f.get('error')}")
+            dup_per_cell[name] = f["duplicate_binds"]
+            for key in f["bound"]:
+                if key in owner and owner[key] != name:
+                    cross_cell += 1
+                owner[key] = name
+        if cross_cell or any(dup_per_cell.values()):
+            raise RuntimeError(
+                f"federation exactly-once audit FAILED: cross-cell "
+                f"double binds={cross_cell}, per-cell duplicates="
+                f"{dup_per_cell}")
+        bound_total = sum(len(f["bound"]) for f in finals.values())
+        pending_final = sum(f["pending"] for f in finals.values())
+        moved = counters["spill_moved"] + counters["evacuated_moved"]
+        spillover_bound = max(moved - pending_final - len(router.backlog),
+                              0)
+        return {
+            "cpus": cpus,
+            "cells": n_cells,
+            "nodes_per_cell": nodes_per_cell,
+            "agg_nodes": agg_nodes,
+            "zones_per_cell": zones,
+            "boot_s": round(boot_s, 3),
+            "hydrate_s": round(hydrate_s, 3),
+            "offered_pods": len(pods) + len(warm),
+            "offered_rate_pods_s": rate,
+            "offer_wall_s": round(offer_wall_s, 3),
+            "gangs": n_gangs,
+            "admission_batch": batch,
+            "router_admission_p50_ms": round(p50, 3),
+            "router_admission_p99_ms": round(p99_ms, 3),
+            "router_admission_steady_p99_ms": round(sp99, 3),
+            "router_admission_batches": len(spans),
+            "brownout": {"cell": schedule[0].cell,
+                         "t": schedule[0].t,
+                         "down_s": schedule[0].down_s},
+            "evacuated_moved": counters["evacuated_moved"],
+            "spill_moved": counters["spill_moved"],
+            "spillover_bound": spillover_bound,
+            "bound_total": bound_total,
+            "pending_final": pending_final,
+            "backlog_final": len(router.backlog),
+            "drain_s": round(drain_s, 3),
+            "drained_to_zero": bool(pending == 0),
+            "duplicate_binds_per_cell": dup_per_cell,
+            "cross_cell_double_binds": cross_cell,
+            "router_counters": counters,
+            "per_cell": {
+                name: {"bound": len(f["bound"]),
+                       "pending": f["pending"],
+                       "counters": f["counters"]}
+                for name, f in finals.items()},
+        }
+    finally:
+        for rec in procs:
+            if rec["proc"].is_alive():
+                try:
+                    rec["ctrl"].put("stop")
+                except Exception:
+                    pass
+        for rec in procs:
+            rec["proc"].join(timeout=10.0)
+            if rec["proc"].is_alive():
+                rec["proc"].terminate()
+
+
+def _ab_ranges_overlap(a, b) -> bool:
+    """True when two A/B arm trial distributions overlap — the r17
+    escalation trigger (ISSUE 20 satellite): overlapping arm ranges
+    cannot resolve a small overhead bar, so both on/off A/Bs escalate
+    to more interleaved trials per arm until the ranges separate or
+    the trial cap lands."""
+    return bool(a) and bool(b) and min(a) <= max(b) \
+        and min(b) <= max(a)
+
+
 def _ratio(results, a: str, b: str):
     """pods_s ratio between two fleet results, None when either is
     missing/errored (the A/B must never invent a number)."""
@@ -2185,22 +2437,48 @@ def run_arrival(n_nodes: int, rate: float, duration_s: float,
         # attribution and the telescoping check (phase sums == the
         # pod's create->bound span within stamp resolution)
         psnap = _tracer.snapshot()
+        # ISSUE 20 satellite: the slowest-K reservoir of a saturated
+        # stream is dominated by near-identical timelines — siblings of
+        # the same wave walking the same phase sequence. Keep ONE
+        # exemplar per (wave id, phase signature), the slowest of its
+        # group (the reservoir is span-sorted), with a multiplicity
+        # count and the group's span range. Every KEPT exemplar still
+        # carries its own full phase decomposition, so the telescoping
+        # guarantee (phase sums == create->bound) is asserted per
+        # exemplar exactly as before — dedupe drops rows, never phases.
         exemplars = []
+        seen: dict = {}
         for ex in psnap["exemplars"]:
             ssum = sum(ex["phases_ms"].values())
-            exemplars.append({
+            wave = next((e["a"] for e in ex["events"]
+                         if e["kind"] == "WAVE_DISPATCHED"), None)
+            sig = (wave, tuple(e["kind"] for e in ex["events"]))
+            if sig in seen:
+                g = seen[sig]
+                g["multiplicity"] += 1
+                g["span_ms_range"][0] = min(g["span_ms_range"][0],
+                                            ex["span_ms"])
+                g["span_ms_range"][1] = max(g["span_ms_range"][1],
+                                            ex["span_ms"])
+                continue
+            seen[sig] = row = {
                 "key": ex["key"],
+                "wave": wave,
                 "create_to_bound_ms": ex["span_ms"],
                 "phases_ms": ex["phases_ms"],
                 "phase_sum_ms": round(ssum, 6),
                 "attribution_exact":
                     bool(abs(ssum - ex["span_ms"]) < 1e-3),
                 "events": [e["kind"] for e in ex["events"]],
-            })
+                "multiplicity": 1,
+                "span_ms_range": [ex["span_ms"], ex["span_ms"]],
+            }
+            exemplars.append(row)
         out["podtrace"] = {
             "stats": psnap["stats"],
             "phases": psnap["phases"],
             "tail_exemplars": exemplars,
+            "tail_exemplars_raw": len(psnap["exemplars"]),
             "slo": _slo.snapshot(),
         }
     if injector is not None:
@@ -2319,7 +2597,40 @@ def measure_churn(n_nodes: int, rate: float, duration_s: float,
         raise RuntimeError(
             f"duplicate binds: quiet={quiet['duplicate_binds']} "
             f"churn={churned['duplicate_binds']}")
+    # cpus-aware bar + same-box attribution (ISSUE 20 satellite): the
+    # r11 >=0.5 bar was set where fault housekeeping could OVERLAP the
+    # stream core. On a 1-core box every rebuild/requeue serializes
+    # behind the stream, so the ratio sits structurally lower. The
+    # placebo arm separates harness cost from fault-handling cost: the
+    # SAME churn machinery (FaultyBindApi wrapper + injector thread)
+    # with an all-zero fault schedule — if the placebo ratio holds near
+    # 1.0, the collapse is real fault work with no spare core to hide
+    # on, not the measurement apparatus.
+    cpus = os.cpu_count() or 1
+    bar = 0.5 if cpus >= 2 else 0.35
+    attribution = {"cpus": cpus, "bar": bar, "r11_bar_cpus": 2}
+    if cpus == 1 and os.environ.get("BENCH_CHURN_ATTRIBUTION",
+                                    "1") != "0":
+        placebo_cfg = ChurnConfig(
+            seed=cfg.seed, node_churn_per_min=0.0, flap_per_min=0.0,
+            cordon_per_min=0.0, relabel_per_min=0.0,
+            evict_per_min_abs=0.0, bind_fail_rate=0.0,
+            bind_timeout_rate=0.0)
+        placebo = run_arrival(n_nodes, rate=rate, duration_s=duration_s,
+                              profile=profile, budget_ms=budget_ms,
+                              warm=True, churn_cfg=placebo_cfg)
+        placebo_ratio = (placebo["sustained_pods_s"] / quiet_s
+                         if quiet_s else 0.0)
+        attribution["placebo_ratio"] = round(placebo_ratio, 3)
+        attribution["verdict"] = (
+            "fault-handling serializes behind the single stream core "
+            "(placebo churn harness keeps quiet throughput)"
+            if placebo_ratio >= 0.85 else
+            "churn harness thread itself contends for the stream core")
     return {
+        "churn_cpus": cpus,
+        "churn_vs_quiet_bar": bar,
+        "churn_attribution": attribution,
         "churn_offered_pods_s": float(rate),
         "churn_quiet_sustained_pods_s": quiet_s,
         "churn_sustained_pods_s": churn_s,
@@ -3351,9 +3662,27 @@ def main():
                 rec_dropped = r_on.get("recorder_dropped")
                 if len(offs) < trials:
                     offs.append(_leg(False)["sustained_pods_s"])
+            # auto-escalation (ISSUE 20 satellite): when the two arms'
+            # trial RANGES overlap, the pair cannot attribute the delta
+            # to the recorder at all — escalate to the r17 6-trial
+            # protocol (3 interleaved per arm) instead of shipping a
+            # number the box noise wrote. r20's 4.8% "overhead" from 2
+            # overlapping trials was exactly this failure.
+            escalated = False
+            while _ab_ranges_overlap(offs, ons) and len(ons) < 3:
+                escalated = True
+                r_on = _leg(True)
+                ons.append(r_on["sustained_pods_s"])
+                if r_on["p99_ms"] is not None:
+                    on_p99s.append(r_on["p99_ms"])
+                offs.append(_leg(False)["sustained_pods_s"])
             off_s = statistics.median(offs)
             on_s = statistics.median(ons)
             recorder_ab = {
+                "recorder_ab_trials_per_arm": [len(offs), len(ons)],
+                "recorder_ab_escalated": escalated,
+                "recorder_ab_ranges_overlap":
+                    _ab_ranges_overlap(offs, ons),
                 "recorder_off_sustained_pods_s": round(off_s, 1),
                 "recorder_on_sustained_pods_s": round(on_s, 1),
                 "recorder_off_trials": offs,
@@ -3405,10 +3734,25 @@ def main():
                 arrival_podtrace = r_on["podtrace"]
                 if len(offs) < trials:
                     offs.append(_pleg(False)["sustained_pods_s"])
+            # same escalation contract as the recorder A/B: overlapping
+            # arm ranges -> the r17 6-trial protocol
+            escalated = False
+            while _ab_ranges_overlap(offs, ons) and len(ons) < 3:
+                escalated = True
+                r_on = _pleg(True)
+                ons.append(r_on["sustained_pods_s"])
+                if r_on["p99_ms"] is not None:
+                    on_p99s.append(r_on["p99_ms"])
+                arrival_podtrace = r_on["podtrace"]
+                offs.append(_pleg(False)["sustained_pods_s"])
             off_s = statistics.median(offs)
             on_s = statistics.median(ons)
             exemplars = (arrival_podtrace or {}).get("tail_exemplars", [])
             podtrace_ab = {
+                "podtrace_ab_trials_per_arm": [len(offs), len(ons)],
+                "podtrace_ab_escalated": escalated,
+                "podtrace_ab_ranges_overlap":
+                    _ab_ranges_overlap(offs, ons),
                 "podtrace_off_sustained_pods_s": round(off_s, 1),
                 "podtrace_on_sustained_pods_s": round(on_s, 1),
                 "podtrace_off_trials": offs,
@@ -3578,6 +3922,27 @@ def main():
         except Exception as e:
             import sys
             print(f"bench: multiproc measurement failed: {e}",
+                  file=sys.stderr)
+
+    # federation tier (ISSUE 20): M cell processes (the r18 engine
+    # unchanged behind the async binary wire) behind ONE front-door
+    # router scoring the fused [C, M] cell-aggregate tensor, with a
+    # mid-offer cell brownout draining through the spillover path and
+    # the store-truth exactly-once audit hard-failing the scenario
+    # (BENCH_FEDERATION=0 to skip; BENCH_FED_CELLS, BENCH_FED_NODES,
+    # BENCH_FED_PODS, BENCH_FED_RATE knobs — rate 0 = auto 250*cpus)
+    federation = None
+    if os.environ.get("BENCH_FEDERATION", "1") != "0":
+        try:
+            federation = measure_federation(
+                n_cells=int(os.environ.get("BENCH_FED_CELLS", 4)),
+                nodes_per_cell=int(os.environ.get("BENCH_FED_NODES",
+                                                  50_000)),
+                n_pods=int(os.environ.get("BENCH_FED_PODS", 1600)),
+                rate=float(os.environ.get("BENCH_FED_RATE", 0)))
+        except Exception as e:
+            import sys
+            print(f"bench: federation measurement failed: {e}",
                   file=sys.stderr)
 
     # wire-wall calibration (ISSUE 11 satellite): the NO-OP transport
@@ -3807,6 +4172,23 @@ def main():
         if fastlane_mixed else None,
         "fastlane_duplicate_binds": fastlane_mixed.get(
             "fastlane_duplicate_binds") if fastlane_mixed else None,
+        # federation tier (ISSUE 20): the trend-tracked headline trio —
+        # aggregate nodes behind the front door, router admission p99 on
+        # top of per-cell create->bound, and pods spilled-then-bound
+        # under the brownout — plus the full scenario (cpus + scaled
+        # offered rate disclosed inside)
+        "federation": federation,
+        "federation_agg_nodes": federation.get("agg_nodes")
+        if federation else None,
+        "federation_router_p99_ms": federation.get(
+            "router_admission_p99_ms") if federation else None,
+        "federation_spillover_bound": federation.get("spillover_bound")
+        if federation else None,
+        "federation_duplicate_binds": (
+            federation.get("cross_cell_double_binds", 0)
+            + max(federation.get("duplicate_binds_per_cell",
+                                 {}).values(), default=0))
+        if federation else None,
     }, **(churn or {}), **(rolling or {}), **(priority_churn or {}),
         **(mixed or {}), **(gangmix or {}))
     # box-shape disclosure (ISSUE 17 satellite): every scenario's JSON
@@ -3826,7 +4208,7 @@ def main():
     # working. BENCH_ARTIFACT= (empty) disables, or names another round;
     # the default is pinned to THIS round so a bench run can never
     # rewrite a prior round's file as commit noise (ISSUE 11 satellite).
-    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r20.json")
+    artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_r21.json")
     if artifact:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             artifact)

@@ -204,6 +204,30 @@ class BinaryWireClient:
             raise WireError(f"unexpected verb 0x{verb:02x} to RELIST")
         return framing.decode_relist_result(payload)
 
+    def cell_agg(self, drain_spill: bool = False,
+                 evacuate: bool = False) -> Tuple[dict, List]:
+        """Federation pull (ISSUE 20): (aggregate dict, spilled pods) —
+        the cell's routing column plus, with ``drain_spill``, the pods
+        the cell gave up on (they LEFT its store with this response);
+        ``evacuate`` additionally uproots every pending pod (brownout)."""
+        verb, payload = self._roundtrip(
+            framing.CELL_AGG,
+            framing.encode_cell_agg_request(drain_spill, evacuate))
+        if verb != framing.CELL_AGG_RESULT:
+            raise WireError(f"unexpected verb 0x{verb:02x} to CELL_AGG")
+        return framing.decode_cell_agg_result(payload)
+
+    def admit(self, idem_key: str, pods: List) -> Tuple[int, int]:
+        """Hand a batch of pending pods to this cell; (accepted,
+        replayed). Replaying the SAME idem_key after an ambiguous wire
+        fault converges to the recorded answer — the router's half of
+        cross-cell exactly-once admission."""
+        verb, payload = self._roundtrip(
+            framing.ADMIT, framing.encode_admit_request(idem_key, pods))
+        if verb != framing.ADMIT_RESULT:
+            raise WireError(f"unexpected verb 0x{verb:02x} to ADMIT")
+        return framing.decode_admit_result(payload)
+
     def metrics(self) -> str:
         verb, payload = self._roundtrip(framing.METRICS)
         if verb != framing.METRICS_TEXT:

@@ -189,6 +189,17 @@ class Scheduler:
         # alike — so a scenario can join bind instants against its own
         # creation stamps without touching scheduler internals. None = off.
         self.wave_observer = None
+        # federation spill hook (ISSUE 20): when set, a pod whose
+        # unschedulable verdicts reach spill_after_attempts LEAVES this
+        # cell — handed to spill_handler(pods) instead of backoff-
+        # requeued, so the front-door router can re-admit it to a
+        # sibling cell with spare capacity (PAPERS.md §Borg spillover).
+        # Gang members never spill individually: gangs route whole-cell
+        # and their below-quorum retries stay on the backoff path. None
+        # (the default) keeps single-cell behavior bit-identical.
+        self.spill_handler = None
+        self.spill_after_attempts = 3
+        self._unsched_attempts: Dict[str, int] = {}
         # gangs parked below quorum: name -> {pod key: pod} (engine/gang.py)
         self._gang_waiting: Dict[str, Dict[str, Pod]] = {}
         # gangs whose quorum committed: members now schedule individually
@@ -583,7 +594,8 @@ class Scheduler:
                         f"0/{len(self.engine.snapshot.node_names)} nodes "
                         f"available (fit_count={r.fit_count})")
                 unschedulable_pods.append(r.pod)
-                self.queue.add_backoff(r.pod)
+                if self._requeue_unschedulable(r.pod):
+                    stats["spilled"] = stats.get("spilled", 0) + 1
             else:
                 placed.append(r)
         # one batched /binding pass (per-binding semantics identical to the
@@ -918,21 +930,28 @@ class Scheduler:
         preemptors = None
         if res.unschedulable:
             self.metrics.failed.inc(len(res.unschedulable))
+            spilled_keys = set()
             for pod, fcnt in res.unschedulable:
                 if record:
                     self._event(
                         pod, "Warning", "FailedScheduling",
                         f"0/{len(self.engine.snapshot.node_names)} nodes "
                         f"available (fit_count={fcnt})")
-                self.queue.add_backoff(pod)
+                if self._requeue_unschedulable(pod):
+                    out["spilled"] = out.get("spilled", 0) + 1
+                    spilled_keys.add(pod.key())
             # wave-path preemption (ISSUE 14): the harvest's unschedulable
             # preemptors displace lower bands WITHOUT flushing the
             # pipeline — planned below, AFTER this wave's binding pass,
             # so a victim choice can never race a not-yet-posted bind
-            # (the classic round's ordering, kept)
-            if self.wave_preemption and features.enabled("PodPriority") \
-                    and any(p.priority > 0 for p, _f in res.unschedulable):
-                preemptors = [p for p, _f in res.unschedulable]
+            # (the classic round's ordering, kept). A spilled pod is
+            # LEAVING this cell — it must not displace victims here while
+            # the router re-admits it elsewhere.
+            if self.wave_preemption and features.enabled("PodPriority"):
+                preemptors = [p for p, _f in res.unschedulable
+                              if p.key() not in spilled_keys]
+                if not any(p.priority > 0 for p in preemptors):
+                    preemptors = None
         if not res.bound:
             if preemptors:
                 for k, v in self._preempt_wave(preemptors,
@@ -1111,6 +1130,26 @@ class Scheduler:
         the host tail — placements are bit-identical, only the wall-clock
         overlap is forfeited."""
         return ScheduleLoop(self, chunk or self.pipeline_chunk, overlap)
+
+    def _requeue_unschedulable(self, pod) -> bool:
+        """Backoff-requeue an unschedulable pod — or SPILL it to the
+        federation hook once its verdict count crosses the threshold.
+        Returns True when the pod was spilled (it left this cell: no
+        requeue, latency stamp cleared). With no spill_handler the
+        attempt ledger is never touched — single-cell behavior stays
+        bit-identical."""
+        h = self.spill_handler
+        if h is not None:
+            key = pod.key()
+            n = self._unsched_attempts.get(key, 0) + 1
+            if n >= self.spill_after_attempts:
+                self._unsched_attempts.pop(key, None)
+                self._first_queued.pop(key, None)
+                h([pod])
+                return True
+            self._unsched_attempts[key] = n
+        self.queue.add_backoff(pod)
+        return False
 
     def stream(self, budget_s: float = 0.25, min_quantum: int = 256,
                max_quantum: int = 16384, overlap: bool = True,

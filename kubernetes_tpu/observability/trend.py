@@ -57,9 +57,28 @@ HEADLINE_METRICS: Tuple[Tuple[str, str, Optional[str]], ...] = (
     ("rolling_replacement_p99_ms", "rollout p99 ms", "down"),
     ("telemetry_overhead_pct", "recorder ovh %", None),
     ("podtrace_overhead_pct", "podtrace ovh %", None),
+    # ISSUE 20: the federation tier — aggregate nodes behind the front
+    # door, router admission p99 on top of per-cell create->bound, and
+    # pods spilled-then-bound under a cell brownout — absent before r21;
+    # the gate tolerates missing history like multiproc/fastlane
+    ("federation_agg_nodes", "fed agg nodes", "up"),
+    ("federation_router_p99_ms", "fed router p99 ms", "down"),
+    ("federation_spillover_bound", "fed spill bound", "up"),
 )
 
 NOISE_BAND = 0.30
+
+# cpus-aware band (ISSUE 20 satellite): metrics whose level is set by
+# how much housekeeping can OVERLAP the stream core, mapped to the
+# artifact key carrying their same-box attribution A/B. On a 1-core box
+# fault handling serializes behind the stream, so the churn ratio sits
+# structurally lower than any multi-core bar — the r19/r20 0.37-0.39
+# readings against the 2-core r11 0.66 were box shape, not code (the
+# same-box placebo A/B in bench.measure_churn carries the attribution).
+# A 1-core regression on these metrics is annotated and NOT gated,
+# exactly like box_change — but ONLY when the round's artifact actually
+# carries the attribution evidence; a bare 1-cpu drop still gates.
+SINGLE_CORE_LENIENT = {"churn_vs_quiet": "churn_attribution"}
 
 
 def load_rounds(root: str) -> List[Tuple[int, Dict]]:
@@ -142,6 +161,13 @@ def find_regressions(rounds: List[Tuple[int, Dict]],
             if cur_cpus is not None and prev_cpus is not None \
                     and cur_cpus != prev_cpus:
                 reg["box_change"] = f"{prev_cpus} -> {cur_cpus} cpus"
+            elif key in SINGLE_CORE_LENIENT and cur_cpus == 1 \
+                    and isinstance(
+                        latest.get(SINGLE_CORE_LENIENT[key]), dict):
+                reg["single_core_band"] = (
+                    "1-cpu box: housekeeping serializes behind the "
+                    f"stream core — see {SINGLE_CORE_LENIENT[key]} "
+                    "in the artifact")
             regs.append(reg)
     return regs
 
@@ -226,7 +252,8 @@ def main(argv=None) -> int:
     if prog:
         print(prog)
     regs = find_regressions(rounds, band=args.band)
-    fatal = [g for g in regs if "box_change" not in g]
+    fatal = [g for g in regs
+             if "box_change" not in g and "single_core_band" not in g]
     if regs:
         print(f"\nREGRESSIONS past the ±{args.band:.0%} band:")
         for g in regs:
@@ -237,6 +264,8 @@ def main(argv=None) -> int:
                 # shapes) explains the delta — report it, don't gate on
                 # it (the r18 churn_vs_quiet lesson)
                 note = f"  [box change: {g['box_change']} — not gated]"
+            elif "single_core_band" in g:
+                note = f"  [{g['single_core_band']} — not gated]"
             print(f"  {arrow} {g['label']} ({g['metric']}): "
                   f"r{g['round']:02d}={g['current']:.2f} vs "
                   f"r{g['vs_round']:02d}={g['previous']:.2f} "
@@ -248,6 +277,6 @@ def main(argv=None) -> int:
     return 0
 
 
-__all__ = ["HEADLINE_METRICS", "NOISE_BAND", "find_regressions",
-           "load_rounds", "main", "progress_summary", "render_table",
-           "round_cpus"]
+__all__ = ["HEADLINE_METRICS", "NOISE_BAND", "SINGLE_CORE_LENIENT",
+           "find_regressions", "load_rounds", "main", "progress_summary",
+           "render_table", "round_cpus"]

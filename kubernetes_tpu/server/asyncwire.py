@@ -394,6 +394,40 @@ class AsyncBinaryServer:
                 self._pool, self.service.relist)
             return (framing.RELIST_RESULT,
                     framing.encode_relist_result(nodes, pods))
+        if verb == framing.CELL_AGG:
+            # federation pull (ISSUE 20): fold-and-answer the cell's
+            # routing column; drain/evacuate mutate the store — off the
+            # event loop like every service touch
+            fn = getattr(self.service, "cell_aggregate", None)
+            if fn is None:
+                return framing.ERROR, framing.encode_error(
+                    "service has no federation tier")
+            drain, evac = framing.decode_cell_agg_request(payload)
+            agg, spilled = await loop.run_in_executor(
+                self._pool,
+                lambda: fn(drain_spill=drain, evacuate=evac))
+            return (framing.CELL_AGG_RESULT,
+                    framing.encode_cell_agg_result(agg, spilled))
+        if verb == framing.ADMIT:
+            fn = getattr(self.service, "admit", None)
+            if fn is None:
+                return framing.ERROR, framing.encode_error(
+                    "service has no federation tier")
+            if self._inflight >= self.max_inflight:
+                self._count("admission_shed")
+                return framing.OVERLOADED, framing.encode_overloaded(
+                    self._retry_ms())
+            self._inflight += 1
+            try:
+                # decode on the worker: a router batch blob must not
+                # stall every connection's reads while it parses
+                accepted, replayed = await loop.run_in_executor(
+                    self._pool, lambda: fn(*framing.decode_admit_request(
+                        payload)))
+            finally:
+                self._inflight -= 1
+            return (framing.ADMIT_RESULT,
+                    framing.encode_admit_result(accepted, replayed))
         if verb == framing.STATS:
             # live introspection (ISSUE 13): the registry snapshot takes
             # per-source locks — off the event loop like every other

@@ -58,6 +58,15 @@ Verbs — requests:
                 bounded-stale snapshot without the server pushing state
                 (the level-triggered re-list of the watch/relist
                 discipline, over the wire).
+    CELL_AGG    federation aggregate pull (ISSUE 20): u8 verb flags
+                (drain spill / evacuate pending) -> CELL_AGG_RESULT
+                carrying the cell's incrementally-maintained aggregate
+                (JSON blob) + the spilled/evacuated pods it hands back
+                for re-routing (codec-tagged items blob).
+    ADMIT       federation admission (ISSUE 20): idempotency key + pod
+                batch -> ADMIT_RESULT (accepted, replayed counts). A
+                pod that already exists in the cell's store is a REPLAY,
+                never a second admission.
 
 Verbs — responses:
 
@@ -106,6 +115,16 @@ STATS = 0x07
 # process relists (nodes, bound pods) from the shared cell to refresh
 # its own scheduler's bounded-stale snapshot
 RELIST = 0x08
+# federation verbs (ISSUE 20): the front-door router's two touches of a
+# member cell. CELL_AGG pulls the cell's incrementally-maintained
+# aggregate (capacity headroom, band pressure, affinity domains — the
+# [C, M] routing tensor's one column) plus any spilled pods the cell
+# wants re-routed; flags in the payload ask for spill drain and/or a
+# full pending evacuation (brownout). ADMIT hands a batch of pods to
+# exactly one cell under an idempotency key — replays are counted, not
+# re-created, so a lost ADMIT_RESULT re-send cannot double-admit.
+CELL_AGG = 0x09
+ADMIT = 0x0A
 
 VERDICT = 0x81
 BIND_RESULT = 0x82
@@ -117,6 +136,8 @@ METRICS_TEXT = 0x88
 PONG = 0x89
 STATS_RESULT = 0x8A
 RELIST_RESULT = 0x8B
+CELL_AGG_RESULT = 0x8C
+ADMIT_RESULT = 0x8D
 
 FLAG_COMPACT = 0x01
 # trace context on FILTER/BIND (ISSUE 15): when set, the payload is
@@ -567,21 +588,91 @@ def decode_relist_result(payload: bytes):
             decode_items_blob(r.blob(), "pods"))
 
 
+# ------------------------------------------------------- federation verbs
+
+# CELL_AGG request flag bits (payload u8, not frame flags: frame flags
+# are transport-scoped, these are verb semantics)
+CELL_DRAIN_SPILL = 0x01   # include + consume the cell's spill buffer
+CELL_EVACUATE = 0x02      # brownout: ALSO uproot every pending pod
+
+
+def encode_cell_agg_request(drain_spill: bool = False,
+                            evacuate: bool = False) -> bytes:
+    f = (CELL_DRAIN_SPILL if drain_spill else 0) \
+        | (CELL_EVACUATE if evacuate else 0)
+    return bytes(Writer().u8(f).buf)
+
+
+def decode_cell_agg_request(payload: bytes) -> Tuple[bool, bool]:
+    f = Reader(payload).u8()
+    return bool(f & CELL_DRAIN_SPILL), bool(f & CELL_EVACUATE)
+
+
+def encode_cell_agg_result(agg: Dict, spilled) -> bytes:
+    """CELL_AGG_RESULT: the aggregate as one JSON blob (an open-ended,
+    evolving key set — the STATS rationale) + a codec-tagged items blob
+    of pods the cell hands back for re-routing (spill drain/evacuation;
+    empty when the request asked for neither)."""
+    return bytes(Writer()
+                 .blob(json.dumps(agg, separators=(",", ":")).encode())
+                 .blob(encode_items_blob(list(spilled), "pods")
+                       if spilled else b"").buf)
+
+
+def decode_cell_agg_result(payload: bytes):
+    r = Reader(payload)
+    try:
+        agg = json.loads(r.blob())
+    except ValueError as e:
+        raise FrameError(f"bad CELL_AGG payload: {e}") from e
+    blob = r.blob()
+    return agg, (decode_items_blob(blob, "pods") if blob else [])
+
+
+def encode_admit_request(idem_key: str, pods) -> bytes:
+    return bytes(Writer().str_(idem_key)
+                 .blob(encode_items_blob(list(pods), "pods")).buf)
+
+
+def decode_admit_request(payload: bytes):
+    r = Reader(payload)
+    idem_key = r.str_()
+    return idem_key, decode_items_blob(r.blob(), "pods")
+
+
+def encode_admit_result(accepted: int, replayed: int) -> bytes:
+    return bytes(Writer().u32(accepted).u32(replayed).buf)
+
+
+def decode_admit_result(payload: bytes) -> Tuple[int, int]:
+    r = Reader(payload)
+    return r.u32(), r.u32()
+
+
 __all__ = [
-    "BIND", "BIND_KINDS", "BIND_RESULT", "CODEC_JSON", "CODEC_PROTO",
+    "ADMIT", "ADMIT_RESULT",
+    "BIND", "BIND_KINDS", "BIND_RESULT",
+    "CELL_AGG", "CELL_AGG_RESULT", "CELL_DRAIN_SPILL", "CELL_EVACUATE",
+    "CODEC_JSON", "CODEC_PROTO",
     "DEADLINE", "ERROR", "FILTER", "FLAG_COMPACT", "FLAG_TRACE",
     "FrameDecoder",
     "FrameError", "HEADER_SIZE", "MAX_FRAME", "METRICS", "METRICS_TEXT",
     "OVERLOADED", "PING", "PONG", "RELIST", "RELIST_RESULT", "Reader",
     "STATS", "STATS_RESULT",
     "SYNCED", "SYNC_NODES", "SYNC_PODS", "VERDICT", "Writer",
+    "decode_admit_request", "decode_admit_result",
     "decode_bind_request", "decode_bind_request_lazy",
-    "decode_bind_result", "decode_error", "decode_filter_request",
+    "decode_bind_result",
+    "decode_cell_agg_request", "decode_cell_agg_result",
+    "decode_error", "decode_filter_request",
     "decode_filter_request_lazy", "decode_items_blob",
     "decode_metrics_text", "decode_overloaded", "decode_pod_blob",
     "decode_relist_result",
     "decode_stats_request", "decode_stats_result", "decode_synced",
-    "decode_verdict", "encode_bind_request", "encode_bind_result",
+    "decode_verdict",
+    "encode_admit_request", "encode_admit_result",
+    "encode_bind_request", "encode_bind_result",
+    "encode_cell_agg_request", "encode_cell_agg_result",
     "encode_error", "encode_filter_request", "encode_frame",
     "encode_items_blob", "encode_metrics_text", "encode_overloaded",
     "encode_pod_blob", "encode_relist_result", "encode_stats_request",

@@ -601,8 +601,79 @@ def audit_cache_vs_store(sched, api) -> List[str]:
     return problems
 
 
-__all__ = ["ChurnConfig", "ChurnInjector", "ChurnOp", "FaultyBindApi",
+# -------------------------------------------------------- cell brownout
+
+
+@dataclass(frozen=True)
+class CellBrownoutOp:
+    """One cell-level fault for the federation tier (ISSUE 20): the cell
+    goes NotReady at ``t`` (router evacuates its pending pods through
+    the spillover path) and recovers at ``t + down_s``."""
+
+    t: float
+    cell: str
+    down_s: float
+
+
+def make_brownout_schedule(cell_names: List[str], duration_s: float,
+                           down_s: float = 2.0, count: int = 1,
+                           seed: int = 0) -> List[CellBrownoutOp]:
+    """Frozen brownout schedule, deterministic in its arguments (the
+    same replayable-trace contract as make_churn_schedule). Instants
+    land in the middle 80% of the window — a brownout at the very edge
+    would measure shutdown, not spillover — and never overlap on the
+    same cell."""
+    rng = random.Random(seed ^ 0xB10)
+    ops: List[CellBrownoutOp] = []
+    busy_until: Dict[str, float] = {}
+    lo, hi = 0.1 * duration_s, 0.9 * duration_s
+    for _ in range(max(int(count), 0)):
+        t = rng.uniform(lo, max(hi - down_s, lo))
+        free = [c for c in cell_names if busy_until.get(c, -1.0) < t]
+        if not free:
+            continue
+        cell = free[rng.randrange(len(free))]
+        busy_until[cell] = t + down_s
+        ops.append(CellBrownoutOp(t, cell, down_s))
+    ops.sort(key=lambda op: (op.t, op.cell))
+    return ops
+
+
+class BrownoutDriver:
+    """Applies a frozen brownout schedule against a FederationRouter.
+    Call ``apply_until(t)`` from the owner's clock; each op's down and
+    up phases fire exactly once. Returns evacuated-pod count applied in
+    this call."""
+
+    def __init__(self, router, schedule: List[CellBrownoutOp]):
+        self._router = router
+        self._downs = sorted(schedule, key=lambda op: op.t)
+        self._ups = sorted(schedule, key=lambda op: op.t + op.down_s)
+        self._di = 0
+        self._ui = 0
+        self.evacuated = 0
+
+    def apply_until(self, t: float) -> int:
+        moved = 0
+        while self._di < len(self._downs) and self._downs[self._di].t <= t:
+            op = self._downs[self._di]
+            self._di += 1
+            moved += self._router.brownout(op.cell)
+        while self._ui < len(self._ups) \
+                and self._ups[self._ui].t + self._ups[self._ui].down_s <= t:
+            op = self._ups[self._ui]
+            self._ui += 1
+            self._router.recover(op.cell)
+        self.evacuated += moved
+        return moved
+
+    def done(self) -> bool:
+        return self._di >= len(self._downs) and self._ui >= len(self._ups)
+
+
+__all__ = ["BrownoutDriver", "CellBrownoutOp", "ChurnConfig",
+           "ChurnInjector", "ChurnOp", "FaultyBindApi",
            "RollingUpdateConfig", "RollingUpdateDriver",
            "audit_cache_vs_store", "audit_store_transitions",
-           "diurnal_rate", "extender_store_binder", "make_churn_schedule",
-           "ZONES"]
+           "diurnal_rate", "extender_store_binder",
+           "make_brownout_schedule", "make_churn_schedule", "ZONES"]

@@ -1951,3 +1951,80 @@ def test_lint_gate_refuses_concurrency_dirty_tree(tmp_path):
     (tmp_path / "bad.py").write_text(textwrap.dedent(GL007_TORN_COUNTER))
     ok, report = lint_gate(str(tmp_path))
     assert not ok and "GL007" in report
+
+
+# ----------------------------------------------------- federation (ISSUE 20)
+
+
+def test_gl002_registry_covers_federation_route_scores(tmp_path):
+    """ISSUE 20: the router's fused [C, M] scoring seam
+    (ops/federation.route_scores) is a module-level jit bind — the
+    project-wide registry must pick it up from the REAL source so GL002
+    taint extends to consumers. An unblessed fetch of the routing
+    verdict sits on the admission path: one accidental sync per batch
+    is the router's whole sub-10 ms budget."""
+    import ast
+
+    from kubernetes_tpu.analysis.rules.base import ProjectIndex
+
+    fed_py = os.path.join(PKG_DIR, "ops", "federation.py")
+    with open(fed_py, "r", encoding="utf-8") as fh:
+        index = ProjectIndex()
+        index.scan(ast.parse(fh.read()))
+    assert "route_scores" in index.jitted_names, \
+        "route_scores missing from the jit registry"
+    fixture = tmp_path / "route_batch.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.ops.federation import route_scores
+
+        def route_batch(dc, dm, cf, mf, cc, mc, pr, rd, dok):
+            out = route_scores(dc, dm, cf, mf, cc, mc, pr, rd, dok)
+            return np.asarray(out)
+    """))
+    findings, _sup, errors = run_paths([fed_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert any(f.rule == "GL002" and "route_batch" in f.context
+               for f in findings), findings
+    # the blessed fetch — the ONE routing-verdict transfer per batch
+    # (the stacked [2, C] output exists exactly so there is one)
+    fixture.write_text(fixture.read_text().replace(
+        "return np.asarray(out)",
+        "return np.asarray(out)  # graftlint: sync-ok"))
+    findings, _sup, errors = run_paths([fed_py, str(fixture)],
+                                       rules=["GL002"])
+    assert not errors, errors
+    assert not [f for f in findings if "route_batch" in f.context], \
+        findings
+
+
+def test_federation_pad_to_bucket_idiom_stays_silent(tmp_path):
+    """The router's pad-to-bucket shape bounding (host-side np.pad of
+    the C axis BEFORE the dispatch, trim after the blessed fetch) is
+    the documented GL003 escape hatch one level up — the full rule set
+    must stay silent on it."""
+    fed_py = os.path.join(PKG_DIR, "ops", "federation.py")
+    fixture = tmp_path / "padded_route.py"
+    fixture.write_text(textwrap.dedent("""
+        import numpy as np
+        from kubernetes_tpu.ops.federation import route_scores
+        from kubernetes_tpu.ops.predicates import bucket
+
+        def padded_route(dc, dm, cf, mf, cc, mc, pr, rd, dok):
+            c = len(dc)
+            cb = bucket(c)
+            if cb != c:
+                pad = cb - c
+                dc = np.pad(dc, (0, pad))
+                dm = np.pad(dm, (0, pad))
+                dok = np.pad(dok, ((0, pad), (0, 0)),
+                             constant_values=True)
+            out = route_scores(dc, dm, cf, mf, cc, mc, pr, rd, dok)
+            verdict = np.asarray(out)  # graftlint: sync-ok
+            return verdict[:, :c]
+    """))
+    findings, _sup, errors = run_paths([fed_py, str(fixture)])
+    assert not errors, errors
+    assert not [f for f in findings if "padded_route" in f.context], \
+        findings
